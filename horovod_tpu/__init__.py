@@ -1,0 +1,99 @@
+"""horovod_tpu — a TPU-native distributed deep-learning training framework.
+
+A ground-up rebuild of the capabilities of Horovod 0.19.1 (reference:
+yangw1234/horovod, see SURVEY.md) designed for TPU hardware: XLA collectives
+(`psum`/`all_gather`/`ppermute`) compiled over the ICI/DCN device mesh
+replace MPI/NCCL/Gloo; `jax.distributed` + an HTTP rendezvous replace the
+MPI/Gloo controller bootstrap; a native (C++) background engine provides the
+reference's asynchronous named-tensor eager path (negotiation, tensor
+fusion, response cache, timeline, stall inspection).
+
+Typical use (the reference's four-line recipe, README.rst "Usage")::
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))          # grads psum'd
+    params = hvd.broadcast_parameters(params, root_rank=0)     # state sync
+    step = hvd.distribute(train_step)                          # shard_map'd
+"""
+
+from .basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    num_devices,
+    device_rank,
+    is_homogeneous,
+    mesh,
+    global_topology,
+    DP_AXIS,
+    CROSS_AXIS,
+    LOCAL_AXIS,
+)
+from .ops.collectives import (  # noqa: F401
+    ReduceOp,
+    Average,
+    Sum,
+    Adasum,
+    Min,
+    Max,
+    allreduce,
+    allreduce_,
+    grouped_allreduce,
+    allgather,
+    broadcast,
+    broadcast_,
+    alltoall,
+    reducescatter,
+)
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Heavier layers load lazily so `import horovod_tpu` stays cheap and the
+    # jit-only path never starts the eager engine.
+    if name in (
+        "DistributedOptimizer",
+        "DistributedGradientTransform",
+        "distribute",
+        "broadcast_parameters",
+        "broadcast_optimizer_state",
+        "broadcast_object",
+    ):
+        from . import optim  # noqa: PLC0415
+
+        return getattr(optim, name)
+    if name == "Compression":
+        from .ops.compression import Compression  # noqa: PLC0415
+
+        return Compression
+    if name in (
+        "allreduce_async",
+        "allreduce_async_",
+        "allgather_async",
+        "broadcast_async",
+        "broadcast_async_",
+        "synchronize",
+        "poll",
+        "join",
+    ):
+        from .ops import eager  # noqa: PLC0415
+
+        return getattr(eager, name)
+    if name == "SyncBatchNorm":
+        from .parallel.sync_batch_norm import SyncBatchNorm  # noqa: PLC0415
+
+        return SyncBatchNorm
+    if name == "callbacks":
+        from . import callbacks  # noqa: PLC0415
+
+        return callbacks
+    raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

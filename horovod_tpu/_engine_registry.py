@@ -1,0 +1,37 @@
+"""Process-wide handle to the eager engine (native background runtime).
+
+Kept in its own module so :mod:`horovod_tpu.basics` can tear the engine down
+on :func:`horovod_tpu.shutdown` without importing the engine eagerly (the
+jit-only path never pays for it)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_engine = None
+
+
+def get_engine():
+    """Lazily start the eager engine (reference: InitializeHorovodOnce
+    spawning the background thread, horovod/common/operations.cc:604-650)."""
+    global _engine
+    with _lock:
+        if _engine is None:
+            from .runtime.engine import EagerEngine  # noqa: PLC0415
+
+            _engine = EagerEngine.start()
+        return _engine
+
+
+def peek_engine() -> Optional[object]:
+    return _engine
+
+
+def shutdown_engine() -> None:
+    global _engine
+    with _lock:
+        if _engine is not None:
+            _engine.shutdown()
+            _engine = None

@@ -1,0 +1,285 @@
+"""Process/topology bootstrap for horovod_tpu.
+
+TPU-native analog of the reference's ``HorovodBasics`` ctypes layer
+(reference: horovod/common/basics.py:22-66) and the C init path
+(horovod/common/operations.cc:604-650).  Where the reference spawns a
+background MPI/Gloo controller thread per process, the TPU build wires up
+``jax.distributed`` (the JAX coordination service plays the role of the Gloo
+HTTP rendezvous, reference horovod/common/gloo/gloo_context.cc:113-157) and
+builds named device meshes over which XLA collectives compile.
+
+Rank semantics
+--------------
+The reference runs one process per accelerator, so ``rank() == device``.
+On TPU one process owns several chips, so the concepts split:
+
+* ``rank()`` / ``size()``            -- process-level (one per host by default).
+  This is what the eager per-op engine coordinates over, exactly like the
+  reference controller negotiates over MPI ranks.
+* ``local_rank()`` / ``local_size()`` -- process index within the host
+  (reference: horovod/common/mpi/mpi_controller.cc:25-81 local_comm split).
+* ``cross_rank()`` / ``cross_size()`` -- one-process-per-host axis
+  (reference Communicator::CROSS, horovod/common/common.h:111-115).
+* ``num_devices()`` / ``device_rank()`` -- chip-level; this is the width of
+  the data-parallel mesh axis the jit path psums over, and the number that
+  matters for scaling efficiency.
+
+Environment contract (set by ``hvdrun``, mirroring HOROVOD_RANK/... set by
+the reference launcher, horovod/run/gloo_run.py:143-165):
+
+    HVDTPU_RANK / HVDTPU_SIZE
+    HVDTPU_LOCAL_RANK / HVDTPU_LOCAL_SIZE
+    HVDTPU_CROSS_RANK / HVDTPU_CROSS_SIZE
+    HVDTPU_COORDINATOR        host:port of the jax.distributed coordinator
+    HVDTPU_CONTROLLER_PORT    base port for the eager-engine controller mesh
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "rank",
+    "size",
+    "local_rank",
+    "local_size",
+    "cross_rank",
+    "cross_size",
+    "num_devices",
+    "device_rank",
+    "is_homogeneous",
+    "mesh",
+    "global_topology",
+    "DP_AXIS",
+    "CROSS_AXIS",
+    "LOCAL_AXIS",
+]
+
+# Canonical mesh axis names.  DP_AXIS is the flat data-parallel axis every
+# collective defaults to (the analog of Communicator::GLOBAL); CROSS/LOCAL
+# form the 2D hierarchical mesh (DCN x ICI), the analog of the reference's
+# cross/local communicators used by NCCLHierarchicalAllreduce
+# (horovod/common/ops/nccl_operations.cc:162-300).
+DP_AXIS = "hvd"
+CROSS_AXIS = "hvd_cross"
+LOCAL_AXIS = "hvd_local"
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+@dataclass
+class Topology:
+    """Static view of the job, fixed at init() (SPMD world is static;
+    the reference's dynamic Join story is handled at the op layer)."""
+
+    process_rank: int
+    process_count: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    devices: Sequence[jax.Device] = field(default_factory=list)
+    homogeneous: bool = True
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+
+_state_lock = threading.Lock()
+_topology: Optional[Topology] = None
+_mesh_cache: dict = {}
+
+
+def _env_int(name: str, default: int) -> int:
+    value = os.environ.get(name)
+    return int(value) if value not in (None, "") else default
+
+
+def init(comm=None) -> Topology:
+    """Initialize the framework (reference: horovod_init, operations.cc:663).
+
+    Safe to call more than once (the reference spin-waits on
+    initialization_done, operations.cc:646-648; here re-init is a no-op).
+
+    ``comm`` is accepted for API compatibility with the reference's
+    sub-communicator init (horovod/common/basics.py:33-65) but only the
+    default (whole-world) communicator is supported on TPU, where process
+    membership is fixed by the coordination service.
+    """
+    global _topology
+    with _state_lock:
+        if _topology is not None:
+            return _topology
+        if comm is not None and comm not in ([], None):
+            raise ValueError(
+                "horovod_tpu.init(comm=...) sub-communicators are not supported; "
+                "the TPU world is defined by the coordination service."
+            )
+
+        world = _env_int("HVDTPU_SIZE", 1)
+        proc = _env_int("HVDTPU_RANK", 0)
+        coordinator = os.environ.get("HVDTPU_COORDINATOR")
+
+        if world > 1 and not _jax_distributed_active():
+            if coordinator is None:
+                raise RuntimeError(
+                    "HVDTPU_SIZE > 1 but HVDTPU_COORDINATOR is unset; launch with "
+                    "hvdrun or set the rendezvous environment explicitly."
+                )
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world,
+                process_id=proc,
+            )
+
+        devices = tuple(jax.devices())
+        local_devices = tuple(jax.local_devices())
+        # Homogeneity check: the reference allgathers local sizes and flags
+        # mixed hosts (mpi_controller.cc:46-81).  Here device counts per
+        # process are visible globally through the platform client.
+        per_proc = {}
+        for d in devices:
+            per_proc[d.process_index] = per_proc.get(d.process_index, 0) + 1
+        homogeneous = len(set(per_proc.values())) <= 1
+
+        _topology = Topology(
+            process_rank=proc if world > 1 else 0,
+            process_count=world if world > 1 else 1,
+            local_rank=_env_int("HVDTPU_LOCAL_RANK", 0),
+            local_size=_env_int("HVDTPU_LOCAL_SIZE", 1),
+            cross_rank=_env_int("HVDTPU_CROSS_RANK", proc if world > 1 else 0),
+            cross_size=_env_int("HVDTPU_CROSS_SIZE", world if world > 1 else 1),
+            devices=devices,
+            homogeneous=homogeneous,
+        )
+        del local_devices
+        return _topology
+
+
+def _jax_distributed_active() -> bool:
+    try:
+        from jax._src import distributed  # noqa: PLC0415
+
+        return distributed.global_state.client is not None
+    except Exception:  # pragma: no cover - internal layout shift
+        return jax.process_count() > 1
+
+
+def shutdown() -> None:
+    """Tear down state (reference: horovod_shutdown, operations.cc:688).
+
+    Stops the eager engine if running; leaves the JAX runtime alive (XLA
+    client shutdown is owned by the process, as MPI_Finalize ownership is
+    negotiated in the reference's MPIContextManager)."""
+    global _topology
+    with _state_lock:
+        from . import _engine_registry  # noqa: PLC0415
+
+        _engine_registry.shutdown_engine()
+        _topology = None
+        _mesh_cache.clear()
+
+
+def is_initialized() -> bool:
+    return _topology is not None
+
+
+def global_topology() -> Topology:
+    if _topology is None:
+        raise NotInitializedError()
+    return _topology
+
+
+def rank() -> int:
+    """Process rank (reference: horovod_rank, operations.cc:696)."""
+    return global_topology().process_rank
+
+
+def size() -> int:
+    """Process count (reference: horovod_size, operations.cc:708)."""
+    return global_topology().process_count
+
+
+def local_rank() -> int:
+    """Rank within the host (reference: horovod_local_rank, operations.cc:702)."""
+    return global_topology().local_rank
+
+
+def local_size() -> int:
+    """Processes on this host (reference: horovod_local_size, operations.cc:714)."""
+    return global_topology().local_size
+
+
+def cross_rank() -> int:
+    return global_topology().cross_rank
+
+
+def cross_size() -> int:
+    return global_topology().cross_size
+
+
+def num_devices() -> int:
+    """Total chips in the job == width of the DP mesh axis."""
+    return global_topology().num_devices
+
+
+def device_rank(device: Optional[jax.Device] = None) -> int:
+    """Global index of a chip in the DP mesh (first local chip by default)."""
+    topo = global_topology()
+    if device is None:
+        device = jax.local_devices()[0]
+    return list(topo.devices).index(device)
+
+
+def is_homogeneous() -> bool:
+    """Reference: horovod_is_homogeneous (operations.cc:720)."""
+    return global_topology().homogeneous
+
+
+def mesh(shape: str = "flat") -> jax.sharding.Mesh:
+    """Build (and cache) the named device mesh collectives compile over.
+
+    ``flat``          -> 1D mesh, axis DP_AXIS over every chip.
+    ``hierarchical``  -> 2D mesh (CROSS_AXIS=hosts, LOCAL_AXIS=chips/host),
+                         the TPU analog of the reference's local/cross
+                         communicators (mpi/mpi_context.cc; used by
+                         NCCLHierarchicalAllreduce, nccl_operations.cc:162-300).
+                         Collectives over LOCAL_AXIS ride ICI; CROSS_AXIS
+                         rides DCN.
+    """
+    topo = global_topology()
+    if shape in _mesh_cache:
+        return _mesh_cache[shape]
+    devices = np.asarray(topo.devices, dtype=object)
+    if shape == "flat":
+        m = jax.sharding.Mesh(devices, (DP_AXIS,))
+    elif shape == "hierarchical":
+        hosts = topo.cross_size if topo.process_count > 1 else 1
+        if len(devices) % max(hosts, 1) != 0:
+            raise ValueError(
+                f"cannot build hierarchical mesh: {len(devices)} devices over "
+                f"{hosts} hosts is uneven"
+            )
+        per = len(devices) // max(hosts, 1)
+        m = jax.sharding.Mesh(
+            devices.reshape(hosts, per), (CROSS_AXIS, LOCAL_AXIS)
+        )
+    else:
+        raise ValueError(f"unknown mesh shape {shape!r}")
+    _mesh_cache[shape] = m
+    return m

@@ -1,0 +1,30 @@
+"""Collective ops for horovod_tpu.
+
+Two execution paths, mirroring the design split in SURVEY.md §7:
+
+* :mod:`horovod_tpu.ops.collectives` -- the **jit/SPMD path**: per-device
+  collectives (psum / all_gather / ppermute) with Horovod's autodiff rules,
+  usable inside ``pjit`` / ``shard_map`` over a named mesh axis.  XLA
+  schedules and fuses these; no runtime controller is involved (the
+  reference needed one because NCCL kernels are invisible to the framework
+  compiler; XLA collectives are not).
+* :mod:`horovod_tpu.ops.eager` -- the **eager per-op path**: Horovod-style
+  named-tensor enqueue (``allreduce_async_`` / ``synchronize``) coordinated
+  by the native background engine, for API parity with the reference's
+  horovod/torch/mpi_ops.py surface.
+"""
+
+from .collectives import (  # noqa: F401
+    ReduceOp,
+    Average,
+    Sum,
+    Adasum,
+    allreduce,
+    allreduce_,
+    grouped_allreduce,
+    allgather,
+    broadcast,
+    broadcast_,
+    alltoall,
+    reducescatter,
+)

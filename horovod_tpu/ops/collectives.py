@@ -1,0 +1,337 @@
+"""SPMD collectives with Horovod's autodiff rules.
+
+These are the jit-path primitives: call them inside ``shard_map`` / ``pjit``
+over a named mesh axis (default :data:`horovod_tpu.basics.DP_AXIS`).  XLA
+lowers them to ICI/DCN collectives; there is no runtime controller on this
+path (SPMD program order already guarantees every chip issues the same
+collectives in the same order, which is the invariant the reference's rank-0
+negotiation protocol exists to enforce — horovod/common/controller.h:62-97).
+
+Autodiff rules are ported from the reference's autograd Functions
+(horovod/torch/mpi_ops.py):
+
+* allreduce  backward = allreduce of the cotangent        (mpi_ops.py:158-171)
+* allgather  backward = reduce, then slice own rank chunk (mpi_ops.py:289-307)
+* broadcast  backward = reduce to root, zero elsewhere    (mpi_ops.py:371-385)
+
+``Average`` is implemented as Sum + divide, exactly as the reference does in
+framework code because its core rejects AVERAGE
+(horovod/common/operations.cc:812-819, horovod/torch/mpi_ops.py:94-129).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..basics import DP_AXIS
+
+__all__ = [
+    "ReduceOp",
+    "Average",
+    "Sum",
+    "Adasum",
+    "Min",
+    "Max",
+    "allreduce",
+    "allreduce_",
+    "grouped_allreduce",
+    "allgather",
+    "broadcast",
+    "broadcast_",
+    "alltoall",
+    "reducescatter",
+    "axis_rank",
+    "axis_size",
+]
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction ops (reference: horovod_reduce_op_{average,sum,adasum},
+    horovod/common/operations.cc:726-799)."""
+
+    AVERAGE = 1
+    SUM = 2
+    ADASUM = 3
+    MIN = 4
+    MAX = 5
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+
+
+def axis_rank(axis_name: str = DP_AXIS):
+    """This shard's index along the collective axis (trace-time value)."""
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str = DP_AXIS) -> int:
+    """Static width of the collective axis."""
+    return lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _allreduce_sum(x, axis_name, average):
+    y = lax.psum(x, axis_name)
+    if average:
+        y = y / axis_size(axis_name)
+    return y
+
+
+def _allreduce_fwd(x, axis_name, average):
+    return _allreduce_sum(x, axis_name, average), None
+
+
+def _allreduce_bwd(axis_name, average, _, g):
+    # Reference rule: backward of allreduce is allreduce with the same op
+    # (horovod/torch/mpi_ops.py:158-171).
+    return (_allreduce_sum(g, axis_name, average),)
+
+
+_allreduce_sum.defvjp(_allreduce_fwd, _allreduce_bwd)
+
+
+def allreduce(
+    tensor,
+    op: ReduceOp = Average,
+    *,
+    axis_name: str = DP_AXIS,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    name: Optional[str] = None,
+):
+    """Allreduce across the mesh axis (reference: hvd.allreduce,
+    horovod/torch/mpi_ops.py:94-155; EnqueueTensorAllreduce,
+    horovod/common/operations.cc:803).
+
+    Works on a single array or an arbitrary pytree (each leaf reduced).
+    ``name`` is accepted for reference-API compatibility; the jit path does
+    not need names (no negotiation), the eager path does.
+    """
+    del name
+    if op == Adasum:
+        from .adasum import adasum_allreduce  # noqa: PLC0415
+
+        return adasum_allreduce(tensor, axis_name=axis_name)
+
+    def one(x):
+        x = jnp.asarray(x)
+        if prescale_factor != 1.0:
+            x = x * prescale_factor
+        if op in (Average, Sum):
+            y = _allreduce_sum(x, axis_name, op == Average)
+        elif op == Min:
+            y = lax.pmin(x, axis_name)
+        elif op == Max:
+            y = lax.pmax(x, axis_name)
+        else:
+            raise ValueError(f"unsupported reduce op {op!r}")
+        if postscale_factor != 1.0:
+            y = y * postscale_factor
+        return y
+
+    return jax.tree_util.tree_map(one, tensor)
+
+
+def allreduce_(tensor, op: ReduceOp = Average, **kwargs):
+    """In-place-spelled alias (JAX arrays are immutable; returns the result).
+
+    Exists so reference call sites (``hvd.allreduce_``) port mechanically."""
+    return allreduce(tensor, op, **kwargs)
+
+
+def grouped_allreduce(
+    tensors: Sequence,
+    op: ReduceOp = Average,
+    *,
+    axis_name: str = DP_AXIS,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Fused allreduce of a list of tensors via a single flat buffer.
+
+    TPU-native tensor fusion: the reference memcpys entries into a 64 MB
+    fusion buffer around one NCCL call
+    (horovod/common/fusion_buffer_manager.cc,
+    collective_operations.cc:159-210); here we flatten+concat into one
+    1-D buffer, issue one psum, and split back.  Under jit XLA usually fuses
+    adjacent psums anyway; this makes the fusion explicit and guarantees a
+    single collective launch.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(list(tensors))
+    if not leaves:
+        return tensors
+    # Promote to a common dtype bucket per dtype, preserving exact dtypes:
+    # fuse only same-dtype runs (the reference fuses per dtype too —
+    # controller.cc:676-689 look-ahead keeps dtypes homogeneous per fusion).
+    out = [None] * len(leaves)
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    for dtype, idxs in by_dtype.items():
+        flat = jnp.concatenate(
+            [jnp.ravel(jnp.asarray(leaves[i])) for i in idxs]
+        )
+        reduced = allreduce(
+            flat,
+            op,
+            axis_name=axis_name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+        offset = 0
+        for i in idxs:
+            n = jnp.asarray(leaves[i]).size
+            out[i] = lax.dynamic_slice_in_dim(reduced, offset, n).reshape(
+                jnp.shape(leaves[i])
+            )
+            offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _allgather(x, axis_name):
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def _allgather_fwd(x, axis_name):
+    return _allgather(x, axis_name), jnp.shape(x)[0]
+
+
+def _allgather_bwd(axis_name, dim0, g):
+    # Reference rule: reduce the gathered cotangent, then every rank keeps
+    # its own slice (horovod/torch/mpi_ops.py:289-307).  psum_scatter does
+    # both in one collective (reduce-scatter), which is strictly cheaper
+    # than the reference's allreduce + narrow.
+    del dim0
+    return (lax.psum_scatter(g, axis_name, scatter_dimension=0, tiled=True),)
+
+
+_allgather.defvjp(_allgather_fwd, _allgather_bwd)
+
+
+def allgather(tensor, *, axis_name: str = DP_AXIS, name: Optional[str] = None):
+    """Concatenate each shard's tensor along dim 0 (reference: hvd.allgather,
+    horovod/torch/mpi_ops.py:231-307; EnqueueTensorAllgather,
+    operations.cc:856).
+
+    The jit path requires equal dim-0 sizes (static shapes; XLA constraint).
+    Ragged gathers — the reference negotiates per-rank sizes at runtime
+    (controller.cc:453-518) — are served by the eager path, which pads to
+    the negotiated max and slices on the host.
+    """
+    del name
+    return jax.tree_util.tree_map(
+        lambda x: _allgather(jnp.asarray(x), axis_name), tensor
+    )
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _broadcast(x, root_rank, axis_name):
+    # One psum of a masked value: every non-root contributes zeros, so the
+    # sum is exactly the root's tensor.  XLA lowers this to a single
+    # all-reduce; on TPU this beats gather-then-index.
+    mask = (lax.axis_index(axis_name) == root_rank).astype(x.dtype)
+    return lax.psum(x * mask, axis_name)
+
+
+def _broadcast_fwd(x, root_rank, axis_name):
+    return _broadcast(x, root_rank, axis_name), None
+
+
+def _broadcast_bwd(root_rank, axis_name, _, g):
+    # Reference rule: sum cotangents to the root, zeros elsewhere
+    # (horovod/torch/mpi_ops.py:371-385).
+    summed = lax.psum(g, axis_name)
+    mask = (lax.axis_index(axis_name) == root_rank).astype(g.dtype)
+    return (summed * mask,)
+
+
+_broadcast.defvjp(_broadcast_fwd, _broadcast_bwd)
+
+
+def broadcast(
+    tensor, root_rank: int, *, axis_name: str = DP_AXIS, name: Optional[str] = None
+):
+    """Broadcast the root shard's value to every shard (reference:
+    hvd.broadcast, horovod/torch/mpi_ops.py:330-406; EnqueueTensorBroadcast,
+    operations.cc:891)."""
+    del name
+    return jax.tree_util.tree_map(
+        lambda x: _broadcast(jnp.asarray(x), root_rank, axis_name), tensor
+    )
+
+
+def broadcast_(tensor, root_rank: int, **kwargs):
+    """In-place-spelled alias; see :func:`allreduce_`."""
+    return broadcast(tensor, root_rank, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# alltoall / reducescatter (TPU-first extensions)
+# ---------------------------------------------------------------------------
+
+
+def alltoall(tensor, *, axis_name: str = DP_AXIS):
+    """Scatter dim-0 chunks to each shard and gather their chunks (the
+    primitive behind Ulysses-style sequence parallelism).  Not present in
+    the reference at 0.19.1 (SURVEY.md §2.9); provided because all-to-all is
+    first-class on the ICI torus and later Horovod grew it."""
+
+    def one(x):
+        x = jnp.asarray(x)
+        n = axis_size(axis_name)
+        if x.shape[0] % n != 0:
+            raise ValueError(
+                f"alltoall dim0 ({x.shape[0]}) must divide the axis size ({n})"
+            )
+        return lax.all_to_all(
+            x.reshape((n, x.shape[0] // n) + x.shape[1:]),
+            axis_name,
+            split_axis=0,
+            concat_axis=0,
+            tiled=False,
+        ).reshape(x.shape)
+
+    return jax.tree_util.tree_map(one, tensor)
+
+
+def reducescatter(tensor, op: ReduceOp = Average, *, axis_name: str = DP_AXIS):
+    """Sum across shards, keep only this shard's dim-0 slice — the first leg
+    of the reference's hierarchical allreduce (nccl_operations.cc:218-229)
+    exposed as a user op."""
+
+    def one(x):
+        x = jnp.asarray(x)
+        y = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+        if op == Average:
+            y = y / axis_size(axis_name)
+        elif op != Sum:
+            raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
+        return y
+
+    return jax.tree_util.tree_map(one, tensor)

@@ -1,0 +1,44 @@
+"""Test harness config.
+
+Mirrors the reference's test strategy (SURVEY.md §4): collective correctness
+is tested against a real multi-device world, not mocks.  Where the reference
+runs pytest under `mpirun -np 2 -H localhost:2`, we give the single test
+process an 8-device virtual CPU mesh (XLA host-platform device count) so
+every SPMD collective executes for real.  Launcher/controller logic is
+unit-tested in-process, like the reference's test_run.py.
+
+Multi-process tests (true multi-controller JAX over the hvdrun launcher)
+live in tests/launcher/ and spawn subprocesses themselves.
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.  Force CPU even
+# when the shell points JAX at a TPU platform: the suite wants a deterministic
+# 8-device virtual mesh regardless of attached hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("HVDTPU_TEST_MODE", "1")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The container's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon already latched into jax.config; env edits above are too
+# late for that knob, so override through the config API before any backend
+# is instantiated.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _world():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert jax.device_count() == 8, "virtual CPU mesh failed to materialize"
+    yield
+    hvd.shutdown()
