@@ -1,0 +1,143 @@
+"""Training-loop callbacks.
+
+Reference: the shared Keras callback implementations
+(horovod/_keras/callbacks.py:20-185): BroadcastGlobalVariablesCallback,
+MetricAverageCallback, LearningRateScheduleCallback,
+LearningRateWarmupCallback.  The TPU build targets functional training
+loops (flax/optax), so each callback exists in the idiomatic form:
+
+* broadcast  -> :func:`horovod_tpu.broadcast_parameters` called at start
+  (wrapped here as a callback object for loop frameworks that want one);
+* metric averaging -> :func:`metric_average` (an eager allreduce, and a
+  jit-safe variant);
+* LR schedules -> **optax schedule constructors** with the reference's
+  exact warmup/staircase semantics, because in JAX the schedule must be a
+  traced function of the step, not a mutable callback.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import optax
+
+from .basics import DP_AXIS, size
+from .ops.collectives import Average, allreduce
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback",
+    "metric_average",
+    "LearningRateScheduleCallback",
+    "LearningRateWarmupCallback",
+    "warmup_schedule",
+    "multiplier_schedule",
+]
+
+
+def metric_average(value, name: Optional[str] = None, *, axis_name: str = DP_AXIS):
+    """Average a metric across workers (reference: MetricAverageCallback,
+    _keras/callbacks.py:46-72, which allreduces epoch metrics).
+
+    Inside jit/shard_map this lowers to a psum; outside it routes through
+    the eager engine — hvd.allreduce performs that dispatch itself."""
+    return allreduce(
+        value, op=Average, axis_name=axis_name, name=name or "metric"
+    )
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast initial state from root once, at the first step
+    (reference: _keras/callbacks.py:20-44, fires on_batch_end of batch 0)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def __call__(self, params):
+        from .optim import broadcast_parameters  # noqa: PLC0415
+
+        if self._done:
+            return params
+        self._done = True
+        return broadcast_parameters(params, self.root_rank)
+
+
+class MetricAverageCallback:
+    """Average a dict of metrics across workers at epoch end
+    (reference: _keras/callbacks.py:46-72)."""
+
+    def __call__(self, metrics: dict) -> dict:
+        return {k: metric_average(v, name=k) for k, v in metrics.items()}
+
+
+def warmup_schedule(
+    base_lr: float,
+    *,
+    warmup_epochs: float = 5.0,
+    steps_per_epoch: int,
+    scale: Optional[float] = None,
+    momentum_correction: bool = False,
+) -> optax.Schedule:
+    """The reference's LearningRateWarmupCallback as an optax schedule
+    (_keras/callbacks.py:116-185): ramp lr from ``base_lr`` to
+    ``base_lr * scale`` (default: world size — the linear scaling rule from
+    Goyal et al., which the callback cites) over ``warmup_epochs`` epochs,
+    with the same exponential-in-epoch interpolation::
+
+        lr = base_lr * scale^(epoch / warmup_epochs)   clipped at scale
+    """
+    del momentum_correction  # torch-specific; optax momentum is stateless in lr
+    target_scale = float(scale) if scale is not None else float(size())
+
+    def schedule(step):
+        epoch = step / steps_per_epoch
+        frac = jnp.minimum(epoch / warmup_epochs, 1.0)
+        return base_lr * jnp.power(target_scale, frac)
+
+    return schedule
+
+
+def multiplier_schedule(
+    base_lr: float,
+    multiplier: Callable[[float], float] | Sequence[tuple[float, float]],
+    *,
+    steps_per_epoch: int,
+    staircase: bool = True,
+) -> optax.Schedule:
+    """The reference's LearningRateScheduleCallback (_keras/callbacks.py:74-114):
+    lr = base_lr * multiplier(epoch).  ``multiplier`` may be a python
+    function of epoch (evaluated at trace time per step via jnp ops is not
+    possible for arbitrary python; so list form) or a list of
+    (start_epoch, multiplier) breakpoints applied in order."""
+    if callable(multiplier):
+        # Sample the python function per epoch over a generous horizon and
+        # turn it into a piecewise-constant schedule (staircase) — keeps
+        # arbitrary python logic out of the traced step.
+        horizon = 1000
+        values = [float(multiplier(e)) for e in range(horizon)]
+        table = jnp.asarray(values) * base_lr
+
+        def schedule(step):
+            epoch = step // steps_per_epoch if staircase else step / steps_per_epoch
+            idx = jnp.clip(jnp.asarray(epoch, jnp.int32), 0, horizon - 1)
+            return table[idx]
+
+        return schedule
+
+    points = sorted(multiplier)
+
+    def schedule(step):
+        epoch = step / steps_per_epoch
+        mult = jnp.asarray(1.0)
+        for start, m in points:
+            mult = jnp.where(epoch >= start, m, mult)
+        return base_lr * mult
+
+    return schedule
+
+
+# Class-style aliases so reference call sites port mechanically.
+LearningRateWarmupCallback = warmup_schedule
+LearningRateScheduleCallback = multiplier_schedule

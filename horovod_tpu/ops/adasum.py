@@ -1,0 +1,105 @@
+"""Adasum reduction, TPU-native.
+
+The reference implements Adasum as a Vector-Halving Distance-Doubling
+(VHDD) fused allreduce in C++ (horovod/common/ops/adasum/adasum.h:167-299):
+log2(N) levels, partner = rank ^ 2^level (adasum.h:230), each pair exchanges
+buffer halves point-to-point and combines them with a projection formula
+computed from pairwise dot products and squared norms
+(DispatchComputeDotAndNormSqrds, adasum.h:101-120).
+
+The pairwise rule for contributions ``a`` and ``b``::
+
+    adasum(a, b) = (1 - a.b / (2 |a|^2)) * a  +  (1 - a.b / (2 |b|^2)) * b
+
+which reduces to a+b when orthogonal and to the average when identical —
+an automatic interpolation between summing and averaging gradients.
+
+TPU design: instead of hand-scheduled point-to-point halves, each of the
+log2(N) levels is one ``lax.ppermute`` exchanging the *current combined
+vector* with the XOR partner, followed by local projection math.  XLA
+schedules the permutes over ICI; the butterfly pattern maps onto the torus
+links the same way recursive halving does.  Bandwidth is 2x VHDD's (whole
+vector per level rather than a shrinking half), traded for zero host
+choreography and full compiler visibility; see parallel/hierarchical.py for
+the 2-level composition that keeps DCN traffic to one level.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..basics import DP_AXIS
+
+__all__ = ["adasum_allreduce", "adasum_combine"]
+
+
+def _numpy_adasum_rows(rows):
+    """Host-side recursive adasum of ``rows[i]`` = rank i's flat buffer —
+    the eager engine's reduction kernel (same binary tree as adasum.h:167-299).
+    """
+    import numpy as np
+
+    vecs = [np.asarray(r, np.float64) for r in rows]
+
+    def rec(vs):
+        if len(vs) == 1:
+            return vs[0]
+        half = len(vs) // 2
+        a, b = rec(vs[:half]), rec(vs[half:])
+        dot = float(np.dot(a, b))
+        na2 = max(float(np.dot(a, a)), 1e-30)
+        nb2 = max(float(np.dot(b, b)), 1e-30)
+        return (1.0 - dot / (2 * na2)) * a + (1.0 - dot / (2 * nb2)) * b
+
+    return rec(vecs)
+
+
+def adasum_combine(a, b, dot, na2, nb2, eps=1e-30):
+    """Combine two contributions given their inner products (the math of
+    reference adasum.h:239-263, per-pair scalar coefficients)."""
+    a_coef = 1.0 - dot / (2.0 * jnp.maximum(na2, eps))
+    b_coef = 1.0 - dot / (2.0 * jnp.maximum(nb2, eps))
+    return a_coef * a + b_coef * b
+
+
+def adasum_allreduce(tensor, *, axis_name: str = DP_AXIS):
+    """Adasum-allreduce a pytree across the mesh axis.
+
+    Matches the reference's recursive binary-tree semantics
+    (adasum.h:167-299): level k combines each rank's running result with
+    partner ``rank ^ 2^k``.  Requires a power-of-2 axis size, as the
+    reference's VHDD does (docs/adasum_user_guide.rst; the torch API
+    enforces power-of-2 at horovod/torch/mpi_ops.py:104-119).
+
+    All math runs in fp32 regardless of input dtype (the reference keeps
+    fp16 inputs but accumulates dots in double; bf16 inputs here would lose
+    the projection's precision), casting back at the end.
+    """
+    n = lax.axis_size(axis_name)
+    if n & (n - 1) != 0:
+        raise ValueError(f"Adasum requires a power-of-2 world size, got {n}")
+
+    def one(x):
+        x = jnp.asarray(x)
+        orig_dtype = x.dtype
+        flat = jnp.ravel(x).astype(jnp.float32)
+
+        level = 1
+        while level < n:
+            # Butterfly exchange: every rank swaps its running vector with
+            # rank ^ level in one ppermute (bidirectional on ICI).  Both
+            # sides of a pair compute the identical combined vector because
+            # adasum_combine is symmetric under swapping (a,|a|^2)<->(b,|b|^2).
+            perm = [(r, r ^ level) for r in range(n)]
+            other = lax.ppermute(flat, axis_name, perm)
+            dot = jnp.dot(flat, other)
+            na2 = jnp.dot(flat, flat)
+            nb2 = jnp.dot(other, other)
+            flat = adasum_combine(flat, other, dot, na2, nb2)
+            level <<= 1
+        return flat.reshape(x.shape).astype(orig_dtype)
+
+    import jax
+
+    return jax.tree_util.tree_map(one, tensor)

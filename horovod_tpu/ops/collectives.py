@@ -69,6 +69,32 @@ Min = ReduceOp.MIN
 Max = ReduceOp.MAX
 
 
+def _check_eager_axis(axis_name: str) -> None:
+    """The eager engine always reduces over the whole process world; a
+    non-default axis_name on a concrete array would silently mean something
+    else, so reject it loudly (sub-axis eager collectives belong under
+    shard_map)."""
+    if axis_name != DP_AXIS:
+        raise ValueError(
+            f"axis_name={axis_name!r} is only meaningful under tracing "
+            f"(shard_map/pjit); the eager path always operates over the "
+            f"full process world."
+        )
+
+
+def _is_traced(tensor) -> bool:
+    """True when we're under jit/shard_map tracing — the SPMD path.
+
+    Concrete arrays outside a trace take the eager engine instead, so a
+    single ``hvd.allreduce`` spelling serves both worlds (the reference has
+    one eager spelling; its graph mode is the framework's tracer doing the
+    same dispatch)."""
+    return any(
+        isinstance(leaf, jax.core.Tracer)
+        for leaf in jax.tree_util.tree_leaves(tensor)
+    )
+
+
 def axis_rank(axis_name: str = DP_AXIS):
     """This shard's index along the collective axis (trace-time value)."""
     return lax.axis_index(axis_name)
@@ -119,9 +145,25 @@ def allreduce(
     horovod/common/operations.cc:803).
 
     Works on a single array or an arbitrary pytree (each leaf reduced).
-    ``name`` is accepted for reference-API compatibility; the jit path does
-    not need names (no negotiation), the eager path does.
+    Under tracing this is a psum over ``axis_name``; on concrete arrays it
+    routes through the eager engine (named-tensor negotiation).
     """
+    if not _is_traced(tensor):
+        _check_eager_axis(axis_name)
+        from . import eager  # noqa: PLC0415
+
+        leaves, treedef = jax.tree_util.tree_flatten(tensor)
+        outs = [
+            eager.allreduce(
+                leaf,
+                op,
+                name=(f"{name}.{i}" if name and len(leaves) > 1 else name),
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+            for i, leaf in enumerate(leaves)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, outs)
     del name
     if op == Adasum:
         from .adasum import adasum_allreduce  # noqa: PLC0415
@@ -239,6 +281,13 @@ def allgather(tensor, *, axis_name: str = DP_AXIS, name: Optional[str] = None):
     (controller.cc:453-518) — are served by the eager path, which pads to
     the negotiated max and slices on the host.
     """
+    if not _is_traced(tensor):
+        _check_eager_axis(axis_name)
+        from . import eager  # noqa: PLC0415
+
+        return jax.tree_util.tree_map(
+            lambda x: eager.allgather(x, name=name), tensor
+        )
     del name
     return jax.tree_util.tree_map(
         lambda x: _allgather(jnp.asarray(x), axis_name), tensor
@@ -280,6 +329,13 @@ def broadcast(
     """Broadcast the root shard's value to every shard (reference:
     hvd.broadcast, horovod/torch/mpi_ops.py:330-406; EnqueueTensorBroadcast,
     operations.cc:891)."""
+    if not _is_traced(tensor):
+        _check_eager_axis(axis_name)
+        from . import eager  # noqa: PLC0415
+
+        return jax.tree_util.tree_map(
+            lambda x: eager.broadcast(x, root_rank, name=name), tensor
+        )
     del name
     return jax.tree_util.tree_map(
         lambda x: _broadcast(jnp.asarray(x), root_rank, axis_name), tensor
@@ -301,6 +357,11 @@ def alltoall(tensor, *, axis_name: str = DP_AXIS):
     primitive behind Ulysses-style sequence parallelism).  Not present in
     the reference at 0.19.1 (SURVEY.md §2.9); provided because all-to-all is
     first-class on the ICI torus and later Horovod grew it."""
+    if not _is_traced(tensor):
+        _check_eager_axis(axis_name)
+        from . import eager  # noqa: PLC0415
+
+        return jax.tree_util.tree_map(lambda x: eager.alltoall(x), tensor)
 
     def one(x):
         x = jnp.asarray(x)
@@ -324,6 +385,12 @@ def reducescatter(tensor, op: ReduceOp = Average, *, axis_name: str = DP_AXIS):
     """Sum across shards, keep only this shard's dim-0 slice — the first leg
     of the reference's hierarchical allreduce (nccl_operations.cc:218-229)
     exposed as a user op."""
+    if not _is_traced(tensor):
+        raise NotImplementedError(
+            "reducescatter is jit-path only: call it inside shard_map/pjit "
+            "over a mesh axis (the eager engine serves allreduce/allgather/"
+            "broadcast/alltoall)."
+        )
 
     def one(x):
         x = jnp.asarray(x)

@@ -1,0 +1,75 @@
+"""Gradient compression (reference: horovod/torch/compression.py:20-74 and
+horovod/tensorflow/compression.py — identical shape).
+
+The reference halves allreduce bytes by casting fp32 grads to fp16 before
+the wire and back after.  On TPU the natural wire dtype is **bfloat16**
+(same exponent range as fp32, native MXU/ICI support), so that is the
+default compressor; fp16 is kept for parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["Compressor", "NoneCompressor", "BFloat16Compressor", "FP16Compressor", "Compression"]
+
+
+class Compressor:
+    """Interface (reference compression.py:20-31)."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context-for-decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference compression.py:34-44)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        del ctx
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = jnp.asarray(tensor).dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return jnp.asarray(tensor, cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else jnp.asarray(tensor, ctx)
+
+
+class BFloat16Compressor(_CastCompressor):
+    """Cast floats to bf16 on the wire — the TPU-native halving."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class FP16Compressor(_CastCompressor):
+    """Reference-parity fp16 compressor (compression.py:47-63)."""
+
+    wire_dtype = jnp.float16
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression`` (reference compression.py:66-74)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BFloat16Compressor

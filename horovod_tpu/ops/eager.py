@@ -1,0 +1,159 @@
+"""Eager per-op collective API with async handles.
+
+Reference surface: horovod/torch/mpi_ops.py — ``allreduce[_async][_]``,
+``allgather[_async]``, ``broadcast[_async][_]``, ``poll``, ``synchronize``,
+``join``, ``barrier``.  Handles map to futures resolved by the background
+engine (reference HandleManager, horovod/torch/handle_manager.cc).
+
+Use this path for host-driven, out-of-jit collectives: metric averaging,
+parameter broadcast at startup, ragged allgathers, uneven-data Join.  The
+training hot loop belongs on the jit path (ops/collectives.py) where XLA
+fuses and schedules everything ahead of time.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Optional
+
+import numpy as np
+
+from .._engine_registry import get_engine
+from ..runtime.messages import RequestType
+from .collectives import Average, ReduceOp
+
+__all__ = [
+    "allreduce",
+    "allreduce_",
+    "allreduce_async",
+    "allreduce_async_",
+    "allgather",
+    "allgather_async",
+    "broadcast",
+    "broadcast_",
+    "broadcast_async",
+    "broadcast_async_",
+    "alltoall",
+    "alltoall_async",
+    "synchronize",
+    "poll",
+    "join",
+    "barrier",
+]
+
+_name_counter = 0
+
+
+def _auto_name(prefix: str) -> str:
+    """Reference behavior: unnamed tensors get a sequence name
+    (torch/mpi_ops.py handle naming 'allreduce.noname.N')."""
+    global _name_counter
+    _name_counter += 1
+    return f"{prefix}.noname.{_name_counter}"
+
+
+def _to_host(tensor) -> np.ndarray:
+    # The eager path owns host<->device movement; jax arrays come to the
+    # host once, the engine's data plane puts fused buffers back on device.
+    return np.asarray(tensor)
+
+
+def allreduce_async(
+    tensor,
+    op: ReduceOp = Average,
+    name: Optional[str] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> concurrent.futures.Future:
+    """reference: hvd.allreduce_async (torch/mpi_ops.py:94-129)."""
+    engine = get_engine()
+    rtype = (
+        RequestType.ADASUM if op == ReduceOp.ADASUM else RequestType.ALLREDUCE
+    )
+    return engine.enqueue(
+        rtype,
+        name or _auto_name("allreduce"),
+        _to_host(tensor),
+        reduce_op=int(op),
+        prescale=prescale_factor,
+        postscale=postscale_factor,
+    )
+
+
+def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None, **kw):
+    """Blocking allreduce (reference torch/mpi_ops.py:131-155)."""
+    return synchronize(allreduce_async(tensor, op, name, **kw))
+
+
+# In-place spellings: JAX arrays are immutable, so these return the result;
+# they exist so reference call sites port one-to-one.
+allreduce_async_ = allreduce_async
+allreduce_ = allreduce
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> concurrent.futures.Future:
+    """reference: hvd.allgather_async (torch/mpi_ops.py:231-260).  Ragged
+    dim-0 across ranks is supported — sizes are negotiated (controller
+    Response::tensor_sizes)."""
+    return get_engine().enqueue(
+        RequestType.ALLGATHER, name or _auto_name("allgather"), _to_host(tensor)
+    )
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(
+    tensor, root_rank: int, name: Optional[str] = None
+) -> concurrent.futures.Future:
+    """reference: hvd.broadcast_async (torch/mpi_ops.py:330-360)."""
+    return get_engine().enqueue(
+        RequestType.BROADCAST,
+        name or _auto_name("broadcast"),
+        _to_host(tensor),
+        root_rank=root_rank,
+    )
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+broadcast_async_ = broadcast_async
+broadcast_ = broadcast
+
+
+def alltoall_async(tensor, name: Optional[str] = None) -> concurrent.futures.Future:
+    return get_engine().enqueue(
+        RequestType.ALLTOALL, name or _auto_name("alltoall"), _to_host(tensor)
+    )
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    return synchronize(alltoall_async(tensor, name))
+
+
+def poll(handle: concurrent.futures.Future) -> bool:
+    """True if the op has completed (reference torch/mpi_ops.py:458-472)."""
+    return handle.done()
+
+
+def synchronize(handle: concurrent.futures.Future):
+    """Block until completion and return the result (reference
+    torch/mpi_ops.py:475-491; raises the negotiated error on mismatch,
+    like the reference's ErrorOp -> exception path)."""
+    return handle.result()
+
+
+def join() -> int:
+    """Block until every rank has joined (reference hvd.join,
+    torch/mpi_ops.py:494-508; semantics at controller.cc:263-307).  While
+    blocked, this rank participates in peers' collectives with zero
+    tensors.  Returns the last rank to join (best-effort)."""
+    return get_engine().join().result()
+
+
+def barrier() -> None:
+    """All-rank barrier on the eager engine."""
+    get_engine().barrier().result()

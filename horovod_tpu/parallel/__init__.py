@@ -1,0 +1,5 @@
+"""Parallelism utilities: hierarchical (2-level) collectives over the
+cross x local mesh, cross-replica batch norm, and sharding helpers."""
+
+from .hierarchical import hierarchical_allreduce  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401

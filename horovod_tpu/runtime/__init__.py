@@ -1,0 +1,7 @@
+"""The eager-path runtime: background engine, negotiation protocol,
+messages, timeline, stall inspection.
+
+This is the TPU re-design of the reference's core runtime
+(horovod/common/: operations.cc background loop, controller.cc negotiation,
+tensor_queue.cc, fusion_buffer_manager.cc).  See runtime/engine.py for the
+architecture notes."""

@@ -1,0 +1,302 @@
+"""Negotiation logic for the eager path.
+
+Reference: horovod/common/controller.cc — the rank-0 coordinator receives
+every rank's ready-tensor Requests, counts per-name readiness
+(IncrementTensorCount, controller.cc:789-812), validates consistency and
+builds Responses (ConstructResponse, controller.cc:378-611), fuses them
+(FuseResponses, controller.cc:640-761), and broadcasts the ResponseList.
+
+TPU redesign: the transport is a symmetric allgather (every rank sees every
+rank's RequestList), so **every rank runs the identical, deterministic
+controller function** below and arrives at the same ResponseList without a
+coordinator broadcast leg.  This halves the control-plane round-trips
+(gather+bcast -> one allgather) and removes the rank-0 special case; the
+reference already relies on response construction being deterministic, we
+just exploit it symmetrically.
+
+The controller state (message table, joined set) persists across cycles in
+ControllerState; readiness spans cycles exactly as in the reference (a
+tensor submitted by rank 0 in cycle k and rank 1 in cycle k+3 completes in
+cycle k+3).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .messages import Request, RequestList, RequestType, Response, ResponseType
+
+LOG = get_logger("controller")
+
+
+@dataclass
+class _TableEntry:
+    """Per-name readiness record (reference MessageTable, controller.h:33)."""
+
+    requests: Dict[int, Request] = field(default_factory=dict)
+    first_seen: float = field(default_factory=time.monotonic)
+    arrival_order: int = 0
+
+
+@dataclass
+class ControllerState:
+    world_size: int
+    message_table: Dict[Tuple, _TableEntry] = field(default_factory=dict)
+    joined_ranks: Set[int] = field(default_factory=set)
+    shutdown_ranks: Set[int] = field(default_factory=set)
+    arrival_counter: int = 0
+    # stall bookkeeping (reference stall_inspector.cc)
+    last_stall_check: float = field(default_factory=time.monotonic)
+
+
+def _validate(requests: Dict[int, Request]) -> Optional[str]:
+    """Consistency checks the reference performs in ConstructResponse
+    (controller.cc:378-611): matching dtype, op params, shapes (allreduce:
+    identical; allgather: identical all-but-dim0; broadcast: identical +
+    same root)."""
+    reqs = list(requests.values())
+    first = reqs[0]
+    if first.request_type == RequestType.ALLGATHER and len(first.shape) == 0:
+        return (
+            f"Allgather of {first.tensor_name} requires at least a "
+            f"1-dimensional tensor (got a scalar)."
+        )
+    for r in reqs[1:]:
+        if r.dtype != first.dtype:
+            return (
+                f"Mismatched data types for {first.tensor_name}: "
+                f"rank {first.request_rank} sent {first.dtype}, "
+                f"rank {r.request_rank} sent {r.dtype}."
+            )
+        if r.request_type != first.request_type:
+            return (
+                f"Mismatched collective operations for {first.tensor_name}."
+            )
+        if (
+            r.reduce_op != first.reduce_op
+            or r.prescale_factor != first.prescale_factor
+            or r.postscale_factor != first.postscale_factor
+        ):
+            return f"Mismatched reduce options for {first.tensor_name}."
+        if first.request_type in (RequestType.ALLREDUCE, RequestType.ADASUM,
+                                  RequestType.BROADCAST, RequestType.ALLTOALL):
+            if tuple(r.shape) != tuple(first.shape):
+                return (
+                    f"Mismatched shapes for {first.tensor_name}: "
+                    f"{tuple(first.shape)} vs {tuple(r.shape)}."
+                )
+        elif first.request_type == RequestType.ALLGATHER:
+            if len(r.shape) == 0:
+                return (
+                    f"Allgather of {first.tensor_name} requires at least a "
+                    f"1-dimensional tensor (got a scalar)."
+                )
+            if tuple(r.shape[1:]) != tuple(first.shape[1:]):
+                return (
+                    f"Mismatched allgather shapes beyond dim 0 for "
+                    f"{first.tensor_name}."
+                )
+        if first.request_type == RequestType.BROADCAST:
+            if r.root_rank != first.root_rank:
+                return (
+                    f"Mismatched root ranks for broadcast {first.tensor_name}:"
+                    f" {first.root_rank} vs {r.root_rank}."
+                )
+    return None
+
+
+def compute_responses(
+    state: ControllerState,
+    all_lists: List[RequestList],
+    *,
+    fusion_threshold_bytes: int,
+    stall_warning_secs: float = 60.0,
+    stall_shutdown_secs: float = 0.0,
+    timeline=None,
+) -> Tuple[List[Response], bool]:
+    """One negotiation cycle: merge every rank's RequestList into the
+    message table, emit ready Responses (fused), handle join/shutdown.
+
+    Returns (responses, should_shutdown).  Deterministic: all ranks call
+    with identical inputs and must produce identical outputs — this is the
+    invariant the whole eager path rests on (the reference gets it by
+    construction from the rank-0 broadcast; we get it from determinism).
+    """
+    # Absorb joins & shutdowns first (reference controller.cc:219-221,256-259).
+    for rank, rlist in enumerate(all_lists):
+        if rlist.shutdown:
+            state.shutdown_ranks.add(rank)
+        if rlist.joined:
+            state.joined_ranks.add(rank)
+
+    for rlist in all_lists:
+        for req in rlist.requests:
+            if req.request_type == RequestType.JOIN:
+                continue  # join is carried by the flag; request is a marker
+            entry = state.message_table.get(req.key())
+            if entry is None:
+                entry = _TableEntry(arrival_order=state.arrival_counter)
+                state.arrival_counter += 1
+                state.message_table[req.key()] = entry
+                if timeline is not None:
+                    timeline.negotiate_start(
+                        req.tensor_name, req.request_type.name
+                    )
+            if timeline is not None:
+                timeline.negotiate_rank_ready(req.tensor_name, req.request_rank)
+            entry.requests[req.request_rank] = req
+
+    needed = state.world_size - len(state.joined_ranks)
+    ready: List[Tuple[Tuple, _TableEntry]] = [
+        (key, e)
+        for key, e in state.message_table.items()
+        if len(e.requests) >= needed
+    ]
+    # Deterministic order: completion order isn't globally defined, so order
+    # by first-arrival counter (identical on all ranks since inputs are).
+    ready.sort(key=lambda kv: kv[1].arrival_order)
+
+    responses: List[Response] = []
+    for key, entry in ready:
+        del state.message_table[key]
+        name, rtype = key
+        err = _validate(entry.requests)
+        if timeline is not None:
+            timeline.negotiate_end(name, rtype.name)
+        if err is not None:
+            responses.append(
+                Response(ResponseType.ERROR, [name], error_message=err)
+            )
+            continue
+        first = next(iter(entry.requests.values()))
+        if rtype == RequestType.ALLGATHER:
+            sizes = [
+                entry.requests[r].shape[0] if r in entry.requests else 0
+                for r in range(state.world_size)
+            ]
+            resp = Response(ResponseType.ALLGATHER, [name], tensor_sizes=sizes)
+            resp._shapes = [tuple(first.shape)]  # type: ignore[attr-defined]
+            resp._dtype = first.dtype  # type: ignore[attr-defined]
+            responses.append(resp)
+        else:
+            resp = Response(ResponseType(int(rtype)), [name])
+            # Negotiated shape/dtype so joined ranks can contribute zeros
+            # of the right geometry (reference tensor_queue.h:39-41).
+            resp._shapes = [tuple(first.shape)]  # type: ignore[attr-defined]
+            resp._dtype = first.dtype  # type: ignore[attr-defined]
+            resp._root_rank = first.root_rank  # type: ignore[attr-defined]
+            if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
+                # Fusion identity + byte size (reference keeps dtype
+                # homogeneous per fusion, controller.cc:676-689).
+                resp._fuse_meta = (  # type: ignore[attr-defined]
+                    first.dtype,
+                    first.reduce_op,
+                    first.prescale_factor,
+                    first.postscale_factor,
+                )
+                try:
+                    itemsize = np.dtype(first.dtype).itemsize
+                except TypeError:
+                    itemsize = 4  # bfloat16 etc. — not a numpy dtype name
+                resp._nbytes = (  # type: ignore[attr-defined]
+                    int(np.prod(first.shape)) * itemsize if first.shape else itemsize
+                )
+            responses.append(resp)
+
+    responses = _fuse(responses, state, fusion_threshold_bytes)
+
+    # Join completion: every rank joined -> JOIN response resets the state
+    # (reference controller.cc:300-307).
+    if len(state.joined_ranks) == state.world_size and state.world_size > 0:
+        responses.append(Response(ResponseType.JOIN, ["join"]))
+        state.joined_ranks.clear()
+
+    _check_stalls(state, stall_warning_secs, stall_shutdown_secs)
+
+    should_shutdown = len(state.shutdown_ranks) > 0
+    return responses, should_shutdown
+
+
+def _fuse(
+    responses: List[Response],
+    state: ControllerState,
+    threshold: int,
+) -> List[Response]:
+    """Fuse adjacent same-type ALLREDUCE responses (reference FuseResponses,
+    controller.cc:640-761, incl. the same-dtype constraint :676-689).
+    Fusion metadata (dtype/size) rides on the per-rank entries at execution
+    time, so here we only group names; the engine concats buffers."""
+    del state
+    fused: List[Response] = []
+    pending: Optional[Response] = None
+    pending_meta: Optional[Tuple] = None
+    pending_bytes = 0
+
+    def flush():
+        nonlocal pending, pending_bytes, pending_meta
+        if pending is not None:
+            fused.append(pending)
+        pending, pending_bytes, pending_meta = None, 0, None
+
+    for resp in responses:
+        if resp.response_type != ResponseType.ALLREDUCE:
+            flush()
+            fused.append(resp)
+            continue
+        meta = getattr(resp, "_fuse_meta", None)
+        nbytes = getattr(resp, "_nbytes", 0)
+        if (
+            pending is None
+            or pending_meta != meta
+            or pending_bytes + nbytes > threshold
+        ):
+            flush()
+            pending = resp
+            pending_meta = meta
+            pending_bytes = nbytes
+        else:
+            pending.tensor_names.extend(resp.tensor_names)
+            pending._shapes.extend(  # type: ignore[attr-defined]
+                resp._shapes  # type: ignore[attr-defined]
+            )
+            pending_bytes += nbytes
+    flush()
+    return fused
+
+
+def _check_stalls(
+    state: ControllerState, warn_secs: float, shutdown_secs: float
+) -> None:
+    """Reference stall_inspector.cc: warn when some ranks have submitted a
+    tensor and others haven't for > warn_secs; optionally escalate."""
+    now = time.monotonic()
+    if now - state.last_stall_check < min(warn_secs, 10.0):
+        return
+    state.last_stall_check = now
+    for (name, _), entry in state.message_table.items():
+        age = now - entry.first_seen
+        if age > warn_secs:
+            missing = sorted(
+                set(range(state.world_size))
+                - set(entry.requests)
+                - state.joined_ranks
+            )
+            LOG.warning(
+                "One or more tensors were submitted to be reduced/gathered "
+                "but some ranks have not yet done so after %.0f s: tensor "
+                "%s is waiting on ranks %s",
+                age,
+                name,
+                missing,
+            )
+            if shutdown_secs > 0 and age > shutdown_secs:
+                raise RuntimeError(
+                    f"Stalled tensor {name} exceeded shutdown threshold "
+                    f"({shutdown_secs}s); aborting (reference "
+                    f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS behavior)."
+                )

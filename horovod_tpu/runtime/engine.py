@@ -1,0 +1,474 @@
+"""The eager-path background engine.
+
+Reference architecture (horovod/common/operations.cc): one background
+thread per process owns all communication; framework threads enqueue
+named TensorTableEntries and get async handles; the thread runs a ~5 ms
+cycle of [negotiate -> execute fused responses -> fire callbacks]
+(RunLoopOnce, operations.cc:550; PerformOperation, operations.cc:232).
+
+TPU redesign decisions:
+
+* **Single-process worlds skip the thread entirely** — collectives over a
+  world of one are identity transforms (the reference executes them
+  through the full machinery; we resolve the future at enqueue, which makes
+  the eager API free in the common single-host case).
+* **Negotiation transport** is an allgather of serialized RequestLists over
+  the JAX coordination service (two-phase: fixed-size length gather, padded
+  payload gather) — the descendant of MPIController's
+  MPI_Gatherv/MPI_Bcast legs (mpi_controller.cc:107-199), but symmetric:
+  every rank runs the deterministic controller (see controller.py).
+* **Data transport** executes each fused response as a device computation
+  over a process-spanning mesh (allgather-based v1; the engine is the seam
+  where a native/C++ transport slots in).
+* Shutdown is coordinated through the negotiation itself (any rank's flag
+  ends the job for everyone, reference controller.cc:256-259,309): cycles
+  are collective, so a rank that stopped cycling unilaterally would
+  deadlock its peers — the flag makes every loop exit on the same cycle.
+"""
+
+from __future__ import annotations
+
+import atexit
+import concurrent.futures
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basics import global_topology
+from ..utils import env as envmod
+from ..utils.logging import get_logger
+from . import timeline as timeline_mod
+from .controller import ControllerState, compute_responses
+from .messages import Request, RequestList, RequestType, Response, ResponseType
+
+LOG = get_logger("engine")
+
+# Reference defaults: fusion 64 MB (operations.cc:419), cycle 5 ms
+# (operations.cc:427).  The python control plane pays ~1 ms per coordination
+# allgather, so the multi-process default cycle is a touch longer.
+DEFAULT_FUSION_BYTES = 64 * 1024 * 1024
+DEFAULT_CYCLE_MS_SINGLE = 1.0
+DEFAULT_CYCLE_MS_MULTI = 10.0
+
+SHUT_DOWN_ERROR = (
+    "horovod_tpu has been shut down. This was caused by an exception on one "
+    "of the ranks or an asymmetric shutdown; check the logs of other ranks."
+    "  (reference: common.h:154-159)"
+)
+DUPLICATE_NAME_ERROR = (
+    "Requested to {op} a tensor with the same name as another tensor that is "
+    "currently being processed.  (reference: common.h:161-164)"
+)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype-string -> numpy dtype, tolerating ml_dtypes names (bfloat16)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: PLC0415
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass
+class TensorTableEntry:
+    """reference common.h:233-250."""
+
+    request: Request
+    tensor: Optional[np.ndarray]
+    future: concurrent.futures.Future = field(
+        default_factory=concurrent.futures.Future
+    )
+
+
+class EagerEngine:
+    """Owns the background thread, tensor table, controller state."""
+
+    def __init__(self):
+        topo = global_topology()
+        self.rank = topo.process_rank
+        self.world = topo.process_count
+        self.fusion_bytes = envmod.env_int(
+            envmod.FUSION_THRESHOLD, DEFAULT_FUSION_BYTES
+        )
+        default_cycle = (
+            DEFAULT_CYCLE_MS_SINGLE if self.world == 1 else DEFAULT_CYCLE_MS_MULTI
+        )
+        self.cycle_s = (
+            envmod.env_float(envmod.CYCLE_TIME, default_cycle) / 1000.0
+        )
+        self.stall_warn = envmod.env_float(envmod.STALL_CHECK_TIME, 60.0)
+        self.stall_shutdown = envmod.env_float(envmod.STALL_SHUTDOWN_TIME, 0.0)
+        if envmod.env_bool(envmod.STALL_CHECK_DISABLE):
+            self.stall_warn = float("inf")
+        self.timeline = timeline_mod.from_env(self.rank)
+
+        self._lock = threading.Lock()
+        self._table: Dict[str, TensorTableEntry] = {}
+        self._pending: List[Request] = []
+        self._joined = False
+        self._join_future: Optional[concurrent.futures.Future] = None
+        self._shutdown_requested = False
+        self._done = False
+        self._controller = ControllerState(world_size=self.world)
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ API
+
+    @classmethod
+    def start(cls) -> "EagerEngine":
+        eng = cls()
+        if eng.world > 1:
+            eng._thread = threading.Thread(
+                target=eng._loop, name="hvdtpu_background", daemon=True
+            )
+            eng._thread.start()
+            atexit.register(eng.shutdown)
+        return eng
+
+    def enqueue(
+        self,
+        op: RequestType,
+        name: str,
+        tensor: Optional[np.ndarray],
+        *,
+        reduce_op: int = 0,
+        root_rank: int = -1,
+        prescale: float = 1.0,
+        postscale: float = 1.0,
+    ) -> concurrent.futures.Future:
+        """reference EnqueueTensorAllreduce/... operations.cc:803-954."""
+        shape = tuple(tensor.shape) if tensor is not None else ()
+        dtype = str(tensor.dtype) if tensor is not None else "float32"
+        req = Request(
+            request_rank=self.rank,
+            request_type=op,
+            tensor_name=name,
+            dtype=dtype,
+            shape=shape,
+            reduce_op=reduce_op,
+            root_rank=root_rank,
+            prescale_factor=prescale,
+            postscale_factor=postscale,
+        )
+        entry = TensorTableEntry(request=req, tensor=tensor)
+        if self.world == 1:
+            self._execute_local(entry)
+            return entry.future
+        with self._lock:
+            if self._done:
+                entry.future.set_exception(RuntimeError(SHUT_DOWN_ERROR))
+                return entry.future
+            if name in self._table:
+                entry.future.set_exception(
+                    ValueError(DUPLICATE_NAME_ERROR.format(op=op.name.lower()))
+                )
+                return entry.future
+            self._table[name] = entry
+            self._pending.append(req)
+        return entry.future
+
+    def join(self) -> concurrent.futures.Future:
+        """reference EnqueueJoin (operations.cc:930) + §3.5 semantics:
+        mark this rank joined; pending peers' collectives proceed with this
+        rank contributing zeros; resolves when every rank has joined."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self.world == 1:
+            fut.set_result(0)
+            return fut
+        with self._lock:
+            self._joined = True
+            self._join_future = fut
+        return fut
+
+    def barrier(self) -> concurrent.futures.Future:
+        return self.enqueue(RequestType.BARRIER, "hvdtpu.barrier", None)
+
+    def shutdown(self) -> None:
+        """Coordinated shutdown, reference semantics: ANY rank's shutdown
+        flag propagates through the negotiation and tears the whole job
+        down; peers' outstanding entries fail with SHUT_DOWN_ERROR
+        (reference controller.cc:256-259,309 + operations.cc:526-532).
+        The flag rides the next cycle so every rank exits its loop in the
+        same cycle — no rank stops cycling unilaterally."""
+        if self.world == 1:
+            self._done = True
+            return
+        with self._lock:
+            if self._done:
+                return
+            self._shutdown_requested = True
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=30)
+        self.timeline.shutdown()
+
+    # ------------------------------------------------------ background loop
+
+    def _loop(self) -> None:
+        while True:
+            start = time.monotonic()
+            try:
+                again = self._run_loop_once()
+            except Exception as exc:  # transport/controller failure
+                LOG.error("background loop error: %s", exc)
+                self._fail_all(exc)
+                return
+            if not again:
+                break
+            elapsed = time.monotonic() - start
+            if elapsed < self.cycle_s:
+                time.sleep(self.cycle_s - elapsed)
+        self._fail_all(RuntimeError(SHUT_DOWN_ERROR))
+        self._done = True
+
+    def _run_loop_once(self) -> bool:
+        """One cycle (reference RunLoopOnce, operations.cc:550)."""
+        self.timeline.mark_cycle()
+        with self._lock:
+            requests = list(self._pending)
+            self._pending.clear()
+            rlist = RequestList(
+                requests=requests,
+                shutdown=self._shutdown_requested,
+                joined=self._joined,
+            )
+        all_lists = self._negotiate(rlist)
+        responses, should_shutdown = compute_responses(
+            self._controller,
+            all_lists,
+            fusion_threshold_bytes=self.fusion_bytes,
+            stall_warning_secs=self.stall_warn,
+            stall_shutdown_secs=self.stall_shutdown,
+            timeline=self.timeline,
+        )
+        for resp in responses:
+            self._perform_operation(resp)
+        return not should_shutdown
+
+    # ---------------------------------------------------------- negotiation
+
+    def _negotiate(self, rlist: RequestList) -> List[RequestList]:
+        """Allgather every rank's RequestList (two-phase, fixed-shape)."""
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        payload = rlist.serialize()
+        lengths = multihost_utils.process_allgather(
+            np.asarray([len(payload)], np.int32)
+        ).reshape(-1)
+        max_len = int(lengths.max())
+        buf = np.zeros(max_len, np.uint8)
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+        gathered = multihost_utils.process_allgather(buf)
+        gathered = np.asarray(gathered).reshape(self.world, max_len)
+        return [
+            RequestList.deserialize(
+                gathered[r, : int(lengths[r])].tobytes()
+            )
+            for r in range(self.world)
+        ]
+
+    # ------------------------------------------------------------ execution
+
+    def _perform_operation(self, resp: Response) -> None:
+        """reference PerformOperation (operations.cc:232-309)."""
+        if resp.response_type == ResponseType.JOIN:
+            with self._lock:
+                fut, self._join_future = self._join_future, None
+                self._joined = False
+            if fut is not None:
+                fut.set_result(self.world - 1)
+            return
+
+        entries: List[Optional[TensorTableEntry]] = []
+        with self._lock:
+            for name in resp.tensor_names:
+                entries.append(self._table.pop(name, None))
+
+        if resp.response_type == ResponseType.ERROR:
+            for e in entries:
+                if e is not None:
+                    e.future.set_exception(RuntimeError(resp.error_message))
+            return
+
+        try:
+            names = ",".join(resp.tensor_names)
+            self.timeline.start(names, resp.response_type.name)
+            if resp.response_type in (
+                ResponseType.ALLREDUCE,
+                ResponseType.ADASUM,
+            ):
+                self._execute_allreduce(resp, entries)
+            elif resp.response_type == ResponseType.ALLGATHER:
+                self._execute_allgather(resp, entries)
+            elif resp.response_type == ResponseType.BROADCAST:
+                self._execute_broadcast(resp, entries)
+            elif resp.response_type == ResponseType.ALLTOALL:
+                self._execute_alltoall(resp, entries)
+            elif resp.response_type == ResponseType.BARRIER:
+                e = entries[0]
+                if e is not None:
+                    e.future.set_result(None)
+            self.timeline.end(names, resp.response_type.name)
+        except Exception as exc:
+            for e in entries:
+                if e is not None and not e.future.done():
+                    e.future.set_exception(exc)
+
+    # A joined rank has no entry for a tensor its peers are reducing: it
+    # participates with zeros of the negotiated shape (reference
+    # tensor_queue.h:39-41 zero-tensor substitution).
+
+    def _data_allgather(self, local: np.ndarray) -> np.ndarray:
+        """Data-plane allgather over processes -> (world, *local.shape)."""
+        from jax.experimental import multihost_utils  # noqa: PLC0415
+
+        out = multihost_utils.process_allgather(local)
+        return np.asarray(out).reshape((self.world,) + tuple(local.shape))
+
+    def _execute_allreduce(self, resp: Response, entries) -> None:
+        meta = getattr(resp, "_fuse_meta", None)
+        shapes = getattr(resp, "_shapes", [()] * len(resp.tensor_names))
+        # Fused buffer: concat all entries (MemcpyInFusionBuffer analog,
+        # collective_operations.cc:159-210).  A joined rank has no entry for
+        # a tensor its peers are reducing and contributes zeros of the
+        # negotiated shape (reference tensor_queue.h:39-41).
+        flats = []
+        for e, shape in zip(entries, shapes):
+            if e is not None and e.tensor is not None:
+                flats.append(np.ravel(np.asarray(e.tensor, np.float64)))
+            else:
+                flats.append(np.zeros(int(np.prod(shape)) if shape else 1))
+        buf = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        dtype, reduce_op, pre, post = meta if meta else ("float32", 1, 1.0, 1.0)
+        if pre != 1.0:
+            buf = buf * pre
+        gathered = self._data_allgather(buf.astype(np.float64))
+        from ..ops.collectives import ReduceOp  # noqa: PLC0415
+
+        if reduce_op == int(ReduceOp.ADASUM):
+            from ..ops.adasum import _numpy_adasum_rows  # noqa: PLC0415
+
+            total = _numpy_adasum_rows(gathered)
+        elif reduce_op == int(ReduceOp.MIN):
+            total = gathered.min(axis=0)
+        elif reduce_op == int(ReduceOp.MAX):
+            total = gathered.max(axis=0)
+        else:
+            total = gathered.sum(axis=0)
+            if reduce_op == int(ReduceOp.AVERAGE):
+                total = total / self.world
+        if post != 1.0:
+            total = total * post
+        offset = 0
+        for e, shape in zip(entries, shapes):
+            n = int(np.prod(shape)) if shape else 1
+            if e is not None:
+                out = total[offset : offset + n].reshape(shape)
+                e.future.set_result(out.astype(e.tensor.dtype))
+            offset += n
+
+    def _execute_allgather(self, resp: Response, entries) -> None:
+        e = entries[0]
+        sizes = resp.tensor_sizes
+        max_d0 = max(sizes) if sizes else 0
+        if e is None or e.tensor is None:
+            # joined rank: participate with an all-pad buffer (its size
+            # was negotiated as 0, so no rows of it survive the slicing)
+            tail = tuple(getattr(resp, "_shapes", [(0,)])[0][1:])
+            local = np.zeros((0,) + tail, _np_dtype(getattr(resp, "_dtype", "float32")))
+        else:
+            local = np.asarray(e.tensor)
+        # Ragged: pad dim0 to the negotiated max (reference negotiates
+        # per-rank sizes in Response::tensor_sizes, controller.cc:453-518;
+        # XLA wants static shapes, so pad-and-slice).
+        pad = max_d0 - local.shape[0]
+        if pad:
+            local = np.concatenate(
+                [local, np.zeros((pad,) + local.shape[1:], local.dtype)]
+            )
+        gathered = self._data_allgather(local)
+        if e is None:
+            return
+        pieces = [gathered[r, : sizes[r]] for r in range(self.world)]
+        e.future.set_result(np.concatenate(pieces, axis=0))
+
+    def _execute_broadcast(self, resp: Response, entries) -> None:
+        e = entries[0]
+        if e is None or e.tensor is None:
+            shape = tuple(getattr(resp, "_shapes", [()])[0])
+            local = np.zeros(shape, _np_dtype(getattr(resp, "_dtype", "float32")))
+            self._data_allgather(local)  # participate; result unused
+            return
+        gathered = self._data_allgather(np.asarray(e.tensor))
+        e.future.set_result(gathered[e.request.root_rank])
+
+    def _execute_alltoall(self, resp: Response, entries) -> None:
+        e = entries[0]
+        if e is None or e.tensor is None:
+            shape = tuple(getattr(resp, "_shapes", [()])[0])
+            local = np.zeros(shape, _np_dtype(getattr(resp, "_dtype", "float32")))
+            self._data_allgather(local)
+            return
+        local = np.asarray(e.tensor)
+        if local.shape[0] % self.world:
+            raise ValueError(
+                f"alltoall dim0 ({local.shape[0]}) must divide world size "
+                f"({self.world})"
+            )
+        gathered = self._data_allgather(local)
+        k = local.shape[0] // self.world
+        mine = np.concatenate(
+            [gathered[r, self.rank * k : (self.rank + 1) * k] for r in range(self.world)],
+            axis=0,
+        )
+        e.future.set_result(mine)
+
+    # -------------------------------------------------------- single process
+
+    def _execute_local(self, entry: TensorTableEntry) -> None:
+        """world==1: collectives are identities (with scaling applied)."""
+        req = entry.request
+        t = entry.tensor
+        if req.request_type in (RequestType.ALLREDUCE, RequestType.ADASUM):
+            out = np.asarray(t)
+            scale = req.prescale_factor * req.postscale_factor
+            if scale != 1.0:
+                out = out * scale
+            entry.future.set_result(out)
+        elif req.request_type in (
+            RequestType.ALLGATHER,
+            RequestType.ALLTOALL,
+        ):
+            entry.future.set_result(np.asarray(t))
+        elif req.request_type == RequestType.BROADCAST:
+            if req.root_rank not in (0, -1):
+                entry.future.set_exception(
+                    ValueError(
+                        f"broadcast root_rank {req.root_rank} out of range "
+                        f"for world size 1"
+                    )
+                )
+            else:
+                entry.future.set_result(np.asarray(t))
+        elif req.request_type == RequestType.BARRIER:
+            entry.future.set_result(None)
+        else:
+            entry.future.set_result(None)
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._lock:
+            entries = list(self._table.values())
+            self._table.clear()
+            self._done = True
+            jf, self._join_future = self._join_future, None
+        for e in entries:
+            if not e.future.done():
+                e.future.set_exception(exc)
+        if jf is not None and not jf.done():
+            jf.set_exception(exc)

@@ -1,0 +1,126 @@
+"""Chrome-tracing timeline for the eager path.
+
+Reference: horovod/common/timeline.cc (311 LoC) — rank 0 writes a Chrome
+trace-event JSON; a dedicated writer thread drains a lock-free queue so the
+hot loop never blocks on file IO; per-tensor state machine NEGOTIATING ->
+TOP_LEVEL -> ACTIVITY (timeline.h:77).
+
+Same design here: events go into a queue.SimpleQueue (single producer =
+engine thread, single consumer = writer thread), the writer streams JSON
+incrementally.  Device-level timing belongs to the XLA profiler
+(jax.profiler.trace) and is deliberately not duplicated — this timeline
+covers the host-side negotiation/queue phases the XLA profiler can't see
+(SURVEY.md §5.1).
+
+Enable with HVDTPU_TIMELINE=/path/trace.json (reference: HOROVOD_TIMELINE,
+operations.cc:403-411); cycle markers via HVDTPU_TIMELINE_MARK_CYCLES.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Activity names mirror reference common.h:31-59.
+NEGOTIATE = "NEGOTIATE"
+QUEUE = "QUEUE"
+EXECUTE = "EXECUTE"
+CYCLE = "CYCLE"
+
+
+class Timeline:
+    """Facade; no-ops unless enabled (so the engine can call it
+    unconditionally, as the reference does via Initialized() checks)."""
+
+    def __init__(self, path: Optional[str], rank: int, mark_cycles: bool = False):
+        self._enabled = bool(path) and rank == 0
+        self._mark_cycles = mark_cycles
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._writer: Optional[threading.Thread] = None
+        self._start = time.perf_counter()
+        if self._enabled:
+            self._path = path
+            self._writer = threading.Thread(
+                target=self._write_loop, name="hvdtpu_timeline", daemon=True
+            )
+            self._writer.start()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def _ts(self) -> float:
+        return (time.perf_counter() - self._start) * 1e6  # us
+
+    def _emit(self, ph: str, name: str, cat: str, tid: str = "ops", **extra):
+        if self._enabled:
+            self._queue.put(
+                {"ph": ph, "name": name, "cat": cat, "pid": 0, "tid": tid,
+                 "ts": self._ts(), **extra}
+            )
+
+    # -- per-tensor state machine (reference timeline.h:77-126) ------------
+    def negotiate_start(self, tensor_name: str, op: str):
+        self._emit("B", f"{NEGOTIATE}_{op}", "negotiate", tid=tensor_name)
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int):
+        self._emit(
+            "i", f"rank_{rank}_ready", "negotiate", tid=tensor_name, s="t"
+        )
+
+    def negotiate_end(self, tensor_name: str, op: str):
+        self._emit("E", f"{NEGOTIATE}_{op}", "negotiate", tid=tensor_name)
+
+    def start(self, tensor_name: str, op: str):
+        self._emit("B", op, "op", tid=tensor_name)
+
+    def activity_start(self, tensor_name: str, activity: str):
+        self._emit("B", activity, "activity", tid=tensor_name)
+
+    def activity_end(self, tensor_name: str):
+        self._emit("E", "", "activity", tid=tensor_name)
+
+    def end(self, tensor_name: str, op: str):
+        self._emit("E", op, "op", tid=tensor_name)
+
+    def mark_cycle(self):
+        if self._mark_cycles:
+            self._emit("i", "CYCLE_START", "cycle", s="g")
+
+    # -- writer ------------------------------------------------------------
+    def _write_loop(self):
+        with open(self._path, "w") as f:
+            f.write("[\n")
+            first = True
+            while True:
+                try:
+                    ev = self._queue.get(timeout=0.5)
+                except queue.Empty:
+                    f.flush()
+                    continue
+                if ev is None:
+                    break
+                if not first:
+                    f.write(",\n")
+                f.write(json.dumps(ev))
+                first = False
+            f.write("\n]\n")
+
+    def shutdown(self):
+        if self._enabled:
+            self._queue.put(None)
+            self._writer.join(timeout=5)
+            self._enabled = False
+
+
+def from_env(rank: int) -> Timeline:
+    return Timeline(
+        os.environ.get("HVDTPU_TIMELINE"),
+        rank,
+        mark_cycles=os.environ.get("HVDTPU_TIMELINE_MARK_CYCLES", "0")
+        in ("1", "true"),
+    )

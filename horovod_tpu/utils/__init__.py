@@ -1,0 +1,4 @@
+"""Shared utilities: logging, env parsing."""
+
+from .logging import get_logger, log  # noqa: F401
+from .env import env_bool, env_float, env_int  # noqa: F401

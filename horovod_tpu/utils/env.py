@@ -1,0 +1,41 @@
+"""Env knob parsing (reference: horovod/common/utils/env_parser.cc and the
+canonical knob list at common.h:62-88).
+
+All runtime configuration converges on environment variables, exactly as in
+the reference (SURVEY.md §5.6): the launcher maps CLI flags onto env vars
+for every rank; the engine reads them at startup."""
+
+from __future__ import annotations
+
+import os
+
+# Canonical knob names (HVDTPU_* ≙ HOROVOD_* of common.h:62-88).
+FUSION_THRESHOLD = "HVDTPU_FUSION_THRESHOLD"
+CYCLE_TIME = "HVDTPU_CYCLE_TIME"
+TIMELINE = "HVDTPU_TIMELINE"
+TIMELINE_MARK_CYCLES = "HVDTPU_TIMELINE_MARK_CYCLES"
+STALL_CHECK_TIME = "HVDTPU_STALL_CHECK_TIME_SECONDS"
+STALL_SHUTDOWN_TIME = "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS"
+STALL_CHECK_DISABLE = "HVDTPU_STALL_CHECK_DISABLE"
+CACHE_CAPACITY = "HVDTPU_CACHE_CAPACITY"
+HIERARCHICAL_ALLREDUCE = "HVDTPU_HIERARCHICAL_ALLREDUCE"
+AUTOTUNE = "HVDTPU_AUTOTUNE"
+AUTOTUNE_LOG = "HVDTPU_AUTOTUNE_LOG"
+LOG_LEVEL = "HVDTPU_LOG_LEVEL"
+
+
+def env_int(name: str, default: int) -> int:
+    value = os.environ.get(name)
+    return int(value) if value not in (None, "") else default
+
+
+def env_float(name: str, default: float) -> float:
+    value = os.environ.get(name)
+    return float(value) if value not in (None, "") else default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    value = os.environ.get(name)
+    if value in (None, ""):
+        return default
+    return value.lower() in ("1", "true", "yes", "on")

@@ -1,0 +1,51 @@
+"""Logging (reference: horovod/common/logging.cc — LOG(level, rank) macros
+to stderr, controlled by HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME).
+
+Maps onto python logging with the same env contract, HVDTPU_-prefixed."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG - 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    level_name = os.environ.get("HVDTPU_LOG_LEVEL", "warning").lower()
+    level = _LEVELS.get(level_name, logging.WARNING)
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("HVDTPU_LOG_HIDE_TIME", "0") in ("1", "true"):
+        fmt = "[%(levelname)s] %(name)s: %(message)s"
+    else:
+        fmt = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+    handler.setFormatter(logging.Formatter(fmt))
+    root = logging.getLogger("horovod_tpu")
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "horovod_tpu") -> logging.Logger:
+    _configure()
+    if not name.startswith("horovod_tpu"):
+        name = f"horovod_tpu.{name}"
+    return logging.getLogger(name)
+
+
+def log(level: str, msg: str, *args) -> None:
+    get_logger().log(_LEVELS.get(level, logging.INFO), msg, *args)

@@ -1,0 +1,247 @@
+"""Negotiation-protocol unit tests, in-process with synthetic request lists
+(the strategy the reference uses for launcher/controller logic in
+test/test_run.py — no multi-process needed to pin the protocol down)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runtime.controller import ControllerState, compute_responses
+from horovod_tpu.runtime.messages import (
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseType,
+)
+
+FUSION = 64 * 1024 * 1024
+
+
+def req(rank, name, rtype=RequestType.ALLREDUCE, shape=(4,), dtype="float32", **kw):
+    return Request(
+        request_rank=rank,
+        request_type=rtype,
+        tensor_name=name,
+        dtype=dtype,
+        shape=shape,
+        **kw,
+    )
+
+
+def cycle(state, lists):
+    return compute_responses(state, lists, fusion_threshold_bytes=FUSION)
+
+
+def test_tensor_ready_only_when_all_ranks_submitted():
+    state = ControllerState(world_size=2)
+    out, _ = cycle(state, [RequestList([req(0, "t")]), RequestList([])])
+    assert out == []
+    out, _ = cycle(state, [RequestList([]), RequestList([req(1, "t")])])
+    assert len(out) == 1
+    assert out[0].response_type == ResponseType.ALLREDUCE
+    assert out[0].tensor_names == ["t"]
+
+
+def test_request_list_roundtrip():
+    rl = RequestList(
+        [req(1, "x", RequestType.BROADCAST, (2, 3), "int32", root_rank=1)],
+        shutdown=True,
+        joined=False,
+    )
+    back = RequestList.deserialize(rl.serialize())
+    assert back.shutdown and not back.joined
+    assert back.requests[0].tensor_name == "x"
+    assert back.requests[0].request_type == RequestType.BROADCAST
+    assert back.requests[0].shape == (2, 3)
+    assert back.requests[0].root_rank == 1
+
+
+def test_dtype_mismatch_produces_error_response():
+    state = ControllerState(world_size=2)
+    out, _ = cycle(
+        state,
+        [
+            RequestList([req(0, "t", dtype="float32")]),
+            RequestList([req(1, "t", dtype="int32")]),
+        ],
+    )
+    assert out[0].response_type == ResponseType.ERROR
+    assert "Mismatched data types" in out[0].error_message
+
+
+def test_shape_mismatch_produces_error_response():
+    state = ControllerState(world_size=2)
+    out, _ = cycle(
+        state,
+        [
+            RequestList([req(0, "t", shape=(4,))]),
+            RequestList([req(1, "t", shape=(5,))]),
+        ],
+    )
+    assert out[0].response_type == ResponseType.ERROR
+    assert "Mismatched shapes" in out[0].error_message
+
+
+def test_allgather_ragged_sizes_negotiated():
+    state = ControllerState(world_size=3)
+    out, _ = cycle(
+        state,
+        [
+            RequestList([req(0, "g", RequestType.ALLGATHER, (2, 7))]),
+            RequestList([req(1, "g", RequestType.ALLGATHER, (5, 7))]),
+            RequestList([req(2, "g", RequestType.ALLGATHER, (1, 7))]),
+        ],
+    )
+    assert out[0].response_type == ResponseType.ALLGATHER
+    assert out[0].tensor_sizes == [2, 5, 1]
+
+
+def test_allgather_scalar_is_error_not_crash():
+    """A 0-d allgather must become an ERROR response, not an IndexError
+    that kills the engine loop."""
+    state = ControllerState(world_size=2)
+    out, _ = cycle(
+        state,
+        [
+            RequestList([req(0, "s", RequestType.ALLGATHER, ())]),
+            RequestList([req(1, "s", RequestType.ALLGATHER, ())]),
+        ],
+    )
+    assert out[0].response_type == ResponseType.ERROR
+    assert "1-dimensional" in out[0].error_message
+
+
+def test_allgather_trailing_shape_mismatch_is_error():
+    state = ControllerState(world_size=2)
+    out, _ = cycle(
+        state,
+        [
+            RequestList([req(0, "g", RequestType.ALLGATHER, (2, 7))]),
+            RequestList([req(1, "g", RequestType.ALLGATHER, (5, 8))]),
+        ],
+    )
+    assert out[0].response_type == ResponseType.ERROR
+
+
+def test_broadcast_root_mismatch_is_error():
+    state = ControllerState(world_size=2)
+    out, _ = cycle(
+        state,
+        [
+            RequestList([req(0, "b", RequestType.BROADCAST, root_rank=0)]),
+            RequestList([req(1, "b", RequestType.BROADCAST, root_rank=1)]),
+        ],
+    )
+    assert out[0].response_type == ResponseType.ERROR
+    assert "root rank" in out[0].error_message.lower()
+
+
+def test_fusion_groups_same_dtype_adjacent_allreduces():
+    state = ControllerState(world_size=1)
+    lists = [
+        RequestList(
+            [
+                req(0, "a", dtype="float32"),
+                req(0, "b", dtype="float32"),
+                req(0, "c", dtype="int32"),
+                req(0, "d", dtype="float32"),
+            ]
+        )
+    ]
+    out, _ = cycle(state, lists)
+    # a+b fuse; c breaks the run (dtype); d starts a new group
+    names = [r.tensor_names for r in out]
+    assert names == [["a", "b"], ["c"], ["d"]]
+
+
+def test_fusion_respects_threshold():
+    state = ControllerState(world_size=1)
+    big = (1024 * 1024,)  # 4 MB each at fp32
+    lists = [RequestList([req(0, f"t{i}", shape=big) for i in range(4)])]
+    out, _ = compute_responses(
+        state, lists, fusion_threshold_bytes=8 * 1024 * 1024
+    )
+    names = [r.tensor_names for r in out]
+    assert names == [["t0", "t1"], ["t2", "t3"]]
+
+
+def test_mixed_reduce_ops_do_not_fuse():
+    state = ControllerState(world_size=1)
+    lists = [
+        RequestList(
+            [req(0, "a", reduce_op=1), req(0, "b", reduce_op=2)]
+        )
+    ]
+    out, _ = cycle(state, lists)
+    assert [r.tensor_names for r in out] == [["a"], ["b"]]
+
+
+def test_join_lowers_required_count_and_completes():
+    """reference controller.cc:219-221,263-307: joined ranks are excluded
+    from readiness counting; all-joined emits a JOIN response."""
+    state = ControllerState(world_size=2)
+    # rank 1 joins; rank 0 still reducing
+    out, _ = cycle(
+        state,
+        [RequestList([req(0, "t")]), RequestList([], joined=True)],
+    )
+    # t is ready with only rank 0's request (needed = 2 - 1 joined)
+    assert any(
+        r.response_type == ResponseType.ALLREDUCE and r.tensor_names == ["t"]
+        for r in out
+    )
+    assert not any(r.response_type == ResponseType.JOIN for r in out)
+    # now rank 0 joins too -> JOIN response, state reset
+    out2, _ = cycle(
+        state,
+        [RequestList([], joined=True), RequestList([], joined=True)],
+    )
+    assert any(r.response_type == ResponseType.JOIN for r in out2)
+    assert state.joined_ranks == set()
+
+
+def test_shutdown_propagates():
+    state = ControllerState(world_size=2)
+    _, stop = cycle(
+        state, [RequestList([], shutdown=True), RequestList([])]
+    )
+    assert stop
+
+
+def test_deterministic_order_across_cycles():
+    """Responses come out in first-arrival order — identical on every rank
+    because inputs are identical (the invariant replacing rank-0 bcast)."""
+    state = ControllerState(world_size=2)
+    cycle(state, [RequestList([req(0, "z"), req(0, "a")]), RequestList([])])
+    out, _ = cycle(
+        state,
+        [RequestList([]), RequestList([req(1, "a"), req(1, "z")])],
+    )
+    flat = [n for r in out for n in r.tensor_names]
+    assert flat == ["z", "a"]  # rank 0's arrival order, not alphabetical
+
+
+def test_stall_warning_logged(caplog):
+    import horovod_tpu.runtime.controller as ctl
+
+    state = ControllerState(world_size=2)
+    cycle(state, [RequestList([req(0, "stuck")]), RequestList([])])
+    # age the entry artificially and force the check window open
+    key = ("stuck", RequestType.ALLREDUCE)
+    state.message_table[key].first_seen -= 100.0
+    state.last_stall_check -= 100.0
+    import logging
+
+    root = logging.getLogger("horovod_tpu")
+    root.propagate = True  # let caplog's root handler see it
+    try:
+        with caplog.at_level("WARNING", logger="horovod_tpu.controller"):
+            compute_responses(
+                state,
+                [RequestList([]), RequestList([])],
+                fusion_threshold_bytes=FUSION,
+                stall_warning_secs=60.0,
+            )
+    finally:
+        root.propagate = False
+    assert any("waiting on ranks [1]" in r.getMessage() for r in caplog.records)

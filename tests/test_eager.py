@@ -1,0 +1,91 @@
+"""Eager per-op API tests (single-process world: collectives are
+identities, handles resolve; the multi-process path is covered by the
+controller unit tests and the launcher integration tests)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.runtime.timeline import Timeline
+
+
+def test_allreduce_identity_and_scaling():
+    x = np.arange(6.0, dtype=np.float32)
+    out = hvd.allreduce_(x, op=hvd.Sum)
+    np.testing.assert_allclose(out, x)
+    out2 = hvd.synchronize(
+        hvd.allreduce_async(x, op=hvd.Sum, prescale_factor=2.0, postscale_factor=3.0)
+    )
+    np.testing.assert_allclose(out2, x * 6.0)
+
+
+def test_async_handle_poll_synchronize():
+    x = np.ones(3, np.float32)
+    h = hvd.allreduce_async(x, name="h1")
+    # single-process resolves immediately
+    deadline = time.time() + 2
+    while not hvd.poll(h) and time.time() < deadline:
+        time.sleep(0.01)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(hvd.synchronize(h), x)
+
+
+def test_allgather_and_broadcast_identity():
+    x = np.random.RandomState(0).randn(4, 2).astype(np.float32)
+    np.testing.assert_allclose(hvd.synchronize(hvd.allgather_async(x)), x)
+    np.testing.assert_allclose(
+        hvd.synchronize(hvd.broadcast_async(x, root_rank=0)), x
+    )
+
+
+def test_broadcast_bad_root_raises():
+    from horovod_tpu.ops import eager
+
+    with pytest.raises(ValueError, match="out of range"):
+        eager.broadcast(np.ones(2, np.float32), root_rank=3)
+
+
+def test_join_and_barrier_single_process():
+    from horovod_tpu.ops import eager
+
+    assert eager.join() == 0
+    eager.barrier()  # must not hang
+
+
+def test_timeline_chrome_trace_format(tmp_path):
+    """reference test/test_timeline.py: run ops with the timeline enabled,
+    assert the JSON contains negotiation and op events."""
+    path = tmp_path / "trace.json"
+    tl = Timeline(str(path), rank=0, mark_cycles=True)
+    tl.negotiate_start("grad0", "ALLREDUCE")
+    tl.negotiate_rank_ready("grad0", 0)
+    tl.negotiate_end("grad0", "ALLREDUCE")
+    tl.start("grad0", "ALLREDUCE")
+    tl.mark_cycle()
+    tl.end("grad0", "ALLREDUCE")
+    tl.shutdown()
+    events = json.loads(path.read_text())
+    names = [e["name"] for e in events]
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "ALLREDUCE" in names
+    assert "CYCLE_START" in names
+    phases = {e["ph"] for e in events}
+    assert {"B", "E"} <= phases
+
+
+def test_timeline_disabled_is_noop(tmp_path):
+    tl = Timeline(None, rank=0)
+    assert not tl.enabled
+    tl.start("x", "ALLREDUCE")  # must not crash
+    tl.shutdown()
+
+
+def test_metric_average_eager():
+    from horovod_tpu.callbacks import MetricAverageCallback
+
+    out = MetricAverageCallback()({"loss": np.float32(2.5)})
+    np.testing.assert_allclose(out["loss"], 2.5)
