@@ -94,6 +94,10 @@ class Topology:
     cross_size: int
     devices: Sequence[jax.Device] = field(default_factory=list)
     homogeneous: bool = True
+    # Whether init() started jax.distributed itself; shutdown() only tears
+    # down what it owns (≙ the reference's MPIContextManager negotiating
+    # MPI_Init/Finalize ownership, horovod/common/mpi/mpi_context.cc).
+    owns_jax_distributed: bool = False
 
     @property
     def num_devices(self) -> int:
@@ -135,17 +139,42 @@ def init(comm=None) -> Topology:
         proc = _env_int("HVDTPU_RANK", 0)
         coordinator = os.environ.get("HVDTPU_COORDINATOR")
 
+        # Some site setups (PJRT plugin registration hooks) overwrite
+        # jax_platforms at interpreter start, clobbering the JAX_PLATFORMS
+        # the launcher exported for its workers.  Re-assert the env intent
+        # through the config API before any backend is instantiated.
+        env_platforms = os.environ.get("JAX_PLATFORMS")
+        if env_platforms and (jax.config.jax_platforms or "") != env_platforms:
+            try:
+                jax.config.update("jax_platforms", env_platforms)
+            except Exception:
+                pass  # backend already up; leave the platform alone
+
+        owns_distributed = False
         if world > 1 and not _jax_distributed_active():
             if coordinator is None:
                 raise RuntimeError(
                     "HVDTPU_SIZE > 1 but HVDTPU_COORDINATOR is unset; launch with "
                     "hvdrun or set the rendezvous environment explicitly."
                 )
+            # Multi-process CPU worlds (the test/dev topology, SURVEY.md §4)
+            # need a CPU collectives backend; jax's is gloo — the very
+            # library the reference uses for its CPU data path.
+            platforms = (jax.config.jax_platforms or "").split(",")
+            if "cpu" in platforms:
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except Exception:  # already initialized or unknown option
+                    pass
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=world,
                 process_id=proc,
+                initialization_timeout=_env_int("HVDTPU_START_TIMEOUT", 300),
             )
+            owns_distributed = True
 
         devices = tuple(jax.devices())
         local_devices = tuple(jax.local_devices())
@@ -166,6 +195,7 @@ def init(comm=None) -> Topology:
             cross_size=_env_int("HVDTPU_CROSS_SIZE", world if world > 1 else 1),
             devices=devices,
             homogeneous=homogeneous,
+            owns_jax_distributed=owns_distributed,
         )
         del local_devices
         return _topology
@@ -191,6 +221,11 @@ def shutdown() -> None:
         from . import _engine_registry  # noqa: PLC0415
 
         _engine_registry.shutdown_engine()
+        if _topology is not None and _topology.owns_jax_distributed:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass  # coordinator may already be gone at interpreter exit
         _topology = None
         _mesh_cache.clear()
 

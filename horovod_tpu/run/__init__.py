@@ -1,0 +1,18 @@
+"""hvdrun — the launcher (reference: horovod/run, `horovodrun` CLI).
+
+Starts N worker processes (local or over ssh), assigns each its
+rank/local_rank/cross_rank slot, points them all at a JAX coordination
+service, and maps CLI/config knobs onto HVDTPU_* env vars for every rank —
+the direct descendant of horovodrun's gloo launch path
+(horovod/run/gloo_run.py), with `jax.distributed` playing the role of the
+gloo rendezvous.
+
+Entry points:
+
+* ``python -m horovod_tpu.run -np 4 python train.py``  (CLI)
+* ``horovod_tpu.run.run(fn, args=(), np=4)``            (python API,
+  reference horovod/run/runner.py:719-808)
+"""
+
+from .api import run  # noqa: F401
+from .runner import main, parse_args  # noqa: F401

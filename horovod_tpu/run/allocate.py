@@ -1,0 +1,119 @@
+"""Host/slot allocation.
+
+Reference: horovod/run/gloo_run.py:54-112 (`_allocate`) — parse a hosts
+string like ``h1:2,h2:2`` into per-process SlotInfo carrying the three
+communicator coordinates (rank / local_rank / cross_rank and their sizes,
+≙ Communicator GLOBAL/LOCAL/CROSS, horovod/common/common.h:111-115).
+
+On TPU the local axis maps to processes within one host (sharing a slice's
+ICI domain) and the cross axis to one process per host (DCN)."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass(frozen=True)
+class HostSlots:
+    hostname: str
+    slots: int
+
+
+@dataclass(frozen=True)
+class SlotInfo:
+    hostname: str
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+
+def parse_hosts(hosts: str) -> List[HostSlots]:
+    """``"h1:2,h2:2"`` -> [HostSlots(h1,2), HostSlots(h2,2)] (reference
+    runner.py hosts arg; also accepts bare hostnames meaning 1 slot)."""
+    out: List[HostSlots] = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.match(r"^(?P<host>[^:]+)(:(?P<slots>\d+))?$", part)
+        if m is None:
+            raise ValueError(f"bad host specification: {part!r}")
+        out.append(
+            HostSlots(m.group("host"), int(m.group("slots") or 1))
+        )
+    if not out:
+        raise ValueError("empty hosts specification")
+    return out
+
+
+def parse_hostfile(path: str) -> List[HostSlots]:
+    """Hostfile lines ``hostname slots=N`` (reference runner.py:553-565)."""
+    out: List[HostSlots] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(?P<host>\S+)(\s+slots\s*=\s*(?P<slots>\d+))?$", line)
+            if m is None:
+                raise ValueError(f"bad hostfile line: {line!r}")
+            out.append(HostSlots(m.group("host"), int(m.group("slots") or 1)))
+    return out
+
+
+def allocate(hosts: List[HostSlots], np: int) -> List[SlotInfo]:
+    """Fill slots host-by-host up to ``np`` processes (reference
+    gloo_run.py:54-112: ranks assigned in host order; local_rank within
+    host; cross_rank = index of host among hosts that have this
+    local_rank)."""
+    total = sum(h.slots for h in hosts)
+    if np > total:
+        raise ValueError(
+            f"requested np={np} processes but hosts provide only {total} "
+            f"slots"
+        )
+    # slots actually used per host, in order
+    used: List[HostSlots] = []
+    remaining = np
+    for h in hosts:
+        take = min(h.slots, remaining)
+        if take > 0:
+            used.append(HostSlots(h.hostname, take))
+        remaining -= take
+        if remaining == 0:
+            break
+
+    # For a given local_rank, the cross communicator is the set of hosts
+    # that have that slot; cross_rank is this host's index *within that
+    # set* (not the global host index — they differ when hosts have
+    # heterogeneous slot counts).
+    cross_sizes: Dict[int, int] = {}
+    for h in used:
+        for lr in range(h.slots):
+            cross_sizes[lr] = cross_sizes.get(lr, 0) + 1
+
+    slots: List[SlotInfo] = []
+    rank = 0
+    cross_seen: Dict[int, int] = {}
+    for h in used:
+        for lr in range(h.slots):
+            cross_rank = cross_seen.get(lr, 0)
+            cross_seen[lr] = cross_rank + 1
+            slots.append(
+                SlotInfo(
+                    hostname=h.hostname,
+                    rank=rank,
+                    size=np,
+                    local_rank=lr,
+                    local_size=h.slots,
+                    cross_rank=cross_rank,
+                    cross_size=cross_sizes[lr],
+                )
+            )
+            rank += 1
+    return slots

@@ -1,0 +1,141 @@
+"""Python launch API: run a function on N distributed workers.
+
+Reference: horovod.run.run (horovod/run/runner.py:719-808) — pickles the
+function, serves it over a KV store, launches workers that fetch/execute
+it, collects per-rank results, returns them ordered by rank."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, List, Optional
+
+import cloudpickle
+
+from .rendezvous import KVStoreClient, KVStoreServer
+from .runner import launch_job
+
+_SCOPE = "runfunc"
+
+
+def _pickle_func(func, args, kwargs) -> bytes:
+    """Serialize by value when the defining module won't be importable in
+    the workers (e.g. a test file or a script outside PYTHONPATH) — the
+    reference sidesteps this by requiring an importable module; pickling by
+    value makes run() self-contained."""
+    module_name = getattr(func, "__module__", None)
+    module = sys.modules.get(module_name) if module_name else None
+    registered = False
+    if (
+        module is not None
+        and module_name not in ("__main__", "builtins")
+        and not module_name.startswith("horovod_tpu")
+        and module_name not in sys.stdlib_module_names
+    ):
+        try:
+            cloudpickle.register_pickle_by_value(module)
+            registered = True
+        except Exception:
+            pass
+    try:
+        return cloudpickle.dumps((func, args, kwargs))
+    finally:
+        if registered:
+            cloudpickle.unregister_pickle_by_value(module)
+
+
+def _advertise_addr(hosts: Optional[str], hostfile: Optional[str], port: int) -> str:
+    """KV-server address workers dial: loopback for all-local jobs, this
+    host's routable address when any worker is remote."""
+    import socket
+
+    from .allocate import parse_hostfile, parse_hosts
+
+    host_slots = (
+        parse_hostfile(hostfile)
+        if hostfile
+        else parse_hosts(hosts)
+        if hosts
+        else []
+    )
+    local_names = {"localhost", "127.0.0.1", socket.gethostname()}
+    if all(h.hostname in local_names for h in host_slots):
+        return f"127.0.0.1:{port}"
+    try:
+        host = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        host = socket.getfqdn()
+    return f"{host}:{port}"
+
+
+def run(
+    func,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    *,
+    np: int = 1,
+    hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    env: Optional[dict] = None,
+    start_timeout: Optional[float] = None,
+    timeout: Optional[float] = None,
+    use_cpu: bool = False,
+) -> List[Any]:
+    """Execute ``func(*args, **kwargs)`` on ``np`` distributed workers and
+    return the list of per-rank results (rank order).
+
+    ``start_timeout`` bounds world formation; ``timeout`` is a whole-job
+    watchdog.  ``use_cpu`` forces JAX_PLATFORMS=cpu in the workers — the
+    launcher-level analog of the reference CI's "multi-process on localhost
+    stands in for multi-node" strategy (SURVEY.md §4).
+    """
+    server = KVStoreServer()
+    port = server.start()
+    try:
+        payload = _pickle_func(func, args, kwargs or {})
+        server_addr = _advertise_addr(hosts, hostfile, port)
+        client = KVStoreClient(f"127.0.0.1:{port}")
+        client.put(_SCOPE, "func", payload)
+
+        worker_env = dict(env or {})
+        worker_env["HVDTPU_RUN_FUNC_ADDR"] = server_addr
+        if use_cpu:
+            worker_env.setdefault("JAX_PLATFORMS", "cpu")
+
+        command = [sys.executable, "-m", "horovod_tpu.run.task_fn"]
+        try:
+            launch_job(
+                command,
+                np,
+                hosts=hosts,
+                hostfile=hostfile,
+                env=worker_env,
+                start_timeout=start_timeout,
+                job_timeout=timeout,
+            )
+        except RuntimeError as launch_err:
+            # A failing worker exits non-zero, which surfaces here before
+            # the result loop — but it published its real traceback to the
+            # KV store first.  Prefer that over the generic exit-code error.
+            for rank in range(np):
+                blob = client.get(_SCOPE, f"result_{rank}")
+                if blob is None:
+                    continue
+                ok, value = cloudpickle.loads(blob)
+                if not ok:
+                    raise RuntimeError(
+                        f"rank {rank} raised during run():\n{value}"
+                    ) from launch_err
+            raise
+        results = []
+        for rank in range(np):
+            blob = client.wait(_SCOPE, f"result_{rank}", timeout=30)
+            ok, value = cloudpickle.loads(blob)
+            if not ok:
+                raise RuntimeError(
+                    f"rank {rank} raised during run():\n{value}"
+                )
+            results.append(value)
+        return results
+    finally:
+        server.stop()

@@ -1,0 +1,95 @@
+"""CLI-args <-> env-var mapping and YAML config layering.
+
+Reference: horovod/run/common/util/config_parser.py (set_env_from_args,
+args<->yaml key maps) and runner.py:163-218,446-450 (the override-action
+trick: explicit CLI flags win over the config file, which wins over
+defaults).
+
+Env contract consumed by the core (utils/env.py) — every knob the
+reference exposes has an HVDTPU_ equivalent here (SURVEY.md §5.6)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, Optional
+
+from ..utils import env as envmod
+
+# arg attribute -> (env var, yaml section.key)
+_ARG_ENV_MAP = {
+    "fusion_threshold_mb": (envmod.FUSION_THRESHOLD, "params.fusion-threshold-mb"),
+    "cycle_time_ms": (envmod.CYCLE_TIME, "params.cycle-time-ms"),
+    "cache_capacity": (envmod.CACHE_CAPACITY, "params.cache-capacity"),
+    "hierarchical_allreduce": (
+        envmod.HIERARCHICAL_ALLREDUCE,
+        "params.hierarchical-allreduce",
+    ),
+    "timeline_filename": (envmod.TIMELINE, "timeline.filename"),
+    "timeline_mark_cycles": (envmod.TIMELINE_MARK_CYCLES, "timeline.mark-cycles"),
+    "no_stall_check": (envmod.STALL_CHECK_DISABLE, "stall-check.disable"),
+    "stall_check_warning_time_seconds": (
+        envmod.STALL_CHECK_TIME,
+        "stall-check.warning-time-seconds",
+    ),
+    "stall_check_shutdown_time_seconds": (
+        envmod.STALL_SHUTDOWN_TIME,
+        "stall-check.shutdown-time-seconds",
+    ),
+    "autotune": (envmod.AUTOTUNE, "autotune.enabled"),
+    "autotune_log_file": (envmod.AUTOTUNE_LOG, "autotune.log-file"),
+    "log_level": (envmod.LOG_LEVEL, "logging.level"),
+}
+
+
+def set_env_from_args(env: Dict[str, str], args: argparse.Namespace) -> Dict[str, str]:
+    """Write HVDTPU_* entries for every set arg (reference
+    config_parser.set_env_from_args, called at runner.py:693-695)."""
+    for attr, (env_name, _) in _ARG_ENV_MAP.items():
+        value = getattr(args, attr, None)
+        # `is`-checks: 0 is a legitimate explicit value (e.g.
+        # --fusion-threshold-mb 0 disables fusion) and 0 == False in python.
+        if value is None or value is False:
+            continue
+        if attr == "fusion_threshold_mb":
+            value = int(value) * 1024 * 1024
+        if value is True:
+            value = "1"
+        env[env_name] = str(value)
+    return env
+
+
+class _StoreOverrideAction(argparse.Action):
+    """Tracks which args the user set explicitly so config-file values
+    don't clobber them (reference runner.py:163-218)."""
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        setattr(namespace, self.dest, values)
+        overrides = getattr(namespace, "_explicit_args", set())
+        overrides.add(self.dest)
+        namespace._explicit_args = overrides
+
+
+class _StoreTrueOverrideAction(_StoreOverrideAction):
+    def __init__(self, option_strings, dest, **kwargs):
+        kwargs.pop("nargs", None)
+        super().__init__(option_strings, dest, nargs=0, **kwargs)
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        super().__call__(parser, namespace, True, option_string)
+
+
+def apply_config_file(args: argparse.Namespace, path: Optional[str]) -> None:
+    """Layer a YAML config under explicit CLI args (reference
+    runner.py:446-450: `read_config_file` + `validate_config_args`)."""
+    if not path:
+        return
+    import yaml  # PyYAML ships with the baked image
+
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    explicit = getattr(args, "_explicit_args", set())
+    for attr, (_, yaml_key) in _ARG_ENV_MAP.items():
+        section, key = yaml_key.split(".")
+        if section in config and key in (config[section] or {}):
+            if attr not in explicit:
+                setattr(args, attr, config[section][key])

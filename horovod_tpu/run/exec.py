@@ -1,0 +1,146 @@
+"""Process execution with tree-safe termination.
+
+Reference: horovod/run/common/util/safe_shell_exec.py (219 LoC) — run a
+command in its own process group, forward termination to the whole tree,
+stream output; and gloo_run's threaded per-slot execution with job-level
+failure propagation (gloo_run.py:168-234, 294-304)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, IO, List, Optional
+
+GRACEFUL_TERM_SECS = 5.0
+
+
+def _stream(pipe: IO[bytes], sink, prefix: bytes) -> None:
+    """Pump a child pipe to our stdout/stderr, rank-prefixed like
+    horovodrun's `[1]<stdout>` tagging."""
+    try:
+        for line in iter(pipe.readline, b""):
+            sink.buffer.write(prefix + line)
+            sink.flush()
+    except ValueError:
+        pass  # sink closed during interpreter shutdown
+    finally:
+        pipe.close()
+
+
+@dataclass
+class _Proc:
+    rank: int
+    popen: subprocess.Popen
+    threads: List[threading.Thread]
+
+
+class ProcessSet:
+    """Launch N local commands; kill the whole set if any fails
+    (reference gloo_run.py:294-304) or on SIGINT/SIGTERM."""
+
+    def __init__(self):
+        self._procs: List[_Proc] = []
+        self._lock = threading.Lock()
+
+    def launch(
+        self,
+        rank: int,
+        cmd: List[str],
+        env: Dict[str, str],
+        tag_output: bool = True,
+    ) -> None:
+        popen = subprocess.Popen(
+            cmd,
+            env=env,
+            stdout=subprocess.PIPE if tag_output else None,
+            stderr=subprocess.PIPE if tag_output else None,
+            start_new_session=True,  # own process group for tree kill
+        )
+        threads = []
+        if tag_output:
+            for pipe, sink in ((popen.stdout, sys.stdout), (popen.stderr, sys.stderr)):
+                t = threading.Thread(
+                    target=_stream,
+                    args=(pipe, sink, f"[{rank}]".encode()),
+                    daemon=True,
+                )
+                t.start()
+                threads.append(t)
+        with self._lock:
+            self._procs.append(_Proc(rank, popen, threads))
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[int, int]:
+        """Wait for all; on first non-zero exit, terminate the rest and
+        raise.  Returns {rank: returncode} when all succeed."""
+        deadline = time.time() + timeout if timeout else None
+        results: Dict[int, int] = {}
+        try:
+            while True:
+                with self._lock:
+                    procs = list(self._procs)
+                pending = [p for p in procs if p.rank not in results]
+                if not pending:
+                    return results
+                for p in pending:
+                    rc = p.popen.poll()
+                    if rc is not None:
+                        results[p.rank] = rc
+                        if rc != 0:
+                            self.terminate()
+                            raise RuntimeError(
+                                f"Process {p.rank} exited with code {rc}; "
+                                f"terminating remaining workers "
+                                f"(launcher failure propagation)."
+                            )
+                if deadline and time.time() > deadline:
+                    self.terminate()
+                    raise TimeoutError("launcher wait() timed out")
+                time.sleep(0.05)
+        except KeyboardInterrupt:
+            self.terminate()
+            raise
+
+    def terminate(self) -> None:
+        """SIGTERM the process groups, escalate to SIGKILL (reference
+        safe_shell_exec's event-driven tree termination)."""
+        with self._lock:
+            procs = list(self._procs)
+        for p in procs:
+            if p.popen.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.popen.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + GRACEFUL_TERM_SECS
+        for p in procs:
+            while p.popen.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.popen.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.popen.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+
+def make_ssh_command(host: str, cmd: List[str], env: Dict[str, str], ssh_port: Optional[int]) -> List[str]:
+    """Wrap a worker command for remote execution (reference
+    gloo_run.py:168-234 get_remote_command: env exported inline over ssh)."""
+    exports = " ".join(
+        f"{k}={_shquote(v)}" for k, v in sorted(env.items())
+    )
+    remote = f"cd {_shquote(os.getcwd())} && env {exports} {' '.join(_shquote(c) for c in cmd)}"
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    return ssh + [host, remote]
+
+
+def _shquote(s: str) -> str:
+    import shlex
+
+    return shlex.quote(str(s))

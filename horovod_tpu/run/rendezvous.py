@@ -1,0 +1,119 @@
+"""Threaded HTTP key-value store for the launcher.
+
+Reference: horovod/run/http/http_server.py — `RendezvousServer` (gloo ranks
+publish/fetch addresses, per-scope completion tracking) and `KVStoreServer`
+(pickled function + results for `horovod.run.run`).
+
+The TPU build needs no address full-mesh (jax.distributed's coordinator
+covers worker rendezvous), so this server's jobs are: distributing the
+pickled function for the python `run()` API, collecting per-rank results,
+and serving as a generic KV side-channel for integrations (the Spark-style
+driver uses it too)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.error import URLError
+from urllib.request import Request, urlopen
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    def _key(self) -> str:
+        return self.path.lstrip("/")
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            self.server.kv[self._key()] = value  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            value = self.server.kv.get(self._key())  # type: ignore[attr-defined]
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            self.server.kv.pop(self._key(), None)  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class KVStoreServer:
+    """reference http_server.py `KVStoreServer` (threaded, start/stop)."""
+
+    def __init__(self, port: int = 0):
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._httpd.kv = {}  # type: ignore[attr-defined]
+        self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvdtpu_kvstore", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+
+class KVStoreClient:
+    """reference http/http_client.py: put/get against the KV server."""
+
+    def __init__(self, addr: str):
+        self._base = f"http://{addr}"
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        req = Request(
+            f"{self._base}/{scope}/{key}", data=value, method="PUT"
+        )
+        urlopen(req, timeout=30).read()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        try:
+            return urlopen(
+                f"{self._base}/{scope}/{key}", timeout=30
+            ).read()
+        except URLError:
+            return None
+        except Exception:
+            return None
+
+    def wait(self, scope: str, key: str, timeout: float = 120.0) -> bytes:
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            value = self.get(scope, key)
+            if value is not None:
+                return value
+            time.sleep(0.1)
+        raise TimeoutError(f"KV key {scope}/{key} not published in {timeout}s")

@@ -1,0 +1,38 @@
+"""Worker-side stub for the run() API (reference: horovod/run/task_fn.py):
+fetch the pickled function from the driver's KV store, execute, publish the
+result under this rank."""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+import cloudpickle
+
+from .rendezvous import KVStoreClient
+
+_SCOPE = "runfunc"
+
+
+def main() -> int:
+    addr = os.environ["HVDTPU_RUN_FUNC_ADDR"]
+    rank = int(os.environ.get("HVDTPU_RANK", "0"))
+    client = KVStoreClient(addr)
+    blob = client.wait(_SCOPE, "func", timeout=60)
+    func, args, kwargs = cloudpickle.loads(blob)
+    try:
+        result = func(*args, **kwargs)
+        client.put(_SCOPE, f"result_{rank}", cloudpickle.dumps((True, result)))
+        return 0
+    except BaseException:
+        client.put(
+            _SCOPE,
+            f"result_{rank}",
+            cloudpickle.dumps((False, traceback.format_exc())),
+        )
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Launcher unit tests — in-process, no processes spawned (the strategy of
+reference test/test_run.py: arg parsing, config layering, allocation, env
+assembly asserted directly)."""
+
+import argparse
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.run.allocate import (
+    HostSlots,
+    SlotInfo,
+    allocate,
+    parse_hostfile,
+    parse_hosts,
+)
+from horovod_tpu.run.config_parser import set_env_from_args
+from horovod_tpu.run.runner import build_slot_env, check_build, parse_args
+
+
+def test_parse_hosts():
+    assert parse_hosts("h1:2,h2:4") == [HostSlots("h1", 2), HostSlots("h2", 4)]
+    assert parse_hosts("solo") == [HostSlots("solo", 1)]
+    with pytest.raises(ValueError):
+        parse_hosts("")
+    with pytest.raises(ValueError):
+        parse_hosts("h1:x")
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text(
+        textwrap.dedent(
+            """
+            # comment
+            node1 slots=2
+            node2   slots=1
+            node3
+            """
+        )
+    )
+    assert parse_hostfile(str(p)) == [
+        HostSlots("node1", 2),
+        HostSlots("node2", 1),
+        HostSlots("node3", 1),
+    ]
+
+
+def test_allocate_ranks_and_cross_ranks():
+    """reference gloo_run.py:54-112: rank in host order, local_rank within
+    host, cross_rank = host index for that local slot."""
+    slots = allocate([HostSlots("a", 2), HostSlots("b", 2)], 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [(s.hostname, s.local_rank) for s in slots] == [
+        ("a", 0), ("a", 1), ("b", 0), ("b", 1),
+    ]
+    assert [(s.cross_rank, s.cross_size) for s in slots] == [
+        (0, 2), (0, 2), (1, 2), (1, 2),
+    ]
+
+
+def test_allocate_partial_last_host():
+    slots = allocate([HostSlots("a", 4), HostSlots("b", 4)], 5)
+    assert len(slots) == 5
+    assert slots[-1].hostname == "b" and slots[-1].local_size == 1
+
+
+def test_allocate_heterogeneous_cross_ranks():
+    """cross_rank must index within the set of hosts that HAVE that local
+    slot, not the global host index (a:1,b:2 -> b's local_rank-1 slot is
+    alone in its cross communicator: cross_rank 0 of size 1)."""
+    slots = allocate([HostSlots("a", 1), HostSlots("b", 2)], 3)
+    by = {(s.hostname, s.local_rank): s for s in slots}
+    assert by[("b", 1)].cross_rank == 0
+    assert by[("b", 1)].cross_size == 1
+    assert by[("a", 0)].cross_rank == 0 and by[("a", 0)].cross_size == 2
+    assert by[("b", 0)].cross_rank == 1 and by[("b", 0)].cross_size == 2
+    for s in slots:
+        assert 0 <= s.cross_rank < s.cross_size
+
+
+def test_explicit_zero_values_reach_env():
+    """0 is a legal explicit knob value and must not be dropped
+    (0 == False in python)."""
+    args = parse_args(["-np", "1", "--fusion-threshold-mb", "0", "python", "x"])
+    env = {}
+    set_env_from_args(env, args)
+    assert env["HVDTPU_FUSION_THRESHOLD"] == "0"
+
+
+def test_allocate_overflow_raises():
+    with pytest.raises(ValueError, match="only 2 slots"):
+        allocate([HostSlots("a", 2)], 3)
+
+
+def test_parse_args_knobs_to_env():
+    args = parse_args(
+        [
+            "-np", "2",
+            "--fusion-threshold-mb", "32",
+            "--cycle-time-ms", "3.5",
+            "--timeline-filename", "/tmp/t.json",
+            "--no-stall-check",
+            "--log-level", "debug",
+            "python", "train.py",
+        ]
+    )
+    assert args.np == 2
+    assert args.command == ["python", "train.py"]
+    env = {}
+    set_env_from_args(env, args)
+    assert env["HVDTPU_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVDTPU_CYCLE_TIME"] == "3.5"
+    assert env["HVDTPU_TIMELINE"] == "/tmp/t.json"
+    assert env["HVDTPU_STALL_CHECK_DISABLE"] == "1"
+    assert env["HVDTPU_LOG_LEVEL"] == "debug"
+
+
+def test_config_file_layering(tmp_path):
+    """Explicit CLI flags beat the config file; file beats defaults
+    (reference runner.py:446-450, test_run.py:168-226)."""
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        textwrap.dedent(
+            """
+            params:
+              fusion-threshold-mb: 16
+              cycle-time-ms: 2
+            timeline:
+              filename: /from/file.json
+            """
+        )
+    )
+    args = parse_args(
+        [
+            "-np", "2",
+            "--config-file", str(cfg),
+            "--cycle-time-ms", "9",  # explicit: must win over file's 2
+            "python", "x.py",
+        ]
+    )
+    assert args.fusion_threshold_mb == 16  # from file
+    assert args.cycle_time_ms == 9  # CLI wins
+    assert args.timeline_filename == "/from/file.json"
+
+
+def test_build_slot_env():
+    slot = SlotInfo("h", 3, 8, 1, 4, 0, 2)
+    env = build_slot_env(slot, "10.0.0.1:9999", {"PATH": "/bin"})
+    assert env["HVDTPU_RANK"] == "3"
+    assert env["HVDTPU_SIZE"] == "8"
+    assert env["HVDTPU_LOCAL_RANK"] == "1"
+    assert env["HVDTPU_LOCAL_SIZE"] == "4"
+    assert env["HVDTPU_CROSS_RANK"] == "0"
+    assert env["HVDTPU_CROSS_SIZE"] == "2"
+    assert env["HVDTPU_COORDINATOR"] == "10.0.0.1:9999"
+    assert env["PATH"] == "/bin"
+
+
+def test_check_build_reports_capabilities():
+    report = check_build()
+    assert "XLA collectives" in report
+    assert "eager per-op engine" in report
+
+
+def test_kvstore_roundtrip():
+    from horovod_tpu.run.rendezvous import KVStoreClient, KVStoreServer
+
+    server = KVStoreServer()
+    port = server.start()
+    try:
+        client = KVStoreClient(f"127.0.0.1:{port}")
+        assert client.get("s", "missing") is None
+        client.put("s", "k", b"payload")
+        assert client.get("s", "k") == b"payload"
+        assert client.wait("s", "k", timeout=1) == b"payload"
+    finally:
+        server.stop()
