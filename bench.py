@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Synthetic ResNet benchmark — the TPU equivalent of the reference's
+examples/pytorch_synthetic_benchmark.py (ResNet-50, synthetic images,
+img/sec reporting; docs/benchmarks.rst:66-79).
+
+Prints ONE JSON line:
+    {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+     "unit": "images/sec/chip", "vs_baseline": N / 103.55}
+
+vs_baseline denominator: the only absolute per-accelerator throughput the
+reference publishes in-tree — tf_cnn_benchmarks ResNet-101, batch 64,
+1656.82 img/sec over 16 Pascal GPUs = 103.55 img/sec/GPU
+(docs/benchmarks.rst:29-43).  The ratio therefore mixes model generation
+and hardware generation; the scaling-efficiency story lives in the
+multi-chip tests, this number tracks single-chip training throughput.
+
+Usage: python bench.py [--model resnet50] [--batch-size 64] [--iters 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_IMG_PER_SEC_PER_ACCEL = 103.55  # docs/benchmarks.rst:43 (1656.82/16)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "resnet101", "resnet18"])
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force CPU (dev mode; numbers not comparable)")
+    args = parser.parse_args()
+
+    if args.cpu:
+        # Env var too: hvd.init() re-asserts JAX_PLATFORMS from the
+        # environment (to undo site-hook overrides), so config alone would
+        # be flipped back.
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu as hvd
+    from horovod_tpu import models
+    from horovod_tpu.optim import DistributedOptimizer
+
+    hvd.init()
+    n_chips = hvd.num_devices()
+
+    model_cls = {
+        "resnet50": models.ResNet50,
+        "resnet101": models.ResNet101,
+        "resnet18": models.ResNet18,
+    }[args.model]
+    model = model_cls(num_classes=1000)
+
+    rng = jax.random.PRNGKey(0)
+    global_batch = args.batch_size * n_chips
+    images = jnp.asarray(
+        np.random.RandomState(0)
+        .randn(global_batch, args.image_size, args.image_size, 3)
+        .astype(np.float32)
+    )
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, 1000, size=(global_batch,))
+    )
+
+    variables = model.init(rng, images[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    tx = DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9), compression=hvd.Compression.none
+    )
+    opt_state = tx.init(params)
+
+    def local_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = hvd.mesh("flat")
+    step = jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1, 2),
+    )
+
+    for _ in range(args.warmup):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    # device_get forces a real host round-trip: on experimental platforms
+    # block_until_ready has been observed to return before execution
+    # completes, which would make the timing fictitious.
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    final_loss = float(loss)
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+
+    img_per_sec = global_batch * args.iters / elapsed
+    per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model}_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    per_chip / BASELINE_IMG_PER_SEC_PER_ACCEL, 3
+                ),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,7 +93,9 @@ def __getattr__(name):
 
         return SyncBatchNorm
     if name == "callbacks":
-        from . import callbacks  # noqa: PLC0415
+        import importlib  # noqa: PLC0415
 
-        return callbacks
+        # `from . import callbacks` would re-enter this __getattr__ while
+        # the submodule is mid-import (fromlist probing) and recurse.
+        return importlib.import_module("horovod_tpu.callbacks")
     raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

@@ -221,11 +221,12 @@ def shutdown() -> None:
         from . import _engine_registry  # noqa: PLC0415
 
         _engine_registry.shutdown_engine()
-        if _topology is not None and _topology.owns_jax_distributed:
-            try:
-                jax.distributed.shutdown()
-            except Exception:
-                pass  # coordinator may already be gone at interpreter exit
+        # The jax.distributed coordination service is deliberately left
+        # running: rank 0 hosts it, and tearing it down here would kill
+        # peers still mid-collective (uneven shutdown is normal — that's
+        # what Join is for).  JAX owns its teardown at process exit, like
+        # the reference leaves MPI_Finalize to the owning context
+        # (mpi/mpi_context.cc MPIContextManager).
         _topology = None
         _mesh_cache.clear()
 

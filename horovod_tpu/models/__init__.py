@@ -1,0 +1,10 @@
+"""Model zoo for benchmarks and examples.
+
+The reference treats models as external (tf_cnn_benchmarks, torchvision's
+resnet50 in examples/pytorch_synthetic_benchmark.py:19-37); this package
+carries TPU-first flax implementations so the framework's benchmarks and
+examples are self-contained: NHWC layouts, bfloat16 compute with fp32
+params, channel sizes that tile onto the 128x128 MXU."""
+
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .simple import MLP, ConvNet  # noqa: F401

@@ -21,6 +21,13 @@ class HostSlots:
     slots: int
 
 
+def is_local_host(name: str) -> bool:
+    """One definition of "this machine" for every launcher component."""
+    import socket
+
+    return name in ("localhost", "127.0.0.1", socket.gethostname(), socket.getfqdn())
+
+
 @dataclass(frozen=True)
 class SlotInfo:
     hostname: str

@@ -44,12 +44,8 @@ def _pickle_func(func, args, kwargs) -> bytes:
             cloudpickle.unregister_pickle_by_value(module)
 
 
-def _advertise_addr(hosts: Optional[str], hostfile: Optional[str], port: int) -> str:
-    """KV-server address workers dial: loopback for all-local jobs, this
-    host's routable address when any worker is remote."""
-    import socket
-
-    from .allocate import parse_hostfile, parse_hosts
+def _all_hosts_local(hosts: Optional[str], hostfile: Optional[str]) -> bool:
+    from .allocate import is_local_host, parse_hostfile, parse_hosts
 
     host_slots = (
         parse_hostfile(hostfile)
@@ -58,14 +54,24 @@ def _advertise_addr(hosts: Optional[str], hostfile: Optional[str], port: int) ->
         if hosts
         else []
     )
-    local_names = {"localhost", "127.0.0.1", socket.gethostname()}
-    if all(h.hostname in local_names for h in host_slots):
-        return f"127.0.0.1:{port}"
+    return all(is_local_host(h.hostname) for h in host_slots)
+
+
+def _routable_ip(probe_host: str) -> str:
+    """The local address a remote host would reach us on.  A connected UDP
+    socket never sends a packet but makes the kernel pick the outbound
+    interface — immune to the Debian /etc/hosts 127.0.1.1 hostname trap
+    that gethostbyname(gethostname()) falls into."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
-        host = socket.gethostbyname(socket.gethostname())
+        s.connect((probe_host, 9))
+        return s.getsockname()[0]
     except OSError:
-        host = socket.getfqdn()
-    return f"{host}:{port}"
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
 
 
 def run(
@@ -89,16 +95,32 @@ def run(
     launcher-level analog of the reference CI's "multi-process on localhost
     stands in for multi-node" strategy (SURVEY.md §4).
     """
-    server = KVStoreServer()
+    all_local = _all_hosts_local(hosts, hostfile)
+    server = KVStoreServer(bind_all=not all_local)
     port = server.start()
     try:
         payload = _pickle_func(func, args, kwargs or {})
-        server_addr = _advertise_addr(hosts, hostfile, port)
-        client = KVStoreClient(f"127.0.0.1:{port}")
+        if all_local:
+            server_addr = f"127.0.0.1:{port}"
+        else:
+            from .allocate import is_local_host, parse_hostfile, parse_hosts
+
+            host_slots = (
+                parse_hostfile(hostfile) if hostfile else parse_hosts(hosts)
+            )
+            probe = next(
+                (h.hostname for h in host_slots if not is_local_host(h.hostname)),
+                "127.0.0.1",
+            )
+            server_addr = f"{_routable_ip(probe)}:{port}"
+        client = KVStoreClient(f"127.0.0.1:{port}", secret=server.secret)
         client.put(_SCOPE, "func", payload)
 
         worker_env = dict(env or {})
         worker_env["HVDTPU_RUN_FUNC_ADDR"] = server_addr
+        from .rendezvous import SECRET_ENV  # noqa: PLC0415
+
+        worker_env[SECRET_ENV] = server.secret
         if use_cpu:
             worker_env.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -118,7 +140,10 @@ def run(
             # the result loop — but it published its real traceback to the
             # KV store first.  Prefer that over the generic exit-code error.
             for rank in range(np):
-                blob = client.get(_SCOPE, f"result_{rank}")
+                try:
+                    blob = client.get(_SCOPE, f"result_{rank}")
+                except Exception:
+                    blob = None
                 if blob is None:
                     continue
                 ok, value = cloudpickle.loads(blob)

@@ -47,6 +47,24 @@ class ProcessSet:
         self._procs: List[_Proc] = []
         self._lock = threading.Lock()
 
+    def install_signal_handlers(self) -> None:
+        """Forward SIGTERM/SIGHUP to the worker tree before dying —
+        children run in their own sessions, so without this a scheduler
+        killing the launcher would orphan every worker (reference
+        gloo_run.py registers the same propagation)."""
+
+        def _handler(signum, frame):
+            del frame
+            self.terminate()
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        for sig in (signal.SIGTERM, signal.SIGHUP):
+            try:
+                signal.signal(sig, _handler)
+            except ValueError:
+                pass  # not the main thread (e.g. run() from a worker)
+
     def launch(
         self,
         rank: int,

@@ -2,21 +2,44 @@
 
 Reference: horovod/run/http/http_server.py — `RendezvousServer` (gloo ranks
 publish/fetch addresses, per-scope completion tracking) and `KVStoreServer`
-(pickled function + results for `horovod.run.run`).
+(pickled function + results for `horovod.run.run`); payload integrity via
+HMAC-signed messages (horovod/run/common/util/secret.py).
 
 The TPU build needs no address full-mesh (jax.distributed's coordinator
 covers worker rendezvous), so this server's jobs are: distributing the
 pickled function for the python `run()` API, collecting per-rank results,
-and serving as a generic KV side-channel for integrations (the Spark-style
-driver uses it too)."""
+and serving as a generic KV side-channel for integrations.
+
+Security model (same as the reference's): every payload is authenticated
+with an HMAC over a per-job secret that travels to workers via the
+launcher's env, because the values are pickles — an unauthenticated write
+would be remote code execution.  All-local jobs additionally bind loopback
+only."""
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
+import secrets as _secrets
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
-from urllib.error import URLError
+from typing import Optional
+from urllib.error import HTTPError, URLError
 from urllib.request import Request, urlopen
+
+SECRET_ENV = "HVDTPU_SECRET"
+_MAC_HEADER = "X-HVDTPU-MAC"
+
+
+def make_secret() -> str:
+    """Per-job shared secret (reference secret.py make_secret_key)."""
+    return _secrets.token_hex(32)
+
+
+def _sign(secret: str, body: bytes) -> str:
+    return hmac.new(secret.encode(), body, hashlib.sha256).hexdigest()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -31,6 +54,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_PUT(self):
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        mac = self.headers.get(_MAC_HEADER, "")
+        if not hmac.compare_digest(
+            mac, _sign(self.server.secret, value)  # type: ignore[attr-defined]
+        ):
+            self.send_response(403)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self.server.kv[self._key()] = value  # type: ignore[attr-defined]
         self.send_response(200)
@@ -47,6 +78,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self.send_response(200)
         self.send_header("Content-Length", str(len(value)))
+        self.send_header(
+            _MAC_HEADER,
+            _sign(self.server.secret, value),  # type: ignore[attr-defined]
+        )
         self.end_headers()
         self.wfile.write(value)
 
@@ -59,12 +94,18 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class KVStoreServer:
-    """reference http_server.py `KVStoreServer` (threaded, start/stop)."""
+    """reference http_server.py `KVStoreServer` (threaded, start/stop).
 
-    def __init__(self, port: int = 0):
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+    ``bind_all=False`` (the all-local default) listens on loopback only."""
+
+    def __init__(self, port: int = 0, *, secret: Optional[str] = None,
+                 bind_all: bool = False):
+        host = "0.0.0.0" if bind_all else "127.0.0.1"
+        self.secret = secret or make_secret()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.kv = {}  # type: ignore[attr-defined]
         self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.secret = self.secret  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -86,34 +127,70 @@ class KVStoreServer:
 
 
 class KVStoreClient:
-    """reference http/http_client.py: put/get against the KV server."""
+    """reference http/http_client.py: authenticated put/get.
 
-    def __init__(self, addr: str):
+    404 means "not published yet" (wait() keeps polling); transport errors
+    carry the address so misconfiguration fails loudly, not as a generic
+    timeout."""
+
+    def __init__(self, addr: str, secret: Optional[str] = None):
         self._base = f"http://{addr}"
+        self._addr = addr
+        self._secret = secret or os.environ.get(SECRET_ENV, "")
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         req = Request(
             f"{self._base}/{scope}/{key}", data=value, method="PUT"
         )
-        urlopen(req, timeout=30).read()
+        req.add_header(_MAC_HEADER, _sign(self._secret, value))
+        try:
+            urlopen(req, timeout=30).read()
+        except HTTPError as e:
+            if e.code == 403:
+                raise PermissionError(
+                    f"KV store at {self._addr} rejected the payload signature"
+                ) from e
+            raise
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
+        """None = key not published yet; raises on transport failure."""
         try:
-            return urlopen(
-                f"{self._base}/{scope}/{key}", timeout=30
-            ).read()
-        except URLError:
-            return None
-        except Exception:
-            return None
+            resp = urlopen(f"{self._base}/{scope}/{key}", timeout=30)
+        except HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        except URLError as e:
+            raise ConnectionError(
+                f"cannot reach KV store at {self._addr}: {e.reason}"
+            ) from e
+        body = resp.read()
+        mac = resp.headers.get(_MAC_HEADER, "")
+        if not hmac.compare_digest(mac, _sign(self._secret, body)):
+            raise PermissionError(
+                f"KV store at {self._addr} returned a bad payload signature"
+            )
+        return body
 
     def wait(self, scope: str, key: str, timeout: float = 120.0) -> bytes:
-        import time
-
+        """Poll until published.  Transient transport errors are tolerated
+        for a short grace window (server may still be starting), then
+        surfaced with the address."""
         deadline = time.time() + timeout
+        grace = time.time() + 5.0
+        last_err: Optional[Exception] = None
         while time.time() < deadline:
-            value = self.get(scope, key)
+            try:
+                value = self.get(scope, key)
+            except ConnectionError as e:
+                if time.time() > grace:
+                    raise
+                last_err = e
+                value = None
             if value is not None:
                 return value
             time.sleep(0.1)
-        raise TimeoutError(f"KV key {scope}/{key} not published in {timeout}s")
+        raise TimeoutError(
+            f"KV key {scope}/{key} not published at {self._addr} within "
+            f"{timeout}s" + (f" (last error: {last_err})" if last_err else "")
+        )

@@ -20,11 +20,21 @@ from typing import Dict, List, Optional
 
 from ..utils.logging import get_logger
 from . import config_parser
-from .allocate import SlotInfo, allocate, parse_hostfile, parse_hosts
+from .allocate import (
+    SlotInfo,
+    allocate,
+    is_local_host,
+    parse_hostfile,
+    parse_hosts,
+)
 from .config_parser import _StoreOverrideAction, _StoreTrueOverrideAction
 from .exec import ProcessSet, make_ssh_command
 
 LOG = get_logger("run")
+
+# Fixed default for remote coordinators, where the launcher cannot probe a
+# free port on the target host; overridable with --coordinator-port.
+DEFAULT_COORDINATOR_PORT = 29500
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
@@ -51,6 +61,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     parser.add_argument(
         "--ssh-port", type=int, action=_StoreOverrideAction, dest="ssh_port"
+    )
+    parser.add_argument(
+        "--coordinator-port", type=int, action=_StoreOverrideAction,
+        dest="coordinator_port", default=None,
+        help=f"Port for the jax.distributed coordinator on the first host "
+             f"(default: probe a free port locally, {DEFAULT_COORDINATOR_PORT} "
+             f"when the first host is remote).",
     )
     parser.add_argument(
         "--start-timeout", type=int, action=_StoreOverrideAction,
@@ -198,6 +215,7 @@ def launch_job(
     ssh_port: Optional[int] = None,
     start_timeout: Optional[float] = None,
     job_timeout: Optional[float] = None,
+    coordinator_port: Optional[int] = None,
     tag_output: bool = True,
 ) -> Dict[int, int]:
     """Allocate slots, spawn workers, wait for completion (reference
@@ -215,11 +233,15 @@ def launch_job(
     slots = allocate(host_slots, np)
 
     first_host = slots[0].hostname
-    coord_host = (
-        "127.0.0.1" if first_host in ("localhost", "127.0.0.1")
-        else first_host
-    )
-    coordinator = f"{coord_host}:{_pick_free_port()}"
+    if is_local_host(first_host):
+        coord_host = "127.0.0.1"
+        port = coordinator_port or _pick_free_port()
+    else:
+        # The coordinator binds on the remote first host, where we cannot
+        # probe; use the fixed (overridable) port.
+        coord_host = first_host
+        port = coordinator_port or DEFAULT_COORDINATOR_PORT
+    coordinator = f"{coord_host}:{port}"
 
     base_env = dict(os.environ)
     if env:
@@ -228,10 +250,10 @@ def launch_job(
         base_env["HVDTPU_START_TIMEOUT"] = str(int(start_timeout))
 
     procs = ProcessSet()
+    procs.install_signal_handlers()
     for slot in slots:
         slot_env = build_slot_env(slot, coordinator, base_env)
-        local = slot.hostname in ("localhost", "127.0.0.1", socket.gethostname())
-        if local:
+        if is_local_host(slot.hostname):
             procs.launch(slot.rank, command, slot_env, tag_output=tag_output)
         else:
             # Remote slots go over ssh with env inlined (reference
@@ -270,12 +292,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not command:
         print("error: no command given", file=sys.stderr)
         return 2
+    if args.verbose and not args.log_level:
+        args.log_level = "debug"
     if args.log_level:
         os.environ["HVDTPU_LOG_LEVEL"] = args.log_level
 
     env: Dict[str, str] = {}
     config_parser.set_env_from_args(env, args)
     try:
+        LOG.info("launching %d processes: %s", args.np, " ".join(command))
         launch_job(
             command,
             args.np,
@@ -284,6 +309,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             env=env,
             ssh_port=args.ssh_port,
             start_timeout=args.start_timeout,
+            coordinator_port=args.coordinator_port,
         )
         return 0
     except (RuntimeError, ValueError, TimeoutError, OSError) as exc:

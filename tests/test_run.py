@@ -170,10 +170,36 @@ def test_kvstore_roundtrip():
     server = KVStoreServer()
     port = server.start()
     try:
-        client = KVStoreClient(f"127.0.0.1:{port}")
+        client = KVStoreClient(f"127.0.0.1:{port}", secret=server.secret)
         assert client.get("s", "missing") is None
         client.put("s", "k", b"payload")
         assert client.get("s", "k") == b"payload"
         assert client.wait("s", "k", timeout=1) == b"payload"
     finally:
         server.stop()
+
+
+def test_kvstore_rejects_unsigned_writes():
+    """The KV store carries pickles; an unauthenticated write would be
+    remote code execution (reference signs messages, run/common/util/
+    secret.py)."""
+    from horovod_tpu.run.rendezvous import KVStoreClient, KVStoreServer
+
+    server = KVStoreServer()
+    port = server.start()
+    try:
+        attacker = KVStoreClient(f"127.0.0.1:{port}", secret="wrong")
+        with pytest.raises(PermissionError, match="rejected"):
+            attacker.put("s", "k", b"evil")
+        good = KVStoreClient(f"127.0.0.1:{port}", secret=server.secret)
+        assert good.get("s", "k") is None  # nothing was stored
+    finally:
+        server.stop()
+
+
+def test_kvstore_transport_error_names_address():
+    from horovod_tpu.run.rendezvous import KVStoreClient
+
+    client = KVStoreClient("127.0.0.1:1", secret="x")  # nothing listens
+    with pytest.raises(ConnectionError, match="127.0.0.1:1"):
+        client.get("s", "k")
