@@ -1,0 +1,275 @@
+#include "ops.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+#include "dtype_math.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// Balanced chunk boundary: chunk i of `count` elements across `n` chunks.
+inline int64_t ChunkOff(int64_t count, int n, int i) {
+  return count * i / n;
+}
+
+}  // namespace
+
+Status RingAllreduce(TcpMesh* mesh, void* buf, int64_t count, DataType dtype,
+                     ReduceOp op) {
+  int n = mesh->size(), rank = mesh->rank();
+  if (n == 1 || count == 0) return Status::OK();
+  size_t elem = DataTypeSize(dtype);
+  uint8_t* b = static_cast<uint8_t*>(buf);
+  int next = (rank + 1) % n, prev = (rank - 1 + n) % n;
+
+  int64_t max_chunk = 0;
+  for (int i = 0; i < n; i++)
+    max_chunk = std::max(max_chunk, ChunkOff(count, n, i + 1) - ChunkOff(count, n, i));
+  std::vector<uint8_t> scratch(static_cast<size_t>(max_chunk) * elem);
+
+  // Reduce-scatter: after n-1 steps, chunk (rank+1)%n holds the full sum.
+  for (int step = 0; step < n - 1; step++) {
+    int send_c = (rank - step + n) % n;
+    int recv_c = (rank - step - 1 + n) % n;
+    int64_t so = ChunkOff(count, n, send_c), sl = ChunkOff(count, n, send_c + 1) - so;
+    int64_t ro = ChunkOff(count, n, recv_c), rl = ChunkOff(count, n, recv_c + 1) - ro;
+    Status s = mesh->SendRecv(next, b + so * elem, static_cast<size_t>(sl) * elem,
+                              prev, scratch.data(), static_cast<size_t>(rl) * elem);
+    if (!s.ok()) return s;
+    ReduceInto(dtype, op, b + ro * elem, scratch.data(), static_cast<size_t>(rl));
+  }
+  // Ring allgather of the reduced chunks.
+  for (int step = 0; step < n - 1; step++) {
+    int send_c = (rank + 1 - step + n) % n;
+    int recv_c = (rank - step + n) % n;
+    int64_t so = ChunkOff(count, n, send_c), sl = ChunkOff(count, n, send_c + 1) - so;
+    int64_t ro = ChunkOff(count, n, recv_c), rl = ChunkOff(count, n, recv_c + 1) - ro;
+    Status s = mesh->SendRecv(next, b + so * elem, static_cast<size_t>(sl) * elem,
+                              prev, b + ro * elem, static_cast<size_t>(rl) * elem);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status RingAllgatherv(TcpMesh* mesh, const void* send, void* recv,
+                      const std::vector<int64_t>& counts, DataType dtype) {
+  int n = mesh->size(), rank = mesh->rank();
+  size_t elem = DataTypeSize(dtype);
+  std::vector<int64_t> offs(n + 1, 0);
+  for (int i = 0; i < n; i++) offs[i + 1] = offs[i] + counts[i];
+  uint8_t* r = static_cast<uint8_t*>(recv);
+  std::memcpy(r + offs[rank] * elem, send,
+              static_cast<size_t>(counts[rank]) * elem);
+  if (n == 1) return Status::OK();
+  int next = (rank + 1) % n, prev = (rank - 1 + n) % n;
+  for (int step = 0; step < n - 1; step++) {
+    int send_b = (rank - step + n) % n;
+    int recv_b = (rank - step - 1 + n) % n;
+    Status s = mesh->SendRecv(
+        next, r + offs[send_b] * elem, static_cast<size_t>(counts[send_b]) * elem,
+        prev, r + offs[recv_b] * elem, static_cast<size_t>(counts[recv_b]) * elem);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+Status TreeBroadcast(TcpMesh* mesh, void* buf, int64_t count, DataType dtype,
+                     int root) {
+  int n = mesh->size(), rank = mesh->rank();
+  if (n == 1 || count == 0) return Status::OK();
+  size_t len = static_cast<size_t>(count) * DataTypeSize(dtype);
+  int vr = (rank - root + n) % n;
+  for (int mask = 1; mask < n; mask <<= 1) {
+    if (vr < mask) {
+      int peer_vr = vr + mask;
+      if (peer_vr < n) {
+        Status s = mesh->SendBytes((peer_vr + root) % n, buf, len);
+        if (!s.ok()) return s;
+      }
+    } else if (vr < 2 * mask) {
+      Status s = mesh->RecvBytes((vr - mask + root) % n, buf, len);
+      if (!s.ok()) return s;
+    }
+  }
+  return Status::OK();
+}
+
+Status PairwiseAlltoall(TcpMesh* mesh, const void* send, void* recv,
+                        int64_t chunk_elems, DataType dtype) {
+  int n = mesh->size(), rank = mesh->rank();
+  size_t chunk = static_cast<size_t>(chunk_elems) * DataTypeSize(dtype);
+  const uint8_t* s = static_cast<const uint8_t*>(send);
+  uint8_t* r = static_cast<uint8_t*>(recv);
+  std::memcpy(r + rank * chunk, s + rank * chunk, chunk);
+  for (int i = 1; i < n; i++) {
+    int to = (rank + i) % n, from = (rank - i + n) % n;
+    Status st = mesh->SendRecv(to, s + to * chunk, chunk, from,
+                               r + from * chunk, chunk);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+namespace {
+
+// Sequential binary-tree adasum over gathered rows — mirrors the Python
+// engine's _numpy_adasum_rows (ops/adasum.py) so both engines agree
+// bit-for-bit on the non-power-of-2 path.
+void TreeAdasum(std::vector<std::vector<double>>& rows, int lo, int hi,
+                std::vector<double>* out) {
+  if (hi - lo == 1) {
+    *out = rows[lo];
+    return;
+  }
+  int half = (hi - lo) / 2;
+  std::vector<double> a, b;
+  TreeAdasum(rows, lo, lo + half, &a);
+  TreeAdasum(rows, lo + half, hi, &b);
+  double dot = 0, na2 = 0, nb2 = 0;
+  for (size_t i = 0; i < a.size(); i++) {
+    dot += a[i] * b[i];
+    na2 += a[i] * a[i];
+    nb2 += b[i] * b[i];
+  }
+  double ac = 1.0 - dot / (2.0 * std::max(na2, 1e-30));
+  double bc = 1.0 - dot / (2.0 * std::max(nb2, 1e-30));
+  out->resize(a.size());
+  for (size_t i = 0; i < a.size(); i++) (*out)[i] = ac * a[i] + bc * b[i];
+}
+
+}  // namespace
+
+Status AdasumAllreduce(TcpMesh* mesh, void* buf, int64_t count,
+                       DataType dtype) {
+  int n = mesh->size(), rank = mesh->rank();
+  if (n == 1) return Status::OK();
+  std::vector<double> d(static_cast<size_t>(count));
+  ToDouble(dtype, buf, d.data(), static_cast<size_t>(count));
+
+  bool pow2 = (n & (n - 1)) == 0;
+  if (!pow2) {
+    // Gather rows to rank 0, binary-tree combine, broadcast back.
+    if (rank == 0) {
+      std::vector<std::vector<double>> rows(static_cast<size_t>(n));
+      rows[0] = d;
+      for (int r = 1; r < n; r++) {
+        rows[r].resize(static_cast<size_t>(count));
+        Status s = mesh->RecvBytes(r, rows[r].data(), rows[r].size() * 8);
+        if (!s.ok()) return s;
+      }
+      std::vector<double> out;
+      TreeAdasum(rows, 0, n, &out);
+      d = out;
+    } else {
+      Status s = mesh->SendBytes(0, d.data(), d.size() * 8);
+      if (!s.ok()) return s;
+    }
+    Status s = TreeBroadcast(mesh, d.data(), count, DataType::FLOAT64, 0);
+    if (!s.ok()) return s;
+    FromDouble(dtype, d.data(), buf, static_cast<size_t>(count));
+    return Status::OK();
+  }
+
+  // VHDD (reference ops/adasum/adasum.h:167-299): log2(n) halving levels
+  // with partner rank^distance, per-level full-vector dots via a recursive-
+  // doubling sum over the 2*distance-rank block, then the mirror doubling
+  // phase to reassemble the full vector.
+  int64_t start = 0, len = count;
+  std::vector<std::pair<int64_t, int64_t>> seg_stack;
+  std::vector<double> other;
+  for (int distance = 1; distance < n; distance <<= 1) {
+    int partner = rank ^ distance;
+    seg_stack.emplace_back(start, len);
+    int64_t h = len / 2;
+    int64_t my_start, my_len, send_off, send_len;
+    if (rank < partner) {  // keep first half, hand off second
+      my_start = start;
+      my_len = h;
+      send_off = start + h;
+      send_len = len - h;
+    } else {
+      my_start = start + h;
+      my_len = len - h;
+      send_off = start;
+      send_len = h;
+    }
+    other.resize(static_cast<size_t>(my_len));
+    Status s = mesh->SendRecv(partner, d.data() + send_off,
+                              static_cast<size_t>(send_len) * 8, partner,
+                              other.data(), static_cast<size_t>(my_len) * 8);
+    if (!s.ok()) return s;
+
+    // Partial inner products on my piece.  Orient (normA, normB) by block:
+    // the lower block's subtree vector is "A" group-wide, so upper-block
+    // ranks swap their locals before the group sum (reference adasum.h
+    // does the same reorientation before SumAllreduceWithComm).
+    double dot = 0, mine2 = 0, theirs2 = 0;
+    for (int64_t i = 0; i < my_len; i++) {
+      double a = d[static_cast<size_t>(my_start + i)];
+      double b = other[static_cast<size_t>(i)];
+      dot += a * b;
+      mine2 += a * a;
+      theirs2 += b * b;
+    }
+    bool lower = (rank & distance) == 0;
+    double triple[3] = {lower ? mine2 : theirs2, lower ? theirs2 : mine2, dot};
+    // Recursive-doubling sum across the 2*distance block (partners rank^bit
+    // all lie inside the block).
+    for (int bit = 1; bit < 2 * distance; bit <<= 1) {
+      int p = rank ^ bit;
+      double in[3];
+      Status st = mesh->SendRecv(p, triple, sizeof(triple), p, in, sizeof(in));
+      if (!st.ok()) return st;
+      triple[0] += in[0];
+      triple[1] += in[1];
+      triple[2] += in[2];
+    }
+    double normA = std::max(triple[0], 1e-30);
+    double normB = std::max(triple[1], 1e-30);
+    double full_dot = triple[2];
+    double coefA = 1.0 - full_dot / (2.0 * normA);
+    double coefB = 1.0 - full_dot / (2.0 * normB);
+    double my_coef = lower ? coefA : coefB;
+    double their_coef = lower ? coefB : coefA;
+    for (int64_t i = 0; i < my_len; i++) {
+      d[static_cast<size_t>(my_start + i)] =
+          my_coef * d[static_cast<size_t>(my_start + i)] +
+          their_coef * other[static_cast<size_t>(i)];
+    }
+    start = my_start;
+    len = my_len;
+  }
+
+  // Distance-doubling reassembly (mirror of the halving, reference
+  // adasum.h second phase): exchange my combined piece with the level's
+  // partner to rebuild the parent segment.
+  for (int distance = n >> 1; distance >= 1; distance >>= 1) {
+    int partner = rank ^ distance;
+    auto [pstart, plen] = seg_stack.back();
+    seg_stack.pop_back();
+    int64_t h = plen / 2;
+    int64_t their_off, their_len;
+    if (rank < partner) {
+      their_off = pstart + h;
+      their_len = plen - h;
+    } else {
+      their_off = pstart;
+      their_len = h;
+    }
+    Status s = mesh->SendRecv(partner, d.data() + start,
+                              static_cast<size_t>(len) * 8, partner,
+                              d.data() + their_off,
+                              static_cast<size_t>(their_len) * 8);
+    if (!s.ok()) return s;
+    start = pstart;
+    len = plen;
+  }
+
+  FromDouble(dtype, d.data(), buf, static_cast<size_t>(count));
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

@@ -51,11 +51,11 @@ def main():
     rng = jax.random.PRNGKey(1)
 
     x, y = synthetic_mnist()
-    # Step 2: shard the data by rank (each process keeps its slice; within
-    # the process, the mesh shards across local chips).
-    per = len(x) // hvd.size()
-    x = x[hvd.rank() * per : (hvd.rank() + 1) * per]
-    y = y[hvd.rank() * per : (hvd.rank() + 1) * per]
+    # Step 2: shard the data across workers.  On TPU the mesh IS the data
+    # sharding: every process builds the same global batch, and
+    # P(DP_AXIS) hands each chip its distinct row block — rank-slicing the
+    # dataset *as well* would shard twice and silently drop rows.
+    per = len(x)
 
     params = model.init(rng, jnp.asarray(x[:1]))
     # Step 4: broadcast initial state so all workers start identically.

@@ -15,14 +15,44 @@ _engine = None
 
 def get_engine():
     """Lazily start the eager engine (reference: InitializeHorovodOnce
-    spawning the background thread, horovod/common/operations.cc:604-650)."""
+    spawning the background thread, horovod/common/operations.cc:604-650).
+
+    Engine selection via ``HVDTPU_EAGER_ENGINE``:
+
+    * ``native`` — the C++ engine (cpp/hvdtpu via runtime/native.py); error
+      if the library isn't built.
+    * ``python`` — the pure-Python engine (runtime/engine.py).
+    * ``auto`` (default) — native when the library is built and the world
+      spans >1 process (a world of one short-circuits in Python for free);
+      Python otherwise.
+    """
     global _engine
     with _lock:
         if _engine is None:
-            from .runtime.engine import EagerEngine  # noqa: PLC0415
+            import os  # noqa: PLC0415
 
-            _engine = EagerEngine.start()
+            choice = os.environ.get("HVDTPU_EAGER_ENGINE", "auto").lower()
+            _engine = _make_engine(choice)
         return _engine
+
+
+def _make_engine(choice: str):
+    from .basics import global_topology  # noqa: PLC0415
+
+    world = global_topology().process_count
+    if choice == "native" or (choice == "auto" and world > 1):
+        from .runtime import native  # noqa: PLC0415
+
+        if native.native_available():
+            return native.NativeEngine()
+        if choice == "native":
+            raise RuntimeError(
+                "HVDTPU_EAGER_ENGINE=native but the native library is not "
+                f"built at {native.LIB_PATH}; run `make -C cpp`."
+            )
+    from .runtime.engine import EagerEngine  # noqa: PLC0415
+
+    return EagerEngine.start()
 
 
 def peek_engine() -> Optional[object]:

@@ -10,6 +10,7 @@ ICI domain) and the cross axis to one process per host (DCN)."""
 
 from __future__ import annotations
 
+import functools
 import re
 from dataclasses import dataclass
 from typing import Dict, List
@@ -21,11 +22,39 @@ class HostSlots:
     slots: int
 
 
-def is_local_host(name: str) -> bool:
-    """One definition of "this machine" for every launcher component."""
+@functools.lru_cache(maxsize=1)
+def _local_names() -> tuple:
+    # getfqdn() can block on DNS; both names are process-invariant, so
+    # resolve once (launch paths call is_local_host per host and per slot).
     import socket
 
-    return name in ("localhost", "127.0.0.1", socket.gethostname(), socket.getfqdn())
+    return ("localhost", "127.0.0.1", socket.gethostname(), socket.getfqdn())
+
+
+def is_local_host(name: str) -> bool:
+    """One definition of "this machine" for every launcher component."""
+    return name in _local_names()
+
+
+def routable_ip(probe_host: str) -> str:
+    """The local address a remote host would reach us on.  A connected UDP
+    socket never sends a packet but makes the kernel pick the outbound
+    interface — immune to the Debian /etc/hosts 127.0.1.1 hostname trap
+    that gethostbyname(gethostname()) falls into.  Shared by the launcher
+    (KV-store address) and the native engine's mesh rendezvous."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((probe_host, 9))
+        return s.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+    finally:
+        s.close()
 
 
 @dataclass(frozen=True)

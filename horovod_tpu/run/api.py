@@ -44,34 +44,14 @@ def _pickle_func(func, args, kwargs) -> bytes:
             cloudpickle.unregister_pickle_by_value(module)
 
 
-def _all_hosts_local(hosts: Optional[str], hostfile: Optional[str]) -> bool:
-    from .allocate import is_local_host, parse_hostfile, parse_hosts
+def _parse_host_slots(hosts: Optional[str], hostfile: Optional[str]) -> list:
+    from .allocate import parse_hostfile, parse_hosts
 
-    host_slots = (
-        parse_hostfile(hostfile)
-        if hostfile
-        else parse_hosts(hosts)
-        if hosts
-        else []
-    )
-    return all(is_local_host(h.hostname) for h in host_slots)
-
-
-def _routable_ip(probe_host: str) -> str:
-    """The local address a remote host would reach us on.  A connected UDP
-    socket never sends a packet but makes the kernel pick the outbound
-    interface — immune to the Debian /etc/hosts 127.0.1.1 hostname trap
-    that gethostbyname(gethostname()) falls into."""
-    import socket
-
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    try:
-        s.connect((probe_host, 9))
-        return s.getsockname()[0]
-    except OSError:
-        return socket.gethostbyname(socket.gethostname())
-    finally:
-        s.close()
+    if hostfile:
+        return parse_hostfile(hostfile)
+    if hosts:
+        return parse_hosts(hosts)
+    return []
 
 
 def run(
@@ -95,7 +75,10 @@ def run(
     launcher-level analog of the reference CI's "multi-process on localhost
     stands in for multi-node" strategy (SURVEY.md §4).
     """
-    all_local = _all_hosts_local(hosts, hostfile)
+    from .allocate import is_local_host, routable_ip
+
+    host_slots = _parse_host_slots(hosts, hostfile)
+    all_local = all(is_local_host(h.hostname) for h in host_slots)
     server = KVStoreServer(bind_all=not all_local)
     port = server.start()
     try:
@@ -103,16 +86,11 @@ def run(
         if all_local:
             server_addr = f"127.0.0.1:{port}"
         else:
-            from .allocate import is_local_host, parse_hostfile, parse_hosts
-
-            host_slots = (
-                parse_hostfile(hostfile) if hostfile else parse_hosts(hosts)
-            )
             probe = next(
                 (h.hostname for h in host_slots if not is_local_host(h.hostname)),
                 "127.0.0.1",
             )
-            server_addr = f"{_routable_ip(probe)}:{port}"
+            server_addr = f"{routable_ip(probe)}:{port}"
         client = KVStoreClient(f"127.0.0.1:{port}", secret=server.secret)
         client.put(_SCOPE, "func", payload)
 

@@ -55,9 +55,16 @@ class ProcessSet:
 
         def _handler(signum, frame):
             del frame
-            self.terminate()
-            signal.signal(signum, signal.SIG_DFL)
-            os.kill(os.getpid(), signum)
+            # terminate() takes self._lock, which the interrupted main
+            # thread may already hold (wait() polls under it) — and Python
+            # locks are not reentrant, so calling it here could deadlock.
+            # Do the work on a fresh thread and re-raise once it finishes.
+            def _term_and_reraise():
+                self.terminate()
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+            threading.Thread(target=_term_and_reraise, daemon=True).start()
 
         for sig in (signal.SIGTERM, signal.SIGHUP):
             try:
@@ -71,14 +78,19 @@ class ProcessSet:
         cmd: List[str],
         env: Dict[str, str],
         tag_output: bool = True,
+        stdin_data: Optional[bytes] = None,
     ) -> None:
         popen = subprocess.Popen(
             cmd,
             env=env,
+            stdin=subprocess.PIPE if stdin_data is not None else None,
             stdout=subprocess.PIPE if tag_output else None,
             stderr=subprocess.PIPE if tag_output else None,
             start_new_session=True,  # own process group for tree kill
         )
+        if stdin_data is not None:
+            popen.stdin.write(stdin_data)
+            popen.stdin.close()
         threads = []
         if tag_output:
             for pipe, sink in ((popen.stdout, sys.stdout), (popen.stderr, sys.stderr)):
@@ -145,17 +157,41 @@ class ProcessSet:
                     pass
 
 
-def make_ssh_command(host: str, cmd: List[str], env: Dict[str, str], ssh_port: Optional[int]) -> List[str]:
+# Env vars whose values must never appear on a command line (`ps` exposes
+# argv to every local user); they travel over the ssh channel's stdin.
+SENSITIVE_ENV = ("HVDTPU_SECRET",)
+
+
+def make_ssh_command(
+    host: str, cmd: List[str], env: Dict[str, str], ssh_port: Optional[int]
+) -> tuple:
     """Wrap a worker command for remote execution (reference
-    gloo_run.py:168-234 get_remote_command: env exported inline over ssh)."""
-    exports = " ".join(
-        f"{k}={_shquote(v)}" for k, v in sorted(env.items())
+    gloo_run.py:168-234 get_remote_command: env exported inline over ssh).
+
+    Returns ``(argv, stdin_data)``: sensitive values (the per-job HMAC
+    secret) are read by the remote shell from stdin — inlining them in the
+    argv would leak them via the process list on both ends."""
+    public = {k: v for k, v in env.items() if k not in SENSITIVE_ENV}
+    secret_items = [(k, env[k]) for k in SENSITIVE_ENV if k in env]
+    exports = " ".join(f"{k}={_shquote(v)}" for k, v in sorted(public.items()))
+    prelude = ""
+    stdin_data: Optional[bytes] = None
+    if secret_items:
+        reads = "; ".join(
+            f"IFS= read -r {k} && export {k}" for k, _ in secret_items
+        )
+        prelude = f"{reads}; "
+        stdin_data = (
+            "".join(f"{v}\n" for _, v in secret_items).encode() or None
+        )
+    remote = (
+        f"{prelude}cd {_shquote(os.getcwd())} && env {exports} "
+        f"{' '.join(_shquote(c) for c in cmd)}"
     )
-    remote = f"cd {_shquote(os.getcwd())} && env {exports} {' '.join(_shquote(c) for c in cmd)}"
     ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if ssh_port:
         ssh += ["-p", str(ssh_port)]
-    return ssh + [host, remote]
+    return ssh + [host, remote], stdin_data
 
 
 def _shquote(s: str) -> str:

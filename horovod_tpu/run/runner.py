@@ -264,11 +264,15 @@ def launch_job(
                 for k, v in slot_env.items()
                 if k.startswith(("HVDTPU_", "JAX_", "XLA_", "TPU_"))
             }
+            ssh_cmd, stdin_data = make_ssh_command(
+                slot.hostname, command, travel, ssh_port
+            )
             procs.launch(
                 slot.rank,
-                make_ssh_command(slot.hostname, command, travel, ssh_port),
+                ssh_cmd,
                 base_env,
                 tag_output=tag_output,
+                stdin_data=stdin_data,
             )
     return procs.wait(timeout=job_timeout)
 

@@ -15,6 +15,20 @@ import horovod_tpu.run as hvdrun
 pytestmark = pytest.mark.multiprocess
 
 
+@pytest.fixture(params=["python", "native"])
+def engine_env(request):
+    """Run each cross-process test under BOTH eager engines: the pure-Python
+    one (runtime/engine.py) and the native C++ one (cpp/hvdtpu via
+    runtime/native.py) — same tests, same assertions, mirroring how the
+    reference CI crosses its {mpi, gloo} backends (SURVEY.md §4)."""
+    if request.param == "native":
+        from horovod_tpu.runtime.native import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C cpp)")
+    return {"HVDTPU_EAGER_ENGINE": request.param}
+
+
 def _world_fn():
     import jax
     import numpy as np
@@ -30,8 +44,9 @@ def _world_fn():
     }
 
 
-def test_run_api_two_process_world():
-    results = hvdrun.run(_world_fn, np=2, use_cpu=True, timeout=180)
+def test_run_api_two_process_world(engine_env):
+    results = hvdrun.run(_world_fn, np=2, use_cpu=True, timeout=180,
+                         env=engine_env)
     assert [r["rank"] for r in results] == [0, 1]
     assert all(r["size"] == 2 for r in results)
     assert all(r["procs"] == 2 for r in results)
@@ -67,8 +82,9 @@ def _eager_ops_fn():
     return out
 
 
-def test_eager_collectives_across_processes():
-    results = hvdrun.run(_eager_ops_fn, np=2, use_cpu=True, timeout=180)
+def test_eager_collectives_across_processes(engine_env):
+    results = hvdrun.run(_eager_ops_fn, np=2, use_cpu=True, timeout=180,
+                         env=engine_env)
     for r in results:
         assert r["allreduce_sum"] == [3.0] * 4  # 1 + 2
         assert r["allreduce_avg"] == [1.5] * 4
@@ -100,8 +116,9 @@ def _join_fn():
     return sums
 
 
-def test_join_uneven_batches():
-    results = hvdrun.run(_join_fn, np=2, use_cpu=True, timeout=180)
+def test_join_uneven_batches(engine_env):
+    results = hvdrun.run(_join_fn, np=2, use_cpu=True, timeout=180,
+                         env=engine_env)
     # batch 0: both ranks -> 2.0; batches 1-2: only rank 0 (rank 1 joined,
     # contributes zeros) -> 1.0
     assert results[0] == [[2.0, 2.0], [1.0, 1.0], [1.0, 1.0]]
@@ -124,8 +141,9 @@ def _mismatch_fn():
         hvd.shutdown()
 
 
-def test_shape_mismatch_raises_on_all_ranks():
-    results = hvdrun.run(_mismatch_fn, np=2, use_cpu=True, timeout=180)
+def test_shape_mismatch_raises_on_all_ranks(engine_env):
+    results = hvdrun.run(_mismatch_fn, np=2, use_cpu=True, timeout=180,
+                         env=engine_env)
     for msg in results:
         assert "Mismatched shapes" in msg
 
@@ -158,8 +176,9 @@ def _broadcast_params_fn():
     }
 
 
-def test_broadcast_parameters_across_processes():
-    results = hvdrun.run(_broadcast_params_fn, np=2, use_cpu=True, timeout=180)
+def test_broadcast_parameters_across_processes(engine_env):
+    results = hvdrun.run(_broadcast_params_fn, np=2, use_cpu=True,
+                         timeout=180, env=engine_env)
     for r in results:
         assert r["w"] == [0.0, 0.0, 0.0]
         assert r["x"] == [0.0, 0.0]

@@ -1,0 +1,204 @@
+"""Native (C++) eager-engine tests beyond the shared 2-process matrix in
+test_multiprocess.py: 4-process worlds (ring schedules differ from the
+2-rank degenerate case), Adasum VHDD numerics against the NumPy reference
+(the reference strategy of test_adasum_pytorch.py), response-cache
+steady-state, dtype coverage incl. bfloat16, and timeline output."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu.run as hvdrun
+
+pytestmark = pytest.mark.multiprocess
+
+try:
+    from horovod_tpu.runtime.native import native_available
+except Exception:  # pragma: no cover
+    def native_available():
+        return False
+
+if not native_available():  # pragma: no cover
+    pytest.skip("native library not built (make -C cpp)", allow_module_level=True)
+
+ENV = {"HVDTPU_EAGER_ENGINE": "native"}
+
+
+def _four_rank_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    rng = np.random.RandomState(100 + r)
+    out = {"rank": r}
+
+    # Large-ish buffer so the ring actually chunks (4 chunks over 4 ranks).
+    big = rng.randn(1000).astype(np.float32)
+    out["big_sum"] = hvd.allreduce(big, op=hvd.Sum, name="big").tolist()
+    out["big_local"] = big.tolist()
+
+    # Adasum over 4 ranks: VHDD path (power of two).
+    ada = rng.randn(64).astype(np.float32)
+    out["ada"] = hvd.allreduce(ada, op=hvd.Adasum, name="ada").tolist()
+    out["ada_local"] = ada.tolist()
+
+    # dtype grid (reference test_torch.py crosses dtypes x dims).
+    for dtype in ["float64", "int32", "int64", "uint8"]:
+        x = (np.arange(6) % 5).astype(dtype) + r
+        out[f"sum_{dtype}"] = hvd.allreduce(
+            x, op=hvd.Sum, name=f"dt_{dtype}"
+        ).tolist()
+    import ml_dtypes
+
+    xb = np.asarray([1.5, 2.5, -3.0], ml_dtypes.bfloat16)
+    out["sum_bf16"] = [
+        float(v) for v in hvd.allreduce(xb, op=hvd.Sum, name="dt_bf16")
+    ]
+
+    # prescale/postscale (reference allreduce prescale_factor support).
+    from horovod_tpu.ops import eager
+
+    h = eager.allreduce_async(
+        np.full(3, 2.0, np.float32), op=hvd.Sum, name="scaled",
+        prescale_factor=0.5, postscale_factor=10.0,
+    )
+    out["scaled"] = eager.synchronize(h).tolist()
+
+    # barrier is collective and returns
+    eager.barrier()
+    out["barrier"] = True
+    hvd.shutdown()
+    return out
+
+
+def _numpy_adasum(rows):
+    def rec(vs):
+        if len(vs) == 1:
+            return vs[0]
+        half = len(vs) // 2
+        a, b = rec(vs[:half]), rec(vs[half:])
+        dot = float(np.dot(a, b))
+        na2 = max(float(np.dot(a, a)), 1e-30)
+        nb2 = max(float(np.dot(b, b)), 1e-30)
+        return (1 - dot / (2 * na2)) * a + (1 - dot / (2 * nb2)) * b
+
+    return rec([np.asarray(r, np.float64) for r in rows])
+
+
+def test_four_process_native_world():
+    results = hvdrun.run(_four_rank_fn, np=4, use_cpu=True, timeout=240,
+                         env=ENV)
+    locals_ = [np.asarray(r["big_local"], np.float32) for r in results]
+    expect = np.sum(locals_, axis=0)
+    for r in results:
+        np.testing.assert_allclose(
+            np.asarray(r["big_sum"], np.float32), expect, rtol=1e-5
+        )
+        assert r["scaled"] == [40.0, 40.0, 40.0]  # (2*0.5)*4ranks*10
+        assert r["barrier"] is True
+        assert r["sum_int32"] == (
+            ((np.arange(6) % 5)[None, :] + np.arange(4)[:, None]).sum(axis=0)
+        ).tolist()
+        np.testing.assert_allclose(
+            np.asarray(r["sum_bf16"]), [6.0, 10.0, -12.0], rtol=0.05
+        )
+
+    ada_rows = [np.asarray(r["ada_local"], np.float64) for r in results]
+    ada_expect = _numpy_adasum(ada_rows)
+    for r in results:
+        np.testing.assert_allclose(
+            np.asarray(r["ada"], np.float64), ada_expect, rtol=1e-4, atol=1e-5
+        )
+
+
+def _three_rank_adasum_fn():
+    # Non-power-of-2 world exercises the gather+tree fallback path.
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    v = np.asarray([1.0 + r, 2.0 * (r + 1), -1.0 * r], np.float32)
+    out = hvd.allreduce(v, op=hvd.Adasum, name="ada3").tolist()
+    hvd.shutdown()
+    return {"v": v.tolist(), "out": out}
+
+
+def test_three_process_adasum_fallback():
+    results = hvdrun.run(_three_rank_adasum_fn, np=3, use_cpu=True,
+                         timeout=240, env=ENV)
+    rows = [np.asarray(r["v"], np.float64) for r in results]
+    expect = _numpy_adasum(rows)
+    for r in results:
+        np.testing.assert_allclose(
+            np.asarray(r["out"], np.float64), expect, rtol=1e-4, atol=1e-5
+        )
+
+
+def _steady_state_fn():
+    # Same named tensors every "step": after step 1 every negotiation is a
+    # cache hit (reference response_cache.h steady-state bitvector path).
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    totals = []
+    for step in range(20):
+        hs = [
+            hvd.allreduce_async(
+                np.full(8, float(step + r + k), np.float32),
+                op=hvd.Average,
+                name=f"grad_{k}",
+            )
+            for k in range(5)
+        ]
+        totals.append(float(sum(hvd.synchronize(h).sum() for h in hs)))
+    hvd.shutdown()
+    return totals
+
+
+def test_response_cache_steady_state():
+    results = hvdrun.run(_steady_state_fn, np=2, use_cpu=True, timeout=240,
+                         env=ENV)
+    # avg over ranks r in {0,1} of (step + r + k): per k avg = step + 0.5 + k
+    expect = [
+        float(sum(8 * (step + 0.5 + k) for k in range(5)))
+        for step in range(20)
+    ]
+    for r in results:
+        np.testing.assert_allclose(r, expect, rtol=1e-6)
+
+
+def _timeline_fn():
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    for i in range(3):
+        hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name=f"t{i}")
+    hvd.shutdown()
+    return os.environ.get("HVDTPU_TIMELINE")
+
+
+def test_native_timeline_written(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    env = dict(ENV)
+    env["HVDTPU_TIMELINE"] = path
+    hvdrun.run(_timeline_fn, np=2, use_cpu=True, timeout=240, env=env)
+    # reference test_timeline.py: rank 0's JSON contains NEGOTIATE_ALLREDUCE
+    # and ALLREDUCE events.
+    with open(path) as f:
+        events = json.load(f)
+    cats = {e.get("cat") for e in events}
+    assert "NEGOTIATE_ALLREDUCE" in cats
+    assert "ALLREDUCE" in cats
