@@ -230,14 +230,14 @@ ResponseList Controller::ComputeResponseList(
     joined_ranks_.clear();
   }
 
-  CheckStalls(cache, should_shutdown);
+  CheckStalls(should_shutdown);
 
   if (shutdown_seen_) *should_shutdown = true;
   out.shutdown = *should_shutdown;
   return out;
 }
 
-void Controller::CheckStalls(ResponseCache* cache, bool* should_shutdown) {
+void Controller::CheckStalls(bool* should_shutdown) {
   // Reference stall_inspector.cc: rank 0 warns when a tensor has been
   // waiting on some ranks past the threshold; optionally escalates to a
   // coordinated shutdown; stalled cached tensors are invalidated.
@@ -261,7 +261,13 @@ void Controller::CheckStalls(ResponseCache* cache, bool* should_shutdown) {
             "some ranks have not yet done so after %.0f s: tensor %s is "
             "waiting on ranks [%s]",
             age, name.c_str(), missing.c_str());
-    if (cache) cache->Erase(name);
+    // NOTE: the reference invalidates stalled *cached* tensors here
+    // (stall_inspector InvalidateStalledCachedTensors), but it coordinates
+    // the eviction across ranks through the cache-bit sync.  Our stall check
+    // fires on rank-local wall clocks, so a local cache->Erase would free a
+    // slot on this rank only and desynchronize slot numbering across the
+    // job (slots are negotiated by id).  A stalled tensor is still pending
+    // negotiation — it has no cache entry to evict — so we only warn.
     if (cfg_.stall_shutdown_secs > 0 && age > cfg_.stall_shutdown_secs) {
       HVD_LOG(LogLevel::ERROR, 0,
               "Stalled tensor %s exceeded shutdown threshold (%.0f s); "

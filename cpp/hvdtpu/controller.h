@@ -69,7 +69,7 @@ class Controller {
 
   std::string Validate(const TableEntry& e) const;
   Response ConstructResponse(const TableEntry& e) const;
-  void CheckStalls(ResponseCache* cache, bool* should_shutdown);
+  void CheckStalls(bool* should_shutdown);
 
   Timeline* timeline_ = nullptr;
   ControllerConfig cfg_;

@@ -44,7 +44,15 @@ def _make_engine(choice: str):
         from .runtime import native  # noqa: PLC0415
 
         if native.native_available():
-            return native.NativeEngine()
+            import atexit  # noqa: PLC0415
+
+            eng = native.NativeEngine()
+            # Same guarantee the Python engine gives itself in start(): a
+            # script that exits without hvd.shutdown() still performs the
+            # coordinated shutdown cycle instead of vanishing mid-negotiation
+            # and killing its peers with transport errors.
+            atexit.register(eng.shutdown)
+            return eng
         if choice == "native":
             raise RuntimeError(
                 "HVDTPU_EAGER_ENGINE=native but the native library is not "

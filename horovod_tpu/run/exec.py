@@ -55,13 +55,15 @@ class ProcessSet:
 
         def _handler(signum, frame):
             del frame
+            # Restore the default disposition here: the handler runs on the
+            # main thread, and signal.signal() refuses any other thread.
+            signal.signal(signum, signal.SIG_DFL)
             # terminate() takes self._lock, which the interrupted main
             # thread may already hold (wait() polls under it) — and Python
             # locks are not reentrant, so calling it here could deadlock.
             # Do the work on a fresh thread and re-raise once it finishes.
             def _term_and_reraise():
                 self.terminate()
-                signal.signal(signum, signal.SIG_DFL)
                 os.kill(os.getpid(), signum)
 
             threading.Thread(target=_term_and_reraise, daemon=True).start()

@@ -1,0 +1,333 @@
+"""Runtime parameter autotuning for the eager engine.
+
+Reference: horovod/common/parameter_manager.cc (528 LoC) +
+optim/bayesian_optimization.cc + optim/gaussian_process.cc — the reference
+tunes {tensor-fusion threshold, cycle time, response-cache enabled,
+hierarchical allreduce/allgather} by scoring throughput (bytes/sec) per
+sample window and driving Bayesian optimization over a Gaussian process;
+rank 0 tunes and broadcasts the winning parameters to all ranks
+(controller.cc:33-47 SynchronizeParameters).
+
+TPU redesign: same tunables and the same GP/EI math, but in NumPy instead
+of Eigen+lbfgs (hyperparameters are picked by a small marginal-likelihood
+grid rather than L-BFGS — the search space is 2-D and tiny).  The
+categorical axes (cache on/off, hierarchical on/off) are explored as a
+deterministic chain, with the continuous (fusion, cycle) surface tuned by
+the GP within each category — mirroring the reference's
+CategoricalParameter / BayesianParameter split (parameter_manager.h:59-78).
+Parameter sync rides the negotiation: rank 0 attaches tuned params to its
+RequestList and every rank applies them on receipt (the descendant of the
+reference's param Bcast).
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Continuous search space (log-ish ranges chosen around the reference
+# defaults: fusion 64 MB, cycle 5 ms — operations.cc:419,427).
+FUSION_BOUNDS_MB = (1.0, 128.0)
+CYCLE_BOUNDS_MS = (1.0, 50.0)
+
+# Categorical exploration chain (reference explores hierarchical/cache
+# combinations; on TPU "hierarchical" selects the 2-level cross×local
+# reduction in the data plane).
+CATEGORIES: List[Dict[str, bool]] = [
+    {"cache_enabled": True, "hierarchical_allreduce": False},
+    {"cache_enabled": True, "hierarchical_allreduce": True},
+    {"cache_enabled": False, "hierarchical_allreduce": False},
+]
+
+DEFAULT_WARMUP_SAMPLES = 3  # discarded while pipelines fill (reference WARMUPS)
+DEFAULT_STEPS_PER_SAMPLE = 10  # negotiation cycles per score sample
+DEFAULT_BAYES_SAMPLES_PER_CATEGORY = 12
+GP_NOISE = 1e-6
+
+
+class GaussianProcess:
+    """GP regression with an RBF kernel (reference gaussian_process.cc).
+
+    Inputs are expected normalized to [0, 1]^d.  Hyperparameters
+    (signal variance, length scale) are selected by maximizing the log
+    marginal likelihood over a small grid — the reference fits them with
+    L-BFGS (vendored lbfgs); a grid is adequate for a 2-D tuner and keeps
+    this dependency-free.
+    """
+
+    def __init__(self, length_scale: float = 0.2, signal_var: float = 1.0,
+                 noise: float = 1e-4):
+        self.length_scale = length_scale
+        self.signal_var = signal_var
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray,
+                length_scale: float, signal_var: float) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return signal_var * np.exp(-0.5 * d2 / (length_scale ** 2))
+
+    def _log_marginal(self, x: np.ndarray, y: np.ndarray,
+                      length_scale: float, signal_var: float) -> float:
+        k = self._kernel(x, x, length_scale, signal_var)
+        k[np.diag_indices_from(k)] += self.noise
+        try:
+            chol = np.linalg.cholesky(k)
+        except np.linalg.LinAlgError:
+            return -np.inf
+        alpha = np.linalg.solve(chol.T, np.linalg.solve(chol, y))
+        return float(
+            -0.5 * y @ alpha
+            - np.log(np.diag(chol)).sum()
+            - 0.5 * len(y) * np.log(2 * np.pi)
+        )
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, float))
+        y = np.asarray(y, float).reshape(-1)
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        if len(y) >= 4:
+            best = (-np.inf, self.length_scale, self.signal_var)
+            for ls in (0.05, 0.1, 0.2, 0.4, 0.8):
+                for sv in (0.5, 1.0, 2.0):
+                    lm = self._log_marginal(x, yn, ls, sv)
+                    if lm > best[0]:
+                        best = (lm, ls, sv)
+            _, self.length_scale, self.signal_var = best
+        k = self._kernel(x, x, self.length_scale, self.signal_var)
+        k[np.diag_indices_from(k)] += self.noise
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, yn)
+        )
+        self._x = x
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior mean and stddev at x (denormalized to y's scale)."""
+        x = np.atleast_2d(np.asarray(x, float))
+        if self._x is None:
+            return (np.zeros(len(x)) + self._y_mean,
+                    np.ones(len(x)) * self._y_std)
+        ks = self._kernel(x, self._x, self.length_scale, self.signal_var)
+        mean = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.maximum(
+            self.signal_var - (v ** 2).sum(0), GP_NOISE
+        )
+        return (mean * self._y_std + self._y_mean,
+                np.sqrt(var) * self._y_std)
+
+
+class BayesianOptimization:
+    """Expected-improvement Bayesian optimization over [0,1]^d
+    (reference bayesian_optimization.cc: NextPoint via EI maximization)."""
+
+    def __init__(self, dims: int, seed: int = 0, xi: float = 0.01):
+        self.dims = dims
+        self.xi = xi
+        self._rng = np.random.RandomState(seed)
+        self._x: List[np.ndarray] = []
+        self._y: List[float] = []
+        self.gp = GaussianProcess()
+
+    def add_sample(self, x: np.ndarray, y: float) -> None:
+        self._x.append(np.asarray(x, float))
+        self._y.append(float(y))
+        self.gp.fit(np.stack(self._x), np.asarray(self._y))
+
+    def best(self) -> Tuple[np.ndarray, float]:
+        i = int(np.argmax(self._y))
+        return self._x[i], self._y[i]
+
+    def next_point(self) -> np.ndarray:
+        if len(self._y) < 2:
+            return self._rng.uniform(size=self.dims)
+        candidates = self._rng.uniform(size=(256, self.dims))
+        # seed the candidate pool near the incumbent too
+        bx, _ = self.best()
+        local = np.clip(
+            bx + self._rng.normal(scale=0.08, size=(64, self.dims)), 0, 1
+        )
+        candidates = np.concatenate([candidates, local])
+        mean, std = self.gp.predict(candidates)
+        y_best = max(self._y)
+        z = (mean - y_best - self.xi) / std
+        # EI = (mu - y* - xi) * Phi(z) + sigma * phi(z)
+        phi = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+        cdf = 0.5 * (1 + _erf(z / np.sqrt(2)))
+        ei = (mean - y_best - self.xi) * cdf + std * phi
+        return candidates[int(np.argmax(ei))]
+
+
+def _erf(x: np.ndarray) -> np.ndarray:
+    # Abramowitz & Stegun 7.1.26; |err| < 1.5e-7 — plenty for EI ranking.
+    sign = np.sign(x)
+    x = np.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    y = 1.0 - (((((1.061405429 * t - 1.453152027) * t) + 1.421413741)
+                * t - 0.284496736) * t + 0.254829592) * t * np.exp(-x * x)
+    return sign * y
+
+
+@dataclass
+class TunedParams:
+    """The parameter struct rank 0 ships to every rank each time the tuner
+    moves (reference Params struct, controller.cc:33-47)."""
+
+    fusion_bytes: int
+    cycle_s: float
+    cache_enabled: bool = True
+    hierarchical_allreduce: bool = False
+
+    def as_wire(self) -> tuple:
+        return (self.fusion_bytes, self.cycle_s, self.cache_enabled,
+                self.hierarchical_allreduce)
+
+    @staticmethod
+    def from_wire(t: tuple) -> "TunedParams":
+        return TunedParams(int(t[0]), float(t[1]), bool(t[2]), bool(t[3]))
+
+
+class ParameterManager:
+    """Owns the engine tunables and drives the score→tune loop
+    (reference parameter_manager.h:59-78,178-220).
+
+    Usage (engine, rank 0 only):
+        pm = ParameterManager(enabled=..., initial=TunedParams(...))
+        pm.record_bytes(n)                 # per executed response
+        new = pm.cycle()                   # per negotiation cycle;
+                                           # returns TunedParams when moved
+    """
+
+    def __init__(
+        self,
+        enabled: bool,
+        initial: TunedParams,
+        log_path: Optional[str] = None,
+        warmup_samples: int = DEFAULT_WARMUP_SAMPLES,
+        steps_per_sample: int = DEFAULT_STEPS_PER_SAMPLE,
+        samples_per_category: int = DEFAULT_BAYES_SAMPLES_PER_CATEGORY,
+        categories: Optional[List[Dict[str, bool]]] = None,
+    ):
+        # `categories` must list only configurations the owning engine
+        # actually consumes — every category costs a full Bayesian sweep,
+        # so exploring knobs with no consumer wastes 1/len(categories) of
+        # the tuning budget per phantom entry.
+        self.categories = CATEGORIES if categories is None else categories
+        self.enabled = enabled
+        self.current = initial
+        self.warmup_samples = warmup_samples
+        self.steps_per_sample = steps_per_sample
+        self.samples_per_category = samples_per_category
+        self._bytes = 0
+        self._steps = 0
+        self._sample_start = time.monotonic()
+        self._samples_seen = 0
+        self._category_i = 0
+        self._bayes = BayesianOptimization(dims=2, seed=0)
+        self._per_category_samples = 0
+        self._done = False
+        self._best: Tuple[float, TunedParams] = (-1.0, initial)
+        self._log_path = log_path
+        if log_path:
+            with open(log_path, "w", newline="") as f:
+                csv.writer(f).writerow(
+                    ["sample", "score_bytes_per_sec", "fusion_mb",
+                     "cycle_ms", "cache_enabled", "hierarchical_allreduce"]
+                )
+
+    # -------------------------------------------------------------- scoring
+
+    def record_bytes(self, n: int) -> None:
+        self._bytes += n
+
+    def cycle(self) -> Optional[TunedParams]:
+        """Advance one negotiation cycle; maybe emit new params to try."""
+        if not self.enabled or self._done:
+            return None
+        self._steps += 1
+        if self._steps < self.steps_per_sample:
+            return None
+        elapsed = time.monotonic() - self._sample_start
+        score = self._bytes / elapsed if elapsed > 0 else 0.0
+        self._bytes = 0
+        self._steps = 0
+        self._sample_start = time.monotonic()
+        self._samples_seen += 1
+        if self._samples_seen <= self.warmup_samples:
+            return None
+        return self._tune(score)
+
+    # --------------------------------------------------------------- tuning
+
+    def _norm(self, p: TunedParams) -> np.ndarray:
+        # Clamp into bounds before the log: params can start outside the
+        # search box (e.g. HVDTPU_FUSION_THRESHOLD=0 disables fusion, and
+        # log2(0) would poison the GP kernel with NaNs).
+        fmb = float(np.clip(p.fusion_bytes / (1024 * 1024), *FUSION_BOUNDS_MB))
+        cms = float(np.clip(p.cycle_s * 1000, *CYCLE_BOUNDS_MS))
+        return np.asarray([
+            (np.log2(fmb) - np.log2(FUSION_BOUNDS_MB[0]))
+            / (np.log2(FUSION_BOUNDS_MB[1]) - np.log2(FUSION_BOUNDS_MB[0])),
+            (np.log2(cms) - np.log2(CYCLE_BOUNDS_MS[0]))
+            / (np.log2(CYCLE_BOUNDS_MS[1]) - np.log2(CYCLE_BOUNDS_MS[0])),
+        ])
+
+    def _denorm(self, x: np.ndarray) -> Tuple[int, float]:
+        lf0, lf1 = np.log2(FUSION_BOUNDS_MB)
+        lc0, lc1 = np.log2(CYCLE_BOUNDS_MS)
+        fmb = 2.0 ** (lf0 + float(np.clip(x[0], 0, 1)) * (lf1 - lf0))
+        cms = 2.0 ** (lc0 + float(np.clip(x[1], 0, 1)) * (lc1 - lc0))
+        return int(fmb * 1024 * 1024), cms / 1000.0
+
+    def _tune(self, score: float) -> Optional[TunedParams]:
+        if score > self._best[0]:
+            self._best = (score, self.current)
+        self._log(score)
+        self._bayes.add_sample(self._norm(self.current), score)
+        self._per_category_samples += 1
+        if self._per_category_samples >= self.samples_per_category:
+            # advance the categorical chain; reset the continuous surface
+            self._category_i += 1
+            self._per_category_samples = 0
+            if self._category_i >= len(self.categories):
+                # converged: settle on the best configuration ever scored
+                self._done = True
+                self.current = self._best[1]
+                return self.current
+            self._bayes = BayesianOptimization(dims=2, seed=self._category_i)
+        fusion_bytes, cycle_s = self._denorm(self._bayes.next_point())
+        cat = self.categories[min(self._category_i, len(self.categories) - 1)]
+        self.current = TunedParams(
+            fusion_bytes=fusion_bytes, cycle_s=cycle_s, **cat
+        )
+        return self.current
+
+    @property
+    def converged(self) -> bool:
+        return self._done
+
+    def best_score(self) -> float:
+        return self._best[0]
+
+    def _log(self, score: float) -> None:
+        if not self._log_path:
+            return
+        p = self.current
+        with open(self._log_path, "a", newline="") as f:
+            csv.writer(f).writerow([
+                self._samples_seen, round(score, 1),
+                round(p.fusion_bytes / 1048576, 2),
+                round(p.cycle_s * 1000, 3),
+                int(p.cache_enabled), int(p.hierarchical_allreduce),
+            ])

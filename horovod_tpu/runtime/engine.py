@@ -43,6 +43,7 @@ from ..basics import global_topology
 from ..utils import env as envmod
 from ..utils.logging import get_logger
 from . import timeline as timeline_mod
+from .autotune import ParameterManager, TunedParams
 from .controller import ControllerState, compute_responses
 from .messages import Request, RequestList, RequestType, Response, ResponseType
 
@@ -64,6 +65,20 @@ DUPLICATE_NAME_ERROR = (
     "Requested to {op} a tensor with the same name as another tensor that is "
     "currently being processed.  (reference: common.h:161-164)"
 )
+
+
+def _response_bytes(resp: Response) -> int:
+    """Payload size of one (possibly fused) response, for autotune scoring
+    (reference scores bytes/sec per sample, parameter_manager.h:178-220)."""
+    shapes = getattr(resp, "_shapes", [])
+    dtype = getattr(resp, "_dtype", "float32")
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = 2  # bfloat16 etc.
+    return sum(
+        (int(np.prod(s)) if s else 1) * itemsize for s in shapes
+    )
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -118,6 +133,28 @@ class EagerEngine:
         self._done = False
         self._controller = ControllerState(world_size=self.world)
         self._thread: Optional[threading.Thread] = None
+
+        # Autotuner (reference parameter_manager.cc): rank 0 scores
+        # bytes/sec per sample window and proposes new params; peers apply
+        # whatever rides rank 0's RequestList.
+        self._pm: Optional[ParameterManager] = None
+        self._pending_params: Optional[tuple] = None
+        if self.rank == 0 and envmod.env_bool(envmod.AUTOTUNE):
+            import os  # noqa: PLC0415
+
+            self._pm = ParameterManager(
+                enabled=True,
+                initial=TunedParams(
+                    fusion_bytes=self.fusion_bytes, cycle_s=self.cycle_s
+                ),
+                log_path=os.environ.get(envmod.AUTOTUNE_LOG) or None,
+                # This engine consumes only the continuous knobs (fusion
+                # threshold, cycle time) — see _apply_params.  The cache /
+                # hierarchical categorical axes belong to engines with those
+                # code paths; listing them here would burn tuning budget on
+                # configurations that don't exist.
+                categories=[{}],
+            )
 
     # ------------------------------------------------------------------ API
 
@@ -237,8 +274,14 @@ class EagerEngine:
                 requests=requests,
                 shutdown=self._shutdown_requested,
                 joined=self._joined,
+                tuned_params=self._pending_params,
             )
+            self._pending_params = None
         all_lists = self._negotiate(rlist)
+        # Parameter sync: every rank (rank 0 included — it may have tuned
+        # last cycle) applies the params riding rank 0's list.
+        if all_lists[0].tuned_params is not None:
+            self._apply_params(TunedParams.from_wire(all_lists[0].tuned_params))
         responses, should_shutdown = compute_responses(
             self._controller,
             all_lists,
@@ -249,7 +292,19 @@ class EagerEngine:
         )
         for resp in responses:
             self._perform_operation(resp)
+        if self._pm is not None:
+            for resp in responses:
+                self._pm.record_bytes(_response_bytes(resp))
+            proposal = self._pm.cycle()
+            if proposal is not None:
+                self._pending_params = proposal.as_wire()
         return not should_shutdown
+
+    def _apply_params(self, p: TunedParams) -> None:
+        """Apply rank-0-tuned params (reference SynchronizeParameters,
+        controller.cc:33-47)."""
+        self.fusion_bytes = p.fusion_bytes
+        self.cycle_s = p.cycle_s
 
     # ---------------------------------------------------------- negotiation
 

@@ -63,11 +63,17 @@ class Request:
 
 @dataclass
 class RequestList:
-    """reference message.h:103-129: requests + shutdown flag."""
+    """reference message.h:103-129: requests + shutdown flag.
+
+    `tuned_params` is the TPU build's parameter-sync channel: rank 0's
+    autotuner attaches its current TunedParams wire tuple here and every
+    rank applies it after negotiation — the descendant of the reference's
+    rank-0 parameter Bcast (controller.cc:33-47 SynchronizeParameters)."""
 
     requests: List[Request] = field(default_factory=list)
     shutdown: bool = False
     joined: bool = False
+    tuned_params: Optional[tuple] = None
 
     def serialize(self) -> bytes:
         payload = (
@@ -87,13 +93,15 @@ class RequestList:
             ],
             self.shutdown,
             self.joined,
+            self.tuned_params,
         )
         return pickle.dumps(payload, protocol=4)
 
     @staticmethod
     def deserialize(data: bytes) -> "RequestList":
-        reqs, shutdown, joined = pickle.loads(data)
+        reqs, shutdown, joined, tuned = pickle.loads(data)
         return RequestList(
+            tuned_params=tuned,
             requests=[
                 Request(
                     request_rank=a,
