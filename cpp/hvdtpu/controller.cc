@@ -147,8 +147,7 @@ void FuseResponseList(std::vector<Response>* responses,
 }
 
 ResponseList Controller::ComputeResponseList(
-    const std::vector<RequestList>& lists, ResponseCache* cache,
-    bool* should_shutdown) {
+    const std::vector<RequestList>& lists, bool* should_shutdown) {
   ResponseList out;
 
   // Absorb join/shutdown flags (reference controller.cc:219-221,256-259).

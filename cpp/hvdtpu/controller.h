@@ -51,7 +51,6 @@ class Controller {
   // Sets *should_shutdown when any rank raised the flag or a stall
   // escalated.
   ResponseList ComputeResponseList(const std::vector<RequestList>& lists,
-                                   ResponseCache* cache,
                                    bool* should_shutdown);
 
   int joined_count() const { return static_cast<int>(joined_ranks_.size()); }

@@ -294,8 +294,7 @@ class Engine {
         }
       }
       bool should_shutdown = false;
-      rlist = controller_->ComputeResponseList(lists, cache_.get(),
-                                               &should_shutdown);
+      rlist = controller_->ComputeResponseList(lists, &should_shutdown);
       std::vector<uint8_t> out;
       SerializeResponseList(rlist, &out);
       for (int r = 1; r < size_; r++) {

@@ -61,14 +61,5 @@ void ResponseCache::Touch(uint32_t slot) {
   s.lru_it = lru_.begin();
 }
 
-void ResponseCache::Erase(const std::string& name) {
-  auto it = by_name_.find(name);
-  if (it == by_name_.end()) return;
-  Slot& s = slots_[it->second];
-  lru_.erase(s.lru_it);
-  s.live = false;
-  free_slots_.push_back(it->second);
-  by_name_.erase(it);
-}
 
 }  // namespace hvdtpu

@@ -46,7 +46,6 @@ class ResponseCache {
 
   // Drop a cached entry by name (stalled-tensor invalidation, reference
   // InvalidateStalledCachedTensors).
-  void Erase(const std::string& name);
 
   size_t size() const { return by_name_.size(); }
 

@@ -8,3 +8,4 @@ params, channel sizes that tile onto the 128x128 MXU."""
 
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
 from .simple import MLP, ConvNet  # noqa: F401
+from .transformer import GPT, GPT_CONFIGS, TransformerConfig, gpt  # noqa: F401

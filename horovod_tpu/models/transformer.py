@@ -1,0 +1,163 @@
+"""GPT-style transformer family, TPU-first.
+
+The long-context flagship of the model zoo (the reference's zoo is conv
+nets via tf_cnn_benchmarks; transformers are where TPU-native design —
+MXU-shaped matmuls, bf16 compute, flash/ring attention — pays off most).
+
+TPU-first choices:
+* bf16 compute / fp32 params and layer norms (MXU-native mixed precision).
+* Attention impl is pluggable per config:
+    - ``"flash"``     — the Pallas kernel (ops/flash_attention.py);
+    - ``"reference"`` — plain softmax attention (parallel/ring_attention.py
+      ``local_attention``), for tests and tiny shapes;
+    - ``"ring"``      — ring attention over a sequence-parallel mesh axis
+      (call the model inside shard_map with tokens sharded along seq);
+    - ``"ulysses"``   — all-to-all head-parallel attention over that axis.
+* Head dim and MLP width default to multiples of 128 (MXU lane width) at
+  the named sizes.
+* No data-dependent Python control flow — the whole forward is one traced
+  graph; sequence-parallel variants take a ``pos_offset`` so learned
+  positions index globally under sharding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    emb_dim: int = 768
+    mlp_ratio: int = 4
+    max_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"  # flash | reference | ring | ulysses
+    sp_axis: Optional[str] = None  # mesh axis for ring/ulysses
+    flash_block_q: int = 128
+    flash_block_k: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.emb_dim // self.num_heads
+
+
+def _attend(cfg: TransformerConfig, q, k, v, pos_offset):
+    """Dispatch to the configured attention schedule (always causal)."""
+    if cfg.attention_impl == "flash":
+        from ..ops.flash_attention import flash_attention  # noqa: PLC0415
+
+        return flash_attention(
+            q, k, v, causal=True,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+        )
+    if cfg.attention_impl == "ring":
+        from ..parallel.ring_attention import ring_attention  # noqa: PLC0415
+
+        if cfg.sp_axis is None:
+            raise ValueError("attention_impl='ring' requires sp_axis")
+        return ring_attention(q, k, v, cfg.sp_axis, causal=True)
+    if cfg.attention_impl == "ulysses":
+        from ..parallel.ring_attention import ulysses_attention  # noqa: PLC0415
+
+        if cfg.sp_axis is None:
+            raise ValueError("attention_impl='ulysses' requires sp_axis")
+        return ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+    if cfg.attention_impl != "reference":
+        raise ValueError(
+            f"unknown attention_impl {cfg.attention_impl!r}; expected "
+            f"'flash', 'reference', 'ring', or 'ulysses'"
+        )
+    from ..parallel.ring_attention import local_attention  # noqa: PLC0415
+
+    return local_attention(
+        q, k, v, causal=True, q_offset=pos_offset, kv_offset=pos_offset
+    )
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: LN → attn → +res, LN → MLP → +res."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, pos_offset):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        qkv = nn.Dense(3 * cfg.emb_dim, dtype=cfg.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, s, cfg.num_heads, cfg.head_dim)
+        att = _attend(
+            cfg, q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            pos_offset,
+        )
+        att = att.reshape(b, s, cfg.emb_dim)
+        x = x + nn.Dense(cfg.emb_dim, dtype=cfg.dtype, name="proj")(att)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.mlp_ratio * cfg.emb_dim, dtype=cfg.dtype,
+                     name="fc1")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(cfg.emb_dim, dtype=cfg.dtype, name="fc2")(h)
+        return x
+
+
+class GPT(nn.Module):
+    """Decoder-only causal LM.
+
+    ``tokens``: int32 ``[batch, seq]`` (local shard under sequence
+    parallelism); ``pos_offset``: global position of ``tokens[:, 0]`` —
+    pass ``axis_index(sp_axis) * local_seq`` inside shard_map.
+    Returns logits ``[batch, seq, vocab]`` in fp32.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        cfg = self.cfg
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.emb_dim, dtype=cfg.dtype, name="wte"
+        )(tokens)
+        pos_table = self.param(
+            "wpe",
+            nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.emb_dim),
+            jnp.float32,
+        )
+        s = tokens.shape[1]
+        pos = jax.lax.dynamic_slice_in_dim(pos_table, pos_offset, s, axis=0)
+        x = tok + pos.astype(cfg.dtype)[None]
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block{i}")(x, pos_offset)
+        x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=cfg.dtype, use_bias=False, name="head"
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+# Named sizes (GPT-2 family geometry; head_dim 64, MXU-friendly widths).
+GPT_CONFIGS = {
+    "nano": TransformerConfig(num_layers=3, num_heads=4, emb_dim=128,
+                              max_len=256, vocab_size=1024),
+    "small": TransformerConfig(num_layers=12, num_heads=12, emb_dim=768),
+    "medium": TransformerConfig(num_layers=24, num_heads=16, emb_dim=1024),
+    "large": TransformerConfig(num_layers=36, num_heads=20, emb_dim=1280),
+}
+
+
+def gpt(size: str = "small", **overrides) -> GPT:
+    """``gpt("small", attention_impl="ring", sp_axis="sp")`` etc."""
+    cfg = GPT_CONFIGS[size]
+    if overrides:
+        cfg = replace(cfg, **overrides)
+    return GPT(cfg)

@@ -1,5 +1,11 @@
 """Parallelism utilities: hierarchical (2-level) collectives over the
-cross x local mesh, cross-replica batch norm, and sharding helpers."""
+cross x local mesh, cross-replica batch norm, sequence/context parallelism
+(ring attention, Ulysses all-to-all), and sharding helpers."""
 
 from .hierarchical import hierarchical_allreduce  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    local_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401

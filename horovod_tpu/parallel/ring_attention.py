@@ -1,0 +1,183 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference framework is data-parallel only (SURVEY.md §2.9/§5.7 — no
+sequence parallelism exists in Horovod 0.19.1), but long-context scaling is
+first-class in the TPU build: sequences longer than one chip's HBM are
+sharded over a mesh axis and attention runs distributed.
+
+Two schedules, both called inside ``shard_map`` over a sequence axis:
+
+* :func:`ring_attention` — blockwise attention with an online softmax;
+  K/V blocks rotate around the ring via ``lax.ppermute`` while each device
+  keeps its Q shard.  Communication per step is one K/V block over ICI
+  (neighbor exchange), overlapping with the block matmul — the TPU-native
+  analog of Ring Attention (Liu et al.; see PAPERS.md), built on the same
+  collective the Adasum VHDD uses.  Memory per device is O(S/P), enabling
+  contexts P× longer than a single chip.
+
+* :func:`ulysses_attention` — all-to-all resharding (DeepSpeed-Ulysses
+  style): q/k/v flip from sequence-sharded to head-sharded with one
+  ``lax.all_to_all``, attention runs *unpartitioned* per head, and the
+  output flips back.  Two all-to-alls total; preferable when
+  num_heads >= axis size and ICI all-to-all bandwidth is plentiful.
+
+Both are reverse-mode differentiable (scan + ppermute/all_to_all have
+transpose rules), so they drop into a training step directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+
+
+def local_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=0,
+    kv_offset=0,
+) -> jax.Array:
+    """Plain softmax attention on local (unpartitioned) q/k/v.
+
+    Shapes ``[batch, seq, heads, head_dim]``.  ``q_offset``/``kv_offset``
+    are the global positions of the first local row — the causal mask is
+    computed in *global* coordinates so sharded callers get the right
+    triangle.  The single-device reference that the distributed schedules
+    must reproduce bit-for-bit (up to fp associativity).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        s = jnp.where(kv_pos[None, :] > q_pos[:, None], -jnp.inf, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention over a sequence-sharded mesh axis.
+
+    Call inside ``shard_map`` with q/k/v sharded along dim 1 (sequence)
+    over ``axis_name``; shapes ``[batch, seq_local, heads, head_dim]``.
+    Each of the P ring steps attends the local Q shard against one K/V
+    block, folds the result into an online-softmax accumulator, and
+    rotates the K/V block to the next neighbor with ``ppermute`` — the
+    classic flash-attention recurrence, distributed.
+
+    The causal mask is evaluated in global coordinates: at step t this
+    rank holds the block originally owned by rank ``(me - t) % P``, so a
+    whole block from a later rank masks to zero contribution and earlier
+    blocks pass through unmasked.
+    """
+    size = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale_ = scale if scale is not None else d ** -0.5
+    perm = [(j, (j + 1) % size) for j in range(size)]
+
+    qf = q.astype(jnp.float32)
+    q_pos = me * s_local + jnp.arange(s_local)
+
+    def step(carry, t):
+        k_blk, v_blk, o, m, l = carry
+        src = (me - t) % size  # original owner of the block in hand
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+            * scale_
+        )
+        if causal:
+            kv_pos = src * s_local + jnp.arange(s_local)
+            scores = jnp.where(
+                kv_pos[None, :] > q_pos[:, None], -jnp.inf, scores
+            )
+        m_new = jnp.maximum(m, scores.max(-1))
+        # exp(-inf - -inf) can only arise for a row with no unmasked key in
+        # ANY block so far; causal rings always see the self-block at t=0
+        # (the diagonal is unmasked), so m_new is finite from step 0 on.
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)  # [b,h,q]
+        l = l * corr + p.sum(-1)
+        o = (
+            o * corr.transpose(0, 2, 1)[..., None]
+            + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        )
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, o, m_new, l), None
+
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    (k_, v_, o, m, l), _ = lax.scan(
+        step, (k, v, o0, m0, l0), jnp.arange(size)
+    )
+    del k_, v_, m
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ulysses-style sequence parallelism: reshard seq→heads, attend, flip
+    back.
+
+    Call inside ``shard_map`` with q/k/v sharded along dim 1 (sequence);
+    shapes ``[batch, seq_local, heads, head_dim]`` with
+    ``heads % axis_size == 0``.  One all-to-all turns the layout into
+    full-sequence × heads/P, attention runs unpartitioned per head (the
+    causal triangle needs no coordinate bookkeeping), and a second
+    all-to-all restores sequence sharding.
+    """
+    size = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % size != 0:
+        raise ValueError(
+            f"ulysses_attention requires heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({size}); use ring_attention for "
+            f"head counts smaller than the mesh axis."
+        )
+
+    def seq_to_heads(x):
+        # [b, s/P, h, d] -> [b, s, h/P, d]
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    out = local_attention(
+        seq_to_heads(q),
+        seq_to_heads(k),
+        seq_to_heads(v),
+        causal=causal,
+        scale=scale,
+    )
+    return heads_to_seq(out)

@@ -71,11 +71,7 @@ def _response_bytes(resp: Response) -> int:
     """Payload size of one (possibly fused) response, for autotune scoring
     (reference scores bytes/sec per sample, parameter_manager.h:178-220)."""
     shapes = getattr(resp, "_shapes", [])
-    dtype = getattr(resp, "_dtype", "float32")
-    try:
-        itemsize = np.dtype(dtype).itemsize
-    except TypeError:
-        itemsize = 2  # bfloat16 etc.
+    itemsize = _np_dtype(getattr(resp, "_dtype", "float32")).itemsize
     return sum(
         (int(np.prod(s)) if s else 1) * itemsize for s in shapes
     )

@@ -1,0 +1,185 @@
+"""Flash-attention kernel + transformer model tests.
+
+The Pallas kernel runs through the interpreter on the CPU test mesh
+(identical program, no TPU needed); correctness is against the plain
+softmax reference, gradients included — the kernel is advertised as
+training-ready.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.models import TransformerConfig, gpt
+from horovod_tpu.ops.flash_attention import flash_attention
+from horovod_tpu.parallel import local_attention
+
+
+def _qkv(b=2, s=64, h=4, d=16, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d), dtype) * 0.3
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        ref = local_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+
+    def test_uneven_blocks(self):
+        # S=48 forces _pick_block to drop to a divisor
+        q, k, v = _qkv(s=48, seed=1)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        ref = local_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+        )
+
+    def test_grads_match_reference(self):
+        q, k, v = _qkv(seed=2)
+        f = lambda *a: (
+            flash_attention(*a, causal=True, block_q=16, block_k=16) ** 2
+        ).sum()
+        r = lambda *a: (local_attention(*a, causal=True) ** 2).sum()
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+            )
+
+    def test_bf16_inputs(self):
+        q, k, v = _qkv(seed=3, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        assert out.dtype == jnp.bfloat16
+        ref = local_attention(
+            *(x.astype(jnp.float32) for x in (q, k, v)), causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=0.05,
+            rtol=0.05,
+        )
+
+    def test_shape_mismatch_rejected(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="matching"):
+            flash_attention(q, k[:, :32], v)
+
+
+class TestGPT:
+    def _cfg(self, **kw):
+        return dict(size="nano", flash_block_q=16, flash_block_k=16, **kw)
+
+    def test_forward_shapes_and_finite(self):
+        model = gpt(**self._cfg())
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 1024, (2, 32))
+        )
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 32, 1024)
+        assert logits.dtype == jnp.float32
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_flash_equals_reference_impl(self):
+        tokens = jnp.asarray(
+            np.random.RandomState(1).randint(0, 1024, (2, 32))
+        )
+        m_flash = gpt(**self._cfg(attention_impl="flash",
+                                  dtype=jnp.float32))
+        m_ref = gpt(**self._cfg(attention_impl="reference",
+                                dtype=jnp.float32))
+        params = m_flash.init(jax.random.PRNGKey(0), tokens)
+        np.testing.assert_allclose(
+            np.asarray(m_flash.apply(params, tokens)),
+            np.asarray(m_ref.apply(params, tokens)),
+            atol=2e-4, rtol=2e-4,
+        )
+
+    def test_causality(self):
+        """Changing a future token must not change past logits."""
+        model = gpt(**self._cfg(dtype=jnp.float32))
+        rng = np.random.RandomState(2)
+        t1 = rng.randint(0, 1024, (1, 16))
+        t2 = t1.copy()
+        t2[0, -1] = (t2[0, -1] + 1) % 1024
+        params = model.init(jax.random.PRNGKey(0), jnp.asarray(t1))
+        l1 = model.apply(params, jnp.asarray(t1))
+        l2 = model.apply(params, jnp.asarray(t2))
+        np.testing.assert_allclose(
+            np.asarray(l1[:, :-1]), np.asarray(l2[:, :-1]), atol=1e-5
+        )
+        assert np.abs(np.asarray(l1[:, -1]) - np.asarray(l2[:, -1])).max() > 1e-3
+
+    def test_sequence_parallel_training_step(self):
+        """One GPT training step with ring attention over an 8-way
+        sequence-parallel mesh matches the single-device step."""
+        S = 64
+        cfg_sp = self._cfg(attention_impl="ring", sp_axis="sp",
+                           dtype=jnp.float32)
+        cfg_1d = self._cfg(attention_impl="reference", dtype=jnp.float32)
+        model_sp, model_1d = gpt(**cfg_sp), gpt(**cfg_1d)
+        tokens = jnp.asarray(np.random.RandomState(3).randint(0, 1024, (2, S)))
+        targets = jnp.roll(tokens, -1, axis=1)
+        params = model_1d.init(jax.random.PRNGKey(0), tokens[:, :8])
+
+        def loss_1d(p):
+            logits = model_1d.apply(p, tokens)
+            return -jnp.take_along_axis(
+                jax.nn.log_softmax(logits), targets[..., None], -1
+            ).mean()
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("sp",))
+        s_local = S // 8
+
+        def local_loss(p, tok, tgt):
+            off = jax.lax.axis_index("sp") * s_local
+            logits = model_sp.apply(p, tok, pos_offset=off)
+            nll = -jnp.take_along_axis(
+                jax.nn.log_softmax(logits), tgt[..., None], -1
+            ).mean()
+            return jax.lax.pmean(nll, "sp")
+
+        loss_sp = jax.jit(
+            shard_map(
+                local_loss,
+                mesh=mesh,
+                in_specs=(P(), P(None, "sp"), P(None, "sp")),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        l1, g1 = jax.value_and_grad(loss_1d)(params)
+        l2 = loss_sp(params, tokens, targets)
+        np.testing.assert_allclose(float(l1), float(l2), atol=1e-5, rtol=1e-5)
+        g2 = jax.grad(
+            lambda p: loss_sp(p, tokens, targets)
+        )(params)
+        flat1 = jax.tree_util.tree_leaves(g1)
+        flat2 = jax.tree_util.tree_leaves(g2)
+        for a, b in zip(flat2, flat1):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+            )
+
+    def test_ring_requires_axis(self):
+        with pytest.raises(ValueError, match="sp_axis"):
+            cfg = TransformerConfig(attention_impl="ring")
+            _attend_probe(cfg)
+
+
+def _attend_probe(cfg):
+    from horovod_tpu.models.transformer import _attend
+
+    x = jnp.zeros((1, 8, cfg.num_heads, cfg.head_dim))
+    _attend(cfg, x, x, x, 0)

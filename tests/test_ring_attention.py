@@ -1,0 +1,147 @@
+"""Sequence-parallel attention tests.
+
+Strategy (SURVEY.md §4 lesson): run the real SPMD schedule on the 8-device
+virtual CPU mesh and compare bit-level behavior against the single-device
+reference (`local_attention`) — no mocks.  Gradients are compared too,
+since both schedules are advertised as training-ready.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import (
+    local_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+B, S, H, D = 2, 32, 8, 16  # global seq 32 over 8 devices = 4 per shard
+AXIS = "sp"
+
+
+def _qkv(seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, S, H, D), dtype) * 0.3
+    return mk(), mk(), mk()
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:8]), (AXIS,))
+
+
+def _sharded(fn, **kw):
+    spec = P(None, AXIS)  # shard dim 1 (sequence)
+    return jax.jit(
+        shard_map(
+            lambda q, k, v: fn(q, k, v, AXIS, **kw),
+            mesh=_mesh(),
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_local_attention(self, causal):
+        q, k, v = _qkv()
+        ref = local_attention(q, k, v, causal=causal)
+        out = _sharded(ring_attention, causal=causal)(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_grads_match(self):
+        q, k, v = _qkv(seed=1)
+        sharded = _sharded(ring_attention, causal=True)
+
+        def loss_ref(q, k, v):
+            return (local_attention(q, k, v, causal=True) ** 2).sum()
+
+        def loss_ring(q, k, v):
+            return (sharded(q, k, v) ** 2).sum()
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+            )
+
+    def test_bfloat16_io(self):
+        q, k, v = _qkv(seed=2, dtype=jnp.bfloat16)
+        out = _sharded(ring_attention, causal=True)(q, k, v)
+        assert out.dtype == jnp.bfloat16
+        ref = local_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=0.05, rtol=0.05
+        )
+
+    def test_long_context_memory_shape(self):
+        """Each shard only ever materializes S/P-sized score blocks — the
+        schedule compiles with per-device attention matrices of
+        (s_local, s_local), not (S, S)."""
+        q, k, v = _qkv(seed=3)
+        fn = _sharded(ring_attention, causal=False)
+        compiled = fn.lower(q, k, v).compile()
+        # sanity: it runs; the (S,S) matrix never exists on one device by
+        # construction of the scan (block is (B,H,4,4) here)
+        out = compiled(q, k, v)
+        assert out.shape == (B, S, H, D)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_local_attention(self, causal):
+        q, k, v = _qkv(seed=4)
+        ref = local_attention(q, k, v, causal=causal)
+        out = _sharded(ulysses_attention, causal=causal)(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_grads_match(self):
+        q, k, v = _qkv(seed=5)
+        sharded = _sharded(ulysses_attention, causal=True)
+        g_ref = jax.grad(
+            lambda *a: (local_attention(*a, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_uly = jax.grad(
+            lambda *a: (sharded(*a) ** 2).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g_uly, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4
+            )
+
+    def test_head_divisibility_error(self):
+        q = jnp.zeros((1, 8, 6, 4))  # 6 heads over 8 devices
+        with pytest.raises(ValueError, match="divisible"):
+            _sharded(ulysses_attention)(q, q, q)
+
+
+class TestLocalAttentionOffsets:
+    def test_global_causal_offsets(self):
+        """q_offset/kv_offset place the causal triangle in global coords."""
+        q, k, v = _qkv(seed=6)
+        full = local_attention(q, k, v, causal=True)
+        # second half of queries attending the full key set
+        half = local_attention(
+            q[:, S // 2:], k, v, causal=True, q_offset=S // 2, kv_offset=0
+        )
+        np.testing.assert_allclose(
+            np.asarray(half), np.asarray(full[:, S // 2:]), atol=2e-5,
+            rtol=2e-5,
+        )
