@@ -44,9 +44,6 @@ class ResponseCache {
   // Mark slot most-recently-used (call when a cached response executes).
   void Touch(uint32_t slot);
 
-  // Drop a cached entry by name (stalled-tensor invalidation, reference
-  // InvalidateStalledCachedTensors).
-
   size_t size() const { return by_name_.size(); }
 
  private:

@@ -75,6 +75,14 @@ def __getattr__(name):
         from .ops.compression import Compression  # noqa: PLC0415
 
         return Compression
+    if name in ("IndexedSlices", "allreduce_sparse", "sparse_to_dense"):
+        from .ops import sparse as _sparse  # noqa: PLC0415
+
+        return {
+            "IndexedSlices": _sparse.IndexedSlices,
+            "allreduce_sparse": _sparse.allreduce_sparse,
+            "sparse_to_dense": _sparse.to_dense,
+        }[name]
     if name in (
         "allreduce_async",
         "allreduce_async_",

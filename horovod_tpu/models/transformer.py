@@ -134,6 +134,12 @@ class GPT(nn.Module):
             jnp.float32,
         )
         s = tokens.shape[1]
+        if s > cfg.max_len:
+            # dynamic_slice clamps out-of-range starts silently, which would
+            # reuse trailing position rows; fail at trace time instead.
+            raise ValueError(
+                f"sequence length {s} exceeds max_len={cfg.max_len}"
+            )
         pos = jax.lax.dynamic_slice_in_dim(pos_table, pos_offset, s, axis=0)
         x = tok + pos.astype(cfg.dtype)[None]
         for i in range(cfg.num_layers):

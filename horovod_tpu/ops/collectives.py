@@ -146,8 +146,46 @@ def allreduce(
 
     Works on a single array or an arbitrary pytree (each leaf reduced).
     Under tracing this is a psum over ``axis_name``; on concrete arrays it
-    routes through the eager engine (named-tensor negotiation).
+    routes through the eager engine (named-tensor negotiation).  An
+    ``IndexedSlices`` input takes the sparse allgather path (reference:
+    horovod/tensorflow/__init__.py:74-89).
     """
+    from .sparse import IndexedSlices, allreduce_sparse  # noqa: PLC0415
+
+    def _sparse(s, suffix=""):
+        return allreduce_sparse(
+            s,
+            op,
+            axis_name=axis_name,
+            name=(f"{name}{suffix}" if name else None),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+
+    if isinstance(tensor, IndexedSlices):
+        return _sparse(tensor)
+    s_leaves, s_treedef = jax.tree_util.tree_flatten(
+        tensor, is_leaf=lambda x: isinstance(x, IndexedSlices)
+    )
+    if any(isinstance(l, IndexedSlices) for l in s_leaves):
+        # Mixed pytree: sparse leaves take the allgather path, dense leaves
+        # recurse onto the ordinary reduce (an IndexedSlices is itself a
+        # NamedTuple pytree, so without is_leaf it would be flattened and
+        # its integer indices psum'd into garbage).
+        outs = [
+            _sparse(l, suffix=f".{i}")
+            if isinstance(l, IndexedSlices)
+            else allreduce(
+                l,
+                op,
+                axis_name=axis_name,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                name=(f"{name}.{i}" if name else None),
+            )
+            for i, l in enumerate(s_leaves)
+        ]
+        return jax.tree_util.tree_unflatten(s_treedef, outs)
     if not _is_traced(tensor):
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415

@@ -49,6 +49,7 @@ def DistributedGradientTransform(
     compression=Compression.none,
     gradient_predivide_factor: float = 1.0,
     groups: Optional[int] = None,
+    sparse_as_dense: bool = True,
 ) -> optax.GradientTransformation:
     """An optax transform that allreduces grads across the mesh axis.
 
@@ -63,6 +64,11 @@ def DistributedGradientTransform(
     ``groups``: number of fusion groups for grouped_allreduce (None = one
     fused reduce per dtype across the whole pytree, the analog of the 64 MB
     fusion buffer, fusion_buffer_manager.cc).
+    ``sparse_as_dense``: IndexedSlices gradient leaves are scatter-added to
+    dense before the reduce (reference DistributedOptimizer's
+    sparse_as_dense option); with False they take the allgather path
+    (horovod/tensorflow/__init__.py:74-89) and stay sparse in the output —
+    only meaningful when the downstream optimizer knows how to apply them.
     """
     if op not in (Average, Sum, Adasum):
         raise ValueError(f"DistributedGradientTransform supports Average/Sum/Adasum, got {op!r}")
@@ -82,7 +88,39 @@ def DistributedGradientTransform(
 
     def update_fn(updates, state, params=None):
         del params
-        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        from ..ops.sparse import (  # noqa: PLC0415
+            IndexedSlices,
+            allreduce_sparse,
+            to_dense,
+        )
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            updates, is_leaf=lambda x: isinstance(x, IndexedSlices)
+        )
+        sparse_out = {}
+        dense_idx = []
+        dense_leaves = []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, IndexedSlices):
+                if sparse_as_dense:
+                    dense_idx.append(i)
+                    dense_leaves.append(to_dense(leaf))
+                else:
+                    if op == Adasum:
+                        # Reference parity: Adasum rejects sparse tensors
+                        # (horovod/torch/mpi_ops.py Adasum+sparse raises).
+                        raise ValueError(
+                            "Adasum does not support sparse (IndexedSlices) "
+                            "gradients; use sparse_as_dense=True or "
+                            "op=Average/Sum."
+                        )
+                    sparse_out[i] = allreduce_sparse(
+                        leaf, op, axis_name=axis_name
+                    )
+            else:
+                dense_idx.append(i)
+                dense_leaves.append(leaf)
+        leaves = dense_leaves
         wire, ctxs = [], []
         for leaf in leaves:
             w, c = compression.compress(leaf)
@@ -104,9 +142,14 @@ def DistributedGradientTransform(
                 prescale_factor=pre,
                 postscale_factor=post_local,
             )
-        out = [
+        reduced_dense = [
             compression.decompress(r, c) for r, c in zip(reduced, ctxs)
         ]
+        out = [None] * (len(reduced_dense) + len(sparse_out))
+        for i, r in zip(dense_idx, reduced_dense):
+            out[i] = r
+        for i, s in sparse_out.items():
+            out[i] = s
         return jax.tree_util.tree_unflatten(treedef, out), state
 
     return optax.GradientTransformation(init_fn, update_fn)

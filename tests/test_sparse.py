@@ -1,0 +1,185 @@
+"""Sparse (IndexedSlices) gradient collectives.
+
+Models the reference's sparse tests (test/test_tensorflow.py
+horovod_allreduce IndexedSlices cases): allreduce of an IndexedSlices is an
+allgather of values+indices (horovod/tensorflow/__init__.py:74-89), and
+sparse_as_dense densifies before the wire."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.sparse import IndexedSlices, allreduce_sparse, to_dense
+
+N = 8
+
+
+def test_to_dense_scatter_adds_duplicates():
+    s = IndexedSlices(
+        values=jnp.array([[1.0, 2.0], [3.0, 4.0], [10.0, 10.0]]),
+        indices=jnp.array([1, 1, 3]),
+        dense_shape=(5, 2),
+    )
+    dense = to_dense(s)
+    np.testing.assert_allclose(
+        np.asarray(dense),
+        [[0, 0], [4, 6], [0, 0], [10, 10], [0, 0]],
+    )
+
+
+@pytest.mark.parametrize("op", [hvd.Average, hvd.Sum])
+def test_allreduce_sparse_spmd(op):
+    rows, dim, per_rank = 16, 4, 3
+    rng = np.random.RandomState(0)
+    values = rng.randn(N, per_rank, dim).astype(np.float32)
+    indices = rng.randint(0, rows, size=(N, per_rank)).astype(np.int32)
+
+    mesh = hvd.mesh("flat")
+
+    def step(v, i):
+        s = IndexedSlices(v[0], i[0], (rows, dim))
+        out = hvd.allreduce(s, op)
+        return to_dense(out)[None]
+
+    out = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+        out_specs=P(hvd.DP_AXIS),
+    )(values, indices)
+
+    expect = np.zeros((rows, dim), np.float32)
+    for r in range(N):
+        for k in range(per_rank):
+            expect[indices[r, k]] += values[r, k]
+    if op == hvd.Average:
+        expect /= N
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out[r]), expect, rtol=1e-5)
+
+
+def test_allreduce_mixed_pytree_with_sparse_leaf():
+    """A nested IndexedSlices must take the sparse path, not be flattened
+    into its fields (which would psum integer indices into garbage)."""
+    rows, dim, per_rank = 8, 2, 2
+    rng = np.random.RandomState(3)
+    values = rng.randn(N, per_rank, dim).astype(np.float32)
+    indices = rng.randint(0, rows, size=(N, per_rank)).astype(np.int32)
+
+    mesh = hvd.mesh("flat")
+
+    def step(v, i):
+        tree = {
+            "emb": IndexedSlices(v[0], i[0], (rows, dim)),
+            "w": jnp.ones((dim,)),
+        }
+        out = hvd.allreduce(tree, hvd.Sum)
+        s = out["emb"]
+        assert isinstance(s, IndexedSlices)
+        assert s.dense_shape == (rows, dim)  # not psum'd
+        return to_dense(s)[None], out["w"][None]
+
+    dense, w = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+        out_specs=(P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+    )(values, indices)
+
+    expect = np.zeros((rows, dim), np.float32)
+    for r in range(N):
+        for k in range(per_rank):
+            expect[indices[r, k]] += values[r, k]
+    np.testing.assert_allclose(np.asarray(dense[0]), expect, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(w[0]), np.full(dim, float(N)))
+
+
+def test_adasum_sparse_raises_without_densify():
+    tx = hvd.DistributedGradientTransform(
+        op=hvd.Adasum, sparse_as_dense=False
+    )
+    mesh = hvd.mesh("flat")
+
+    def step(v):
+        grads = {"emb": IndexedSlices(v[0], jnp.array([0]), (4, 1))}
+        with pytest.raises(ValueError, match="Adasum does not support"):
+            tx.update(grads, tx.init(None))
+        return v
+
+    shard_map(
+        step, mesh=mesh, in_specs=(P(hvd.DP_AXIS),),
+        out_specs=P(hvd.DP_AXIS),
+    )(np.ones((N, 1, 1), np.float32))
+
+
+def test_sparse_as_dense_in_gradient_transform():
+    rows, dim, per_rank = 8, 2, 2
+    rng = np.random.RandomState(1)
+    values = rng.randn(N, per_rank, dim).astype(np.float32)
+    indices = rng.randint(0, rows, size=(N, per_rank)).astype(np.int32)
+
+    tx = hvd.DistributedGradientTransform()
+    mesh = hvd.mesh("flat")
+
+    def step(v, i):
+        grads = {"emb": IndexedSlices(v[0], i[0], (rows, dim)),
+                 "w": jnp.ones((dim,)) * (i[0, 0].astype(jnp.float32))}
+        state = tx.init(None)
+        out, _ = tx.update(grads, state)
+        return out["emb"][None], out["w"][None]
+
+    emb, w = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+        out_specs=(P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+    )(values, indices)
+
+    expect = np.zeros((rows, dim), np.float32)
+    for r in range(N):
+        for k in range(per_rank):
+            expect[indices[r, k]] += values[r, k]
+    expect /= N
+    np.testing.assert_allclose(np.asarray(emb[0]), expect, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(w[0]), np.full(dim, indices[:, 0].astype(np.float32).mean()),
+        rtol=1e-5,
+    )
+
+
+def test_sparse_kept_sparse_when_disabled():
+    rows, dim, per_rank = 6, 2, 2
+    values = np.arange(N * per_rank * dim, dtype=np.float32).reshape(
+        N, per_rank, dim
+    )
+    indices = np.tile(np.arange(per_rank, dtype=np.int32), (N, 1))
+
+    tx = hvd.DistributedGradientTransform(sparse_as_dense=False)
+    mesh = hvd.mesh("flat")
+
+    def step(v, i):
+        grads = {"emb": IndexedSlices(v[0], i[0], (rows, dim))}
+        out, _ = tx.update(grads, tx.init(None))
+        s = out["emb"]
+        assert isinstance(s, IndexedSlices)
+        # concatenated across ranks: N * per_rank rows
+        assert s.values.shape == (N * per_rank, dim)
+        return to_dense(s)[None]
+
+    dense = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+        out_specs=P(hvd.DP_AXIS),
+    )(values, indices)
+
+    expect = np.zeros((rows, dim), np.float32)
+    for r in range(N):
+        for k in range(per_rank):
+            expect[indices[r, k]] += values[r, k] / N
+    np.testing.assert_allclose(np.asarray(dense[0]), expect, rtol=1e-5)
