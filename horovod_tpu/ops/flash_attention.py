@@ -8,9 +8,10 @@ that keeps the (S, S) score matrix out of HBM entirely.
 
 Design (the standard flash recurrence, TPU-shaped):
 
-* Grid ``(batch*heads, S/block_q)``; each program owns one Q tile in VMEM
-  and streams K/V tiles through the MXU with an online softmax, so peak
-  memory is O(block_q * block_k) instead of O(S^2).
+* Grid ``(batch*heads, S/block_q, S/block_k)``; each program owns one Q
+  tile and one (1, block_k, d) K/V tile in VMEM — the online-softmax
+  state rides VMEM scratch across the sequential K grid dimension, so
+  peak memory is O(block_q*d + block_k*d), independent of S.
 * fp32 accumulators regardless of input dtype (bf16 in, bf16 out, fp32
   softmax state — the MXU-native mixed precision).
 * Causal programs stop their K loop at the diagonal tile — the upper
@@ -31,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(jnp.finfo(jnp.float32).min) / 2
 
@@ -98,66 +100,92 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, interpret):
-    """Returns (o [Z,S,D], lse [Z,S]) with Z = batch*heads."""
+    """Returns (o [Z,S,D], lse [Z,S]) with Z = batch*heads.
+
+    K tiles live on the innermost grid dimension, so only (1, bk, d) of K
+    and V are resident per step — VMEM peak is O(bq*d + bk*d), independent
+    of S (the long-context requirement).  The online-softmax state (acc,
+    m, l) persists across the sequential K dimension in VMEM scratch and
+    is flushed to the output block at the last K tile.
+    """
     z, s, d = q.shape
     nq, nk = s // bq, s // bk
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
-        i = pl.program_id(1)
-        qb = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
-        q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    # Mosaic requires the last two block dims to be (8k, 128k) or full —
+    # scalars-per-row state therefore rides a broadcast 128-lane dim, the
+    # same layout the public jax TPU flash kernel uses (MIN_BLOCK_SIZE).
+    LANES = 128
 
-        def body(j, carry):
-            acc, m, l = carry
-            kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        # Causal: K tiles strictly above the diagonal contribute nothing —
+        # skip their compute entirely (their DMA is pipelined regardless).
+        needed = (j * bk <= (i + 1) * bq - 1) if causal else (j >= 0)
+
+        @pl.when(needed)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+            kb = k_ref[0].astype(jnp.float32)          # [bk, d]
+            vb = v_ref[0].astype(jnp.float32)
             st = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
             if causal:
+                q_pos = i * bq + lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0
+                )
                 k_pos = j * bk + lax.broadcasted_iota(
                     jnp.int32, (bq, bk), 1
                 )
                 st = jnp.where(k_pos > q_pos, NEG_INF, st)
-            m_new = jnp.maximum(m, st.max(-1))
-            p = jnp.exp(st - m_new[:, None])
-            corr = jnp.exp(m - m_new)
-            l = l * corr + p.sum(-1)
-            acc = acc * corr[:, None] + jnp.dot(
+            m_prev = m_ref[...]                       # [bq, LANES], lanes equal
+            m_new = jnp.maximum(m_prev, st.max(-1)[:, None])
+            p = jnp.exp(st - m_new[:, :1])
+            corr = jnp.exp(m_prev - m_new)
+            l_ref[...] = l_ref[...] * corr + p.sum(-1)[:, None]
+            acc_ref[...] = acc_ref[...] * corr[:, :1] + jnp.dot(
                 p, vb, preferred_element_type=jnp.float32
             )
-            return acc, m_new, l
+            m_ref[...] = m_new
 
-        # Causal: K tiles strictly above the diagonal contribute nothing —
-        # stop the loop at the diagonal tile instead of masking them.
-        if causal:
-            n_iter = lax.min(nk, ((i + 1) * bq + bk - 1) // bk)
-        else:
-            n_iter = nk
-        acc0 = jnp.zeros((bq, d), jnp.float32)
-        m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((bq,), jnp.float32)
-        acc, m, l = lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
-        o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m + jnp.log(l)
+        @pl.when(j == nk - 1)
+        def _flush():
+            o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+            lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])
 
-    o, lse = pl.pallas_call(
+    o, lse_wide = pl.pallas_call(
         kernel,
-        grid=(z, nq),
+        grid=(z, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda zi, qi: (zi, qi, 0)),
-            pl.BlockSpec((1, s, d), lambda zi, qi: (zi, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda zi, qi: (zi, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda zi, qi, ki: (zi, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda zi, qi, ki: (zi, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda zi, qi, ki: (zi, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda zi, qi: (zi, qi, 0)),
-            pl.BlockSpec((1, bq), lambda zi, qi: (zi, qi)),
+            pl.BlockSpec((1, bq, d), lambda zi, qi, ki: (zi, qi, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda zi, qi, ki: (zi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((z, s, d), q.dtype),
-            jax.ShapeDtypeStruct((z, s), jnp.float32),
+            jax.ShapeDtypeStruct((z, s, LANES), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),       # acc
+            pltpu.VMEM((bq, LANES), jnp.float32),   # running max m
+            pltpu.VMEM((bq, LANES), jnp.float32),   # running sum l
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return o, lse_wide[:, :, 0]
 
 
 def _flash_bwd_blockwise(q, k, v, o, lse, do, causal, scale, bk):
