@@ -75,6 +75,16 @@ def __getattr__(name):
         from .ops.compression import Compression  # noqa: PLC0415
 
         return Compression
+    if name in (
+        "Store",
+        "LocalStore",
+        "save_checkpoint",
+        "restore_checkpoint",
+        "latest_checkpoint_step",
+    ):
+        from . import checkpoint as _ckpt  # noqa: PLC0415
+
+        return getattr(_ckpt, name)
     if name in ("IndexedSlices", "allreduce_sparse", "sparse_to_dense"):
         from .ops import sparse as _sparse  # noqa: PLC0415
 

@@ -1,0 +1,255 @@
+"""Checkpoint / resume and the Store abstraction.
+
+The reference has no checkpoint engine of its own; its pattern (SURVEY.md
+§5.4) is "rank 0 checkpoints through the framework, everyone else restores
+by broadcast": ``broadcast_parameters`` / ``broadcast_optimizer_state``
+(horovod/torch/__init__.py:452-605), the Keras/TF broadcast hooks, and the
+Spark estimators persisting through a ``Store``
+(horovod/spark/common/store.py:30-330).  The TPU build makes that pattern a
+first-class module:
+
+* :class:`Store` / :class:`LocalStore` — where checkpoints and run metadata
+  live (the estimator layer builds on this, mirroring LocalStore/HDFSStore).
+* :func:`save_checkpoint` — orbax-backed pytree save.  Rank 0 writes, other
+  ranks wait at a barrier (the reference's rank-0 checkpoint discipline).
+* :func:`restore_checkpoint` — rank 0 reads, then the state is broadcast to
+  every rank (the broadcast-on-start primitive), so a resumed job starts
+  bit-identical everywhere even if the filesystem is not shared.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .basics import rank, size
+
+__all__ = [
+    "Store",
+    "LocalStore",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_checkpoint_step",
+]
+
+
+class Store:
+    """Filesystem-layout contract for run artifacts (reference:
+    horovod/spark/common/store.py Store — checkpoint/metadata paths keyed
+    off a prefix; subclasses own the actual filesystem).
+    """
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = str(prefix_path)
+
+    # -- paths (reference store.py get_checkpoint_path/get_*_data_path) --
+    def checkpoint_dir(self, run_id: str = "default") -> str:
+        return os.path.join(self.prefix_path, run_id, "checkpoints")
+
+    def metadata_path(self, run_id: str = "default") -> str:
+        return os.path.join(self.prefix_path, run_id, "metadata.json")
+
+    def logs_dir(self, run_id: str = "default") -> str:
+        return os.path.join(self.prefix_path, run_id, "logs")
+
+    # -- filesystem ops subclasses implement --
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    # -- metadata helpers used by the estimator layer --
+    def write_metadata(self, meta: dict, run_id: str = "default") -> None:
+        path = self.metadata_path(run_id)
+        self.makedirs(os.path.dirname(path))
+        self.write_bytes(path, json.dumps(meta, indent=2).encode())
+
+    def read_metadata(self, run_id: str = "default") -> Optional[dict]:
+        path = self.metadata_path(run_id)
+        if not self.exists(path):
+            return None
+        return json.loads(self.read_bytes(path).decode())
+
+
+class LocalStore(Store):
+    """Local (or NFS-mounted) filesystem store (reference LocalStore,
+    horovod/spark/common/store.py)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+def _barrier() -> None:
+    """All-rank sync point; no-op in a single-process world."""
+    if size() <= 1:
+        return
+    from .ops import eager  # noqa: PLC0415
+
+    eager.barrier()
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step:010d}")
+
+
+def _rank0_checkpointer():
+    """An orbax checkpointer that only involves THIS process.
+
+    Orbax's default checkpointers run global barriers across every jax
+    process (sync_global_processes), which deadlocks the rank-0-writes
+    pattern — ranks != 0 never enter save().  Restricting
+    active_processes={me} keeps orbax's atomicity/async machinery without
+    the cross-process sync; our own engine barrier provides the job-wide
+    ordering instead.
+    """
+    import orbax.checkpoint as ocp  # noqa: PLC0415
+
+    me = jax.process_index()
+    if jax.process_count() <= 1:
+        return ocp.StandardCheckpointer()
+    return ocp.AsyncCheckpointer(
+        ocp.StandardCheckpointHandler(),
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=me, active_processes={me}
+        ),
+    )
+
+
+def save_checkpoint(
+    directory: str,
+    state: Any,
+    step: int,
+    *,
+    keep: Optional[int] = None,
+) -> str:
+    """Save a pytree checkpoint; rank 0 writes, all ranks synchronize.
+
+    ``state`` is any pytree of arrays (params, optimizer state, rng, ...).
+    ``directory`` is a local (or NFS-mounted) path — pair with
+    ``LocalStore.checkpoint_dir(run_id)`` for estimator-style layouts.
+    Checkpoint bytes always go through orbax on the filesystem; the Store
+    abstraction covers run *metadata*, not tensor data.
+    ``keep``: retain at most this many newest step directories (>= 1).
+    Returns the step directory path.
+    """
+    if keep is not None and keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    path = _step_dir(directory, step)
+    if rank() == 0:
+        os.makedirs(directory, exist_ok=True)
+        ckptr = _rank0_checkpointer()
+        # orbax refuses to overwrite; force=True matches the reference's
+        # framework-checkpoint overwrite behavior on re-save of a step.
+        ckptr.save(
+            os.path.abspath(path),
+            jax.tree_util.tree_map(np.asarray, state),
+            force=True,
+        )
+        ckptr.wait_until_finished()
+        ckptr.close()
+        if keep is not None:
+            steps = sorted(_list_step_dirs(directory))
+            for old in steps[: max(len(steps) - keep, 0)]:
+                shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
+    _barrier()
+    return path
+
+
+def _list_step_dirs(directory: str):
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[len("step_"):]))
+            except ValueError:
+                continue
+    return steps
+
+
+def latest_checkpoint_step(directory: str) -> Optional[int]:
+    steps = _list_step_dirs(directory)
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(
+    directory: str,
+    target: Any,
+    step: Optional[int] = None,
+    *,
+    broadcast: bool = True,
+) -> Any:
+    """Restore a checkpoint and (by default) broadcast it from rank 0.
+
+    ``target`` is a pytree of the expected structure/shapes (abstract or
+    concrete).  ``step=None`` restores the latest.  With ``broadcast=True``
+    only rank 0 needs the files — every other rank receives the state over
+    the wire (reference broadcast_parameters-on-start,
+    horovod/torch/__init__.py:452-530), which also guarantees bit-identical
+    resume across ranks on non-shared filesystems.
+    """
+    needs_files = rank() == 0 or not broadcast or size() <= 1
+    if step is None:
+        # Resolve "latest" only where the files are required to exist; on a
+        # non-shared filesystem the other ranks have no checkpoint dir and
+        # receive the resolved step (or the failure) from rank 0.
+        if needs_files:
+            step = latest_checkpoint_step(directory)
+        if broadcast and size() > 1:
+            from .optim import broadcast_object  # noqa: PLC0415
+
+            step = broadcast_object(step, root_rank=0)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    state = None
+    if needs_files:
+        ckptr = _rank0_checkpointer()
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+            if not isinstance(x, jax.ShapeDtypeStruct)
+            else x,
+            target,
+        )
+        state = ckptr.restore(os.path.abspath(_step_dir(directory, step)),
+                              abstract)
+        ckptr.close()
+    if broadcast and size() > 1:
+        from .optim import broadcast_object  # noqa: PLC0415
+
+        state = broadcast_object(state, root_rank=0)
+    return state

@@ -244,23 +244,51 @@ def distribute(
 # ---------------------------------------------------------------------------
 
 
+def _engine_active() -> bool:
+    """True when the eager engine's background thread is running.
+
+    While it runs, ALL cross-process traffic must flow through it — issuing
+    a multihost_utils collective from another thread races the engine's own
+    negotiation collectives and deadlocks (the exact hazard the reference's
+    one-communication-thread rule exists for, operations.cc:311-330).
+    """
+    from .._engine_registry import peek_engine  # noqa: PLC0415
+
+    return peek_engine() is not None
+
+
 def broadcast_parameters(params, root_rank: int = 0):
     """Replicate a parameter pytree from ``root_rank``'s process to all
     (reference: torch/__init__.py:452-508; used at train start so every
     worker begins from identical state).
 
-    Cross-process transport is the JAX coordination service
-    (multihost broadcast) — the descendant of the reference's
+    Cross-process transport is the eager engine's broadcast when the engine
+    is running (single communication owner), otherwise the JAX coordination
+    service (multihost broadcast) — the descendants of the reference's
     MPI_Bcast-based parameter broadcast.  Single-process jobs return the
     tree unchanged.
     """
     topo = global_topology()
     if topo.process_count == 1:
         return params
+    if _engine_active():
+        from ..ops import eager  # noqa: PLC0415
+
+        # Enqueue every leaf first so the engine can fuse them into a few
+        # negotiation cycles (the reference enqueues all parameter
+        # broadcasts before synchronizing, torch/__init__.py:452-508).
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        handles = [
+            eager.broadcast_async(np.asarray(l), root_rank=root_rank)
+            for l in leaves
+        ]
+        outs = [eager.synchronize(h) for h in handles]
+        return jax.tree_util.tree_unflatten(treedef, outs)
     from jax.experimental import multihost_utils  # noqa: PLC0415
 
-    is_source = topo.process_rank == root_rank
-    return multihost_utils.broadcast_one_to_all(params, is_source=is_source)
+    return multihost_utils.broadcast_one_to_all(
+        params, is_source=topo.process_rank == root_rank
+    )
 
 
 def broadcast_optimizer_state(opt_state, root_rank: int = 0):
@@ -296,12 +324,25 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     topo = global_topology()
     if topo.process_count == 1:
         return obj
-    from jax.experimental import multihost_utils  # noqa: PLC0415
-
     is_source = topo.process_rank == root_rank
     payload = pickle.dumps(obj) if is_source else b""
-    # Two-phase: broadcast length, then the padded byte buffer (the
-    # reference broadcasts a size tensor then the bytes, same shape).
+    if _engine_active():
+        from ..ops import eager  # noqa: PLC0415
+
+        # Two-phase: broadcast length, then the byte buffer (the reference
+        # broadcasts a size tensor then the bytes, torch/__init__.py:627-641).
+        length = int(
+            eager.broadcast(
+                np.asarray([len(payload)], np.int64), root_rank=root_rank
+            )[0]
+        )
+        buf = np.zeros(length, np.uint8)
+        if is_source:
+            buf[:] = np.frombuffer(payload, np.uint8)
+        buf = eager.broadcast(buf, root_rank=root_rank)
+        return pickle.loads(np.asarray(buf).tobytes()) if length else None
+    from jax.experimental import multihost_utils  # noqa: PLC0415
+
     length = multihost_utils.broadcast_one_to_all(
         np.asarray(len(payload), np.int64), is_source=is_source
     )

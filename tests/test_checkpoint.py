@@ -1,0 +1,104 @@
+"""Checkpoint/resume + Store (SURVEY.md §5.4: rank-0 checkpoint +
+broadcast-on-start; Store mirrors horovod/spark/common/store.py)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.checkpoint import (
+    LocalStore,
+    latest_checkpoint_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "params": {
+            "w": jnp.asarray(rng.randn(4, 3).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(3).astype(np.float32)),
+        },
+        "step": jnp.asarray(7, jnp.int32),
+    }
+
+
+def test_save_restore_roundtrip(tmp_path):
+    d = str(tmp_path / "ckpt")
+    state = _state()
+    save_checkpoint(d, state, step=7)
+    assert latest_checkpoint_step(d) == 7
+    out = restore_checkpoint(d, _state(seed=1))
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+    np.testing.assert_array_equal(np.asarray(out["step"]), 7)
+
+
+def test_restore_latest_and_explicit(tmp_path):
+    d = str(tmp_path / "ckpt")
+    for s in (1, 5, 3):
+        state = _state(seed=s)
+        save_checkpoint(d, state, step=s)
+    assert latest_checkpoint_step(d) == 5
+    latest = restore_checkpoint(d, _state())
+    np.testing.assert_array_equal(
+        np.asarray(latest["params"]["w"]),
+        np.asarray(_state(seed=5)["params"]["w"]),
+    )
+    old = restore_checkpoint(d, _state(), step=1)
+    np.testing.assert_array_equal(
+        np.asarray(old["params"]["w"]),
+        np.asarray(_state(seed=1)["params"]["w"]),
+    )
+
+
+def test_keep_prunes_old_steps(tmp_path):
+    d = str(tmp_path / "ckpt")
+    for s in range(5):
+        save_checkpoint(d, _state(seed=s), step=s, keep=2)
+    names = sorted(os.listdir(d))
+    assert names == ["step_0000000003", "step_0000000004"]
+
+
+def test_resave_same_step_overwrites(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _state(seed=0), step=1)
+    save_checkpoint(d, _state(seed=9), step=1)
+    out = restore_checkpoint(d, _state())
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]),
+        np.asarray(_state(seed=9)["params"]["w"]),
+    )
+
+
+def test_keep_zero_rejected(tmp_path):
+    with pytest.raises(ValueError, match="keep must be >= 1"):
+        save_checkpoint(str(tmp_path / "c"), _state(), step=0, keep=0)
+
+
+def test_restore_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path / "none"), _state())
+
+
+def test_local_store_metadata_and_paths(tmp_path):
+    store = LocalStore(str(tmp_path))
+    assert store.read_metadata("run1") is None
+    store.write_metadata({"epochs": 3}, "run1")
+    assert store.read_metadata("run1") == {"epochs": 3}
+    assert store.checkpoint_dir("run1").startswith(str(tmp_path))
+    # atomic write: no .tmp residue
+    assert not any(p.endswith(".tmp") for p in os.listdir(
+        os.path.dirname(store.metadata_path("run1"))
+    ))

@@ -183,3 +183,58 @@ def test_broadcast_parameters_across_processes(engine_env):
         assert r["w"] == [0.0, 0.0, 0.0]
         assert r["x"] == [0.0, 0.0]
         assert r["obj"] == {"epoch": 7}
+
+
+def _ckpt_fn(ckpt_dir):
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    hvd.init()
+    r = hvd.rank()
+    # per-rank divergent state; save writes rank 0's copy only
+    state = {"w": np.full((3,), float(r + 1), np.float32)}
+    save_checkpoint(ckpt_dir, state, step=1)
+    # restore with broadcast: every rank must come back with rank 0's values
+    out = restore_checkpoint(ckpt_dir, {"w": np.zeros((3,), np.float32)})
+    hvd.shutdown()
+    return np.asarray(out["w"]).tolist()
+
+
+def test_checkpoint_rank0_write_broadcast_restore(engine_env, tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    results = hvdrun.run(_ckpt_fn, (ckpt_dir,), np=2, use_cpu=True,
+                         timeout=180, env=engine_env)
+    for r in results:
+        assert r == [1.0, 1.0, 1.0]  # rank 0's state everywhere
+
+
+def _ckpt_nonshared_fn(ckpt_dir):
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    hvd.init()
+    r = hvd.rank()
+    # Simulate a NON-shared filesystem: each rank gets a private directory;
+    # only rank 0's ever receives the checkpoint.
+    my_dir = os.path.join(ckpt_dir, f"private_{r}")
+    state = {"w": np.full((2,), 42.0 if r == 0 else -1.0, np.float32)}
+    save_checkpoint(my_dir, state, step=3)
+    # step=None: rank 0 resolves "latest" and broadcasts it; rank 1's
+    # directory has no checkpoints but must still restore successfully.
+    restore_dir = my_dir if r == 0 else os.path.join(ckpt_dir, "nowhere")
+    out = restore_checkpoint(restore_dir, {"w": np.zeros((2,), np.float32)})
+    hvd.shutdown()
+    return np.asarray(out["w"]).tolist()
+
+
+def test_checkpoint_restore_without_shared_filesystem(engine_env, tmp_path):
+    results = hvdrun.run(_ckpt_nonshared_fn, (str(tmp_path),), np=2,
+                         use_cpu=True, timeout=180, env=engine_env)
+    for r in results:
+        assert r == [42.0, 42.0]
