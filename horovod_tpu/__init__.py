@@ -75,6 +75,10 @@ def __getattr__(name):
         from .ops.compression import Compression  # noqa: PLC0415
 
         return Compression
+    if name in ("Estimator", "Model"):
+        from . import estimator as _est  # noqa: PLC0415
+
+        return getattr(_est, name)
     if name in (
         "Store",
         "LocalStore",

@@ -114,12 +114,25 @@ class LocalStore(Store):
 
 
 def _barrier() -> None:
-    """All-rank sync point; no-op in a single-process world."""
+    """All-rank sync point; no-op in a single-process world.
+
+    Uses the eager engine's barrier only when the engine is already
+    running (it owns all cross-process traffic then); otherwise a
+    coordination-service sync, so a jit-only job checkpointing doesn't
+    spawn the engine as a side effect.
+    """
     if size() <= 1:
         return
-    from .ops import eager  # noqa: PLC0415
+    from ._engine_registry import peek_engine  # noqa: PLC0415
 
-    eager.barrier()
+    if peek_engine() is not None:
+        from .ops import eager  # noqa: PLC0415
+
+        eager.barrier()
+        return
+    from jax.experimental import multihost_utils  # noqa: PLC0415
+
+    multihost_utils.sync_global_devices("hvdtpu_checkpoint")
 
 
 def _step_dir(directory: str, step: int) -> str:

@@ -1,0 +1,102 @@
+"""Estimator/Model API (reference: horovod.spark estimators —
+test/test_spark_keras.py, test_spark_torch.py: fit on a small dataset,
+check the transformer's predictions and store round-trip)."""
+
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.checkpoint import LocalStore
+from horovod_tpu.estimator import Estimator, Model
+from horovod_tpu.models.simple import MLP
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _blobs(n=256, seed=0):
+    """Two linearly separable 2-D blobs."""
+    rng = np.random.RandomState(seed)
+    half = n // 2
+    x = np.concatenate([
+        rng.randn(half, 2).astype(np.float32) + 2.0,
+        rng.randn(n - half, 2).astype(np.float32) - 2.0,
+    ])
+    y = np.concatenate([
+        np.zeros(half, np.int32), np.ones(n - half, np.int32)
+    ])
+    return {"features": x, "label": y}
+
+
+def test_fit_local_learns_and_transforms(tmp_path):
+    data = _blobs()
+    est = Estimator(
+        MLP(features=(16,), num_classes=2),
+        optax.adam(1e-2),
+        batch_size=32,
+        epochs=5,
+        store=LocalStore(str(tmp_path)),
+        run_id="blobs",
+    )
+    model = est.fit(data)
+    assert len(model.history) == 5
+    assert model.history[-1]["loss"] < model.history[0]["loss"]
+    out = model.transform(data)
+    acc = (out["prediction"] == data["label"]).mean()
+    assert acc > 0.95
+    # metadata landed in the store
+    meta = LocalStore(str(tmp_path)).read_metadata("blobs")
+    assert meta["model"] == "MLP"
+    assert len(meta["history"]) == 5
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    data = _blobs(n=128)
+    store = LocalStore(str(tmp_path))
+    est = Estimator(
+        MLP(features=(8,), num_classes=2),
+        optax.adam(1e-2),
+        batch_size=32,
+        epochs=2,
+        store=store,
+        run_id="r1",
+    )
+    model = est.fit(data)
+    preds = model.transform(data)["prediction"]
+
+    import jax
+
+    template = MLP(features=(8,), num_classes=2).init(
+        jax.random.PRNGKey(0), data["features"][:1]
+    )
+    loaded = Model.load(
+        MLP(features=(8,), num_classes=2), store, "r1",
+        template_params=template,
+    )
+    preds2 = loaded.transform(data)["prediction"]
+    np.testing.assert_array_equal(preds, preds2)
+
+
+def test_bad_batch_size_raises():
+    est = Estimator(
+        MLP(features=(8,), num_classes=2), optax.sgd(0.1),
+        batch_size=31, epochs=1,  # 31 % 8 devices != 0
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        est.fit(_blobs(n=64))
+
+
+def test_mismatched_lengths_raise():
+    est = Estimator(MLP(), optax.sgd(0.1))
+    with pytest.raises(ValueError, match="length mismatch"):
+        est.fit({"features": np.zeros((4, 2), np.float32),
+                 "label": np.zeros(3, np.int32)})
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(ValueError, match="unknown backend"):
+        Estimator(MLP(), optax.sgd(0.1), backend="spark")

@@ -238,3 +238,41 @@ def test_checkpoint_restore_without_shared_filesystem(engine_env, tmp_path):
                          use_cpu=True, timeout=180, env=engine_env)
     for r in results:
         assert r == [42.0, 42.0]
+
+
+def test_estimator_launcher_backend(tmp_path):
+    """Estimator fit through the launcher (≙ Spark-task training,
+    horovod/spark/runner.py): 2 worker processes, eager gradient averaging."""
+    import numpy as np
+    import optax
+
+    from horovod_tpu.checkpoint import LocalStore
+    from horovod_tpu.estimator import Estimator
+    from horovod_tpu.models.simple import MLP
+
+    rng = np.random.RandomState(0)
+    n = 128
+    x = np.concatenate([
+        rng.randn(n // 2, 2).astype(np.float32) + 2.0,
+        rng.randn(n // 2, 2).astype(np.float32) - 2.0,
+    ])
+    y = np.concatenate([
+        np.zeros(n // 2, np.int32), np.ones(n // 2, np.int32)
+    ])
+
+    est = Estimator(
+        MLP(features=(8,), num_classes=2),
+        optax.adam(1e-2),
+        batch_size=32,
+        epochs=3,
+        backend="launcher",
+        np_workers=2,
+        use_cpu=True,
+        store=LocalStore(str(tmp_path)),
+        run_id="launcher",
+    )
+    model = est.fit({"features": x, "label": y})
+    assert len(model.history) == 3
+    assert model.history[-1]["loss"] < model.history[0]["loss"]
+    acc = (model.transform({"features": x})["prediction"] == y).mean()
+    assert acc > 0.9
