@@ -28,7 +28,6 @@ import numpy as np
 import optax
 
 from .checkpoint import (
-    LocalStore,
     Store,
     latest_checkpoint_step,
     restore_checkpoint,
@@ -90,6 +89,7 @@ class Estimator:
         backend: str = "local",
         np_workers: Optional[int] = None,
         use_cpu: bool = False,
+        timeout: Optional[float] = 600.0,
         checkpoint_every_epochs: int = 1,
         verbose: bool = False,
     ):
@@ -109,6 +109,7 @@ class Estimator:
         self.backend = backend
         self.np_workers = np_workers
         self.use_cpu = use_cpu
+        self.timeout = timeout
         self.checkpoint_every_epochs = checkpoint_every_epochs
         self.verbose = verbose
 
@@ -155,12 +156,17 @@ class Estimator:
             "epochs": self.epochs,
             "shuffle": self.shuffle,
             "seed": self.seed,
-            "store_prefix": (
-                self.store.prefix_path if self.store is not None else None
+            # Resolve through the (possibly subclassed) Store here so the
+            # training loops and Model.load agree on the layout.
+            "ckpt_dir": (
+                self.store.checkpoint_dir(self.run_id)
+                if self.store is not None
+                else None
             ),
             "run_id": self.run_id,
             "np_workers": self.np_workers,
             "use_cpu": self.use_cpu,
+            "timeout": self.timeout,
             "checkpoint_every_epochs": self.checkpoint_every_epochs,
             "verbose": self.verbose,
         }
@@ -230,11 +236,7 @@ def _train_local(cfg: dict, x: np.ndarray, y: np.ndarray):
     if steps_per_epoch == 0:
         raise ValueError(f"dataset of {n} rows < batch_size {bs}")
     history = []
-    ckpt_dir = None
-    if cfg["store_prefix"]:
-        ckpt_dir = LocalStore(cfg["store_prefix"]).checkpoint_dir(
-            cfg["run_id"]
-        )
+    ckpt_dir = cfg["ckpt_dir"]
     for epoch in range(cfg["epochs"]):
         order = _epoch_order(n, epoch, cfg["seed"], cfg["shuffle"])
         losses = []
@@ -247,9 +249,16 @@ def _train_local(cfg: dict, x: np.ndarray, y: np.ndarray):
         history.append({"epoch": epoch, "loss": float(np.mean(losses))})
         if cfg["verbose"]:
             print(f"[estimator] epoch {epoch}: loss {history[-1]['loss']:.4f}")
-        if ckpt_dir and (epoch + 1) % cfg["checkpoint_every_epochs"] == 0:
+        if ckpt_dir and _should_checkpoint(epoch, cfg):
             save_checkpoint(ckpt_dir, {"params": params}, step=epoch + 1)
     return _tree_np(params), history
+
+
+def _should_checkpoint(epoch: int, cfg: dict) -> bool:
+    """Cadence epochs plus ALWAYS the final epoch, so the store's latest
+    checkpoint matches the params fit() returns."""
+    last = epoch + 1 == cfg["epochs"]
+    return last or (epoch + 1) % cfg["checkpoint_every_epochs"] == 0
 
 
 def _launcher_worker(cfg, x, y):
@@ -275,9 +284,11 @@ def _train_rank_sharded(cfg, x, y):
     tx = cfg["optimizer"]
     rank, size = hvd.rank(), hvd.size()
     bs = cfg["batch_size"]
+    if bs % size:
+        raise ValueError(
+            f"batch_size {bs} not divisible by {size} workers"
+        )
     per_rank = bs // size
-    if per_rank == 0:
-        raise ValueError(f"batch_size {bs} < world size {size}")
 
     rng = jax.random.PRNGKey(cfg["seed"])
     params = model.init(rng, jnp.asarray(x[:1]))
@@ -293,6 +304,8 @@ def _train_rank_sharded(cfg, x, y):
 
     n = len(x)
     steps_per_epoch = n // bs
+    if steps_per_epoch == 0:
+        raise ValueError(f"dataset of {n} rows < batch_size {bs}")
     history = []
     for epoch in range(cfg["epochs"]):
         order = _epoch_order(n, epoch, cfg["seed"], cfg["shuffle"])
@@ -303,22 +316,27 @@ def _train_rank_sharded(cfg, x, y):
             loss, grads = local_grads(
                 params, jnp.asarray(x[idx]), jnp.asarray(y[idx])
             )
-            # Eager allreduce of the gradient pytree (named-tensor path).
-            grads = hvd.allreduce(_tree_np(grads), op=hvd.Average)
-            loss = float(hvd.allreduce(np.asarray(loss), op=hvd.Average))
-            updates, opt_state = tx.update(
-                jax.tree_util.tree_map(jnp.asarray, grads), opt_state, params
+            # Enqueue the whole gradient pytree (plus the loss) async so
+            # the engine fuses the reduces into a few cycles, the same
+            # pattern as optim.broadcast_parameters.
+            from .ops import eager  # noqa: PLC0415
+
+            leaves, treedef = jax.tree_util.tree_flatten(_tree_np(grads))
+            handles = [
+                eager.allreduce_async(l, hvd.Average) for l in leaves
+            ]
+            loss_h = eager.allreduce_async(np.asarray(loss), hvd.Average)
+            grads = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(eager.synchronize(h)) for h in handles]
             )
+            loss = float(eager.synchronize(loss_h))
+            updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             losses.append(loss)
         history.append({"epoch": epoch, "loss": float(np.mean(losses))})
-        if cfg["store_prefix"] and (
-            (epoch + 1) % cfg["checkpoint_every_epochs"] == 0
-        ):
-            ckpt_dir = LocalStore(cfg["store_prefix"]).checkpoint_dir(
-                cfg["run_id"]
-            )
-            save_checkpoint(ckpt_dir, {"params": params}, step=epoch + 1)
+        if cfg["ckpt_dir"] and _should_checkpoint(epoch, cfg):
+            save_checkpoint(cfg["ckpt_dir"], {"params": params},
+                            step=epoch + 1)
     return params, history
 
 
@@ -328,7 +346,7 @@ def _train_launcher(cfg: dict, x: np.ndarray, y: np.ndarray):
     np_workers = cfg["np_workers"] or 2
     results = hvdrun.run(
         _launcher_worker, (cfg, x, y), np=np_workers,
-        use_cpu=cfg["use_cpu"],
+        use_cpu=cfg["use_cpu"], timeout=cfg["timeout"],
     )
     return results[0]
 

@@ -1,0 +1,8 @@
+"""Framework interop frontends.
+
+The reference ships one binding per host framework (horovod/{torch,
+tensorflow,mxnet,keras}); the TPU build's native surface is JAX, and this
+package provides the migration-path bindings for users arriving from those
+frameworks.  ``horovod_tpu.interop.torch`` mirrors the ``horovod.torch``
+API on host (CPU) torch tensors, riding the same eager engine.
+"""

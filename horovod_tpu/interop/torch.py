@@ -1,0 +1,469 @@
+"""``horovod.torch``-compatible API on host torch tensors.
+
+A drop-in migration surface for reference users (horovod/torch/__init__.py,
+horovod/torch/mpi_ops.py): the same ``init/rank/size``, collective, and
+``DistributedOptimizer`` spellings, executed by this framework's eager
+engine over its host data plane.  Torch here is the *host* framework — CPU
+tensors in, CPU tensors out, zero-copy to numpy both ways; the TPU compute
+path remains JAX (a torch CUDA stream has no TPU analog, and torch/XLA
+interop is out of scope — reference parity is the goal of this module).
+
+Autograd parity: each collective is a ``torch.autograd.Function`` whose
+backward is the reference's (allreduce -> allreduce,
+torch/mpi_ops.py:158-171; allgather -> reduce + narrow by rank offsets,
+:289-307; broadcast -> reduce-to-root, zero elsewhere, :371-385).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Tuple, Union
+
+import numpy as np
+import torch
+
+from ..basics import (  # noqa: F401  (re-exported API surface)
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..ops import eager
+from ..ops.collectives import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "is_homogeneous",
+    "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall",
+    "poll", "synchronize", "join", "barrier",
+    "DistributedOptimizer",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "Compression",
+]
+
+
+# ---------------------------------------------------------------------------
+# tensor conversion
+# ---------------------------------------------------------------------------
+
+
+def _check_cpu(t: torch.Tensor) -> None:
+    if t.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.interop.torch operates on host (CPU) tensors; got "
+            f"device {t.device}.  Move the tensor to CPU first — the TPU "
+            "compute path is JAX (see horovod_tpu.ops.collectives)."
+        )
+
+
+def _to_np(t: torch.Tensor) -> np.ndarray:
+    """Zero-copy when possible; bf16/f16 upcast to f32 for the wire (the
+    reference registers a custom fp16 MPI op instead, half.cc:42-78)."""
+    _check_cpu(t)
+    t = t.detach()
+    if t.dtype in (torch.bfloat16, torch.float16):
+        t = t.float()
+    return t.numpy()
+
+
+def _from_np(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    out = torch.from_numpy(np.ascontiguousarray(a))
+    if like.dtype in (torch.bfloat16, torch.float16):
+        out = out.to(like.dtype)
+    if out.shape != like.shape and out.numel() == like.numel():
+        # the engine's data plane flattens 0-d scalars to shape (1,)
+        out = out.reshape(like.shape)
+    return out
+
+
+class _Handle:
+    """Async handle: future + optional in-place destination (reference
+    HandleManager int handles, horovod/torch/handle_manager.cc)."""
+
+    def __init__(self, future, inplace_into: Optional[torch.Tensor],
+                 like: torch.Tensor):
+        self.future = future
+        self.inplace_into = inplace_into
+        self.like = like
+
+    def result(self) -> torch.Tensor:
+        out = _from_np(np.asarray(self.future.result()), self.like)
+        if self.inplace_into is not None:
+            with torch.no_grad():
+                self.inplace_into.copy_(out)
+            return self.inplace_into
+        return out
+
+
+def poll(handle: _Handle) -> bool:
+    """reference: hvd.poll (torch/mpi_ops.py:458-472)."""
+    return handle.future.done()
+
+
+def synchronize(handle: _Handle) -> torch.Tensor:
+    """reference: hvd.synchronize (torch/mpi_ops.py:475-491)."""
+    return handle.result()
+
+
+def join() -> int:
+    """reference: hvd.join (torch/mpi_ops.py:494-508)."""
+    return eager.join()
+
+
+def barrier() -> None:
+    eager.barrier()
+
+
+# ---------------------------------------------------------------------------
+# collectives (async + autograd wrappers)
+# ---------------------------------------------------------------------------
+
+
+def allreduce_async(
+    tensor: torch.Tensor,
+    op: ReduceOp = Average,
+    name: Optional[str] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> _Handle:
+    fut = eager.allreduce_async(
+        _to_np(tensor), op, name,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    return _Handle(fut, None, tensor)
+
+
+def allreduce_async_(
+    tensor: torch.Tensor,
+    op: ReduceOp = Average,
+    name: Optional[str] = None,
+    **kw,
+) -> _Handle:
+    """In-place async allreduce: the result lands back in ``tensor``
+    (reference allreduce_async_, torch/mpi_ops.py:174-205)."""
+    fut = eager.allreduce_async(_to_np(tensor), op, name, **kw)
+    return _Handle(fut, tensor, tensor)
+
+
+class _AllreduceFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, op, name, prescale, postscale):
+        ctx.op, ctx.prescale, ctx.postscale = op, prescale, postscale
+        return synchronize(
+            allreduce_async(tensor, op, name, prescale, postscale)
+        )
+
+    @staticmethod
+    def backward(ctx, grad):
+        # reference _AllreduceFunction.backward (torch/mpi_ops.py:158-171):
+        # the gradient of an allreduce is the same allreduce of the grads.
+        return (
+            synchronize(allreduce_async(
+                grad.contiguous(), ctx.op, None, ctx.prescale, ctx.postscale
+            )),
+            None, None, None, None,
+        )
+
+
+def allreduce(
+    tensor: torch.Tensor,
+    op: ReduceOp = Average,
+    name: Optional[str] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> torch.Tensor:
+    """Differentiable blocking allreduce (reference torch/mpi_ops.py:131-155)."""
+    if tensor.requires_grad:
+        return _AllreduceFn.apply(
+            tensor, op, name, prescale_factor, postscale_factor
+        )
+    return synchronize(allreduce_async(
+        tensor, op, name, prescale_factor, postscale_factor
+    ))
+
+
+def allreduce_(tensor: torch.Tensor, op: ReduceOp = Average,
+               name: Optional[str] = None, **kw) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, op, name, **kw))
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> _Handle:
+    return _Handle(eager.allgather_async(_to_np(tensor), name), None, tensor)
+
+
+class _AllgatherFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0] if tensor.ndim else 1
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # reference _AllgatherFunction.backward (torch/mpi_ops.py:289-307):
+        # reduce the gathered grads, then narrow out this rank's rows.
+        # Rank offsets come from allgathering the per-rank dim-0 sizes.
+        my_rows = torch.tensor([ctx.dim0], dtype=torch.int64)
+        sizes = synchronize(allgather_async(my_rows, None))
+        reduced = synchronize(allreduce_async(grad.contiguous(), Sum, None))
+        start = int(sizes[:rank()].sum())
+        return reduced.narrow(0, start, ctx.dim0), None
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    """Differentiable allgather; ragged dim 0 supported (negotiated sizes,
+    reference controller.cc:453-518)."""
+    if tensor.requires_grad:
+        return _AllgatherFn.apply(tensor, name)
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> _Handle:
+    return _Handle(
+        eager.broadcast_async(_to_np(tensor), root_rank, name), None, tensor
+    )
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> _Handle:
+    return _Handle(
+        eager.broadcast_async(_to_np(tensor), root_rank, name), tensor, tensor
+    )
+
+
+class _BroadcastFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # reference _BroadcastFunction.backward (torch/mpi_ops.py:371-385):
+        # sum grads to the root; non-roots contribute and receive zero.
+        reduced = synchronize(allreduce_async(grad.contiguous(), Sum, None))
+        if rank() != ctx.root_rank:
+            reduced = torch.zeros_like(reduced)
+        return reduced, None, None
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    if tensor.requires_grad:
+        return _BroadcastFn.apply(tensor, root_rank, name)
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    return _from_np(eager.alltoall(_to_np(tensor), name), tensor)
+
+
+# ---------------------------------------------------------------------------
+# compression (reference horovod/torch/compression.py)
+# ---------------------------------------------------------------------------
+
+
+class _NoneCompressor:
+    @staticmethod
+    def compress(t):
+        return t, t.dtype
+
+    @staticmethod
+    def decompress(t, dtype):
+        return t
+
+
+class _FP16Compressor:
+    """Cast to fp16 before the wire (reference Compression.fp16)."""
+
+    @staticmethod
+    def compress(t):
+        if t.dtype in (torch.float32, torch.float64):
+            return t.half(), t.dtype
+        return t, t.dtype
+
+    @staticmethod
+    def decompress(t, dtype):
+        return t.to(dtype) if t.dtype != dtype else t
+
+
+class Compression:
+    none = _NoneCompressor
+    fp16 = _FP16Compressor
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer (reference horovod/torch/__init__.py:67-222)
+# ---------------------------------------------------------------------------
+
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer: per-parameter hooks fire allreduce as
+    gradients accumulate; ``step()`` synchronizes then applies updates.
+
+    Mirrors the reference's grad-accumulator hook design
+    (torch/__init__.py:67-222) using torch's post-accumulate-grad hooks,
+    including ``backward_passes_per_step`` gradient accumulation
+    (:101-126).
+    """
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op: ReduceOp = Average):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self.backward_passes_per_step = backward_passes_per_step
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [
+                (f"param.{i}.{j}", p)
+                for i, group in enumerate(optimizer.param_groups)
+                for j, p in enumerate(group["params"])
+            ]
+        # Duplicate-name guard (reference torch/__init__.py:90-99).
+        names = [n for n, _ in named]
+        if len(names) != len(set(names)):
+            raise ValueError("parameter names must be unique")
+        params_in_opt = {
+            id(p) for g in optimizer.param_groups for p in g["params"]
+        }
+        self._names = {
+            id(p): n for n, p in named if id(p) in params_in_opt
+        }
+        self._handles: dict = {}
+        self._passes: dict = {}
+        self._hooks = []
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._hooks.append(
+                        p.register_post_accumulate_grad_hook(self._make_hook())
+                    )
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor):
+            self._passes[id(p)] = self._passes.get(id(p), 0) + 1
+            if self._passes[id(p)] < self.backward_passes_per_step:
+                return
+            self._passes[id(p)] = 0
+            name = self._names.get(id(p), f"grad.{id(p)}")
+            wire, dctx = self._compression.compress(p.grad)
+            fut = eager.allreduce_async(
+                _to_np(wire), self._op, f"allreduce.{name}",
+                prescale_factor=1.0 / self.backward_passes_per_step,
+            )
+            self._handles[id(p)] = (p, fut, dctx)
+
+        return hook
+
+    def synchronize(self) -> None:
+        """Wait for all outstanding grad reductions and write them back
+        (reference torch/__init__.py:165-215)."""
+        for p, fut, dctx in self._handles.values():
+            out = _from_np(np.asarray(fut.result()), p.grad)
+            out = self._compression.decompress(out, dctx)
+            with torch.no_grad():
+                p.grad.copy_(out)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called with allreduces in flight — call step() "
+                "or synchronize() first (reference torch/__init__.py:217-222)"
+            )
+        return self._opt.zero_grad(*a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = Average) -> _DistributedOptimizer:
+    """reference: hvd.DistributedOptimizer (torch/__init__.py:396-449)."""
+    return _DistributedOptimizer(
+        optimizer, named_parameters, compression,
+        backward_passes_per_step, op,
+    )
+
+
+# ---------------------------------------------------------------------------
+# state replication (reference torch/__init__.py:452-648)
+# ---------------------------------------------------------------------------
+
+
+def broadcast_parameters(
+    params: Union[dict, Iterable[Tuple[str, torch.Tensor]]],
+    root_rank: int = 0,
+) -> None:
+    """In-place broadcast of a state_dict or named_parameters iterable
+    (reference torch/__init__.py:452-508)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        handles.append((p, eager.broadcast_async(
+            _to_np(p), root_rank, f"broadcast.{name}"
+        )))
+    for p, fut in handles:
+        with torch.no_grad():
+            p.copy_(_from_np(np.asarray(fut.result()), p))
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state in place (reference
+    torch/__init__.py:511-605: tensor state broadcast + scalar state via
+    object broadcast)."""
+    tensors = []
+    scalars = {}
+    for pid, pstate in optimizer.state_dict().get("state", {}).items():
+        for key, val in pstate.items():
+            if isinstance(val, torch.Tensor):
+                tensors.append((f"opt.{pid}.{key}", val))
+            else:
+                scalars[(pid, key)] = val
+    broadcast_parameters(tensors, root_rank)
+    scalars = broadcast_object(scalars, root_rank)
+    sd = optimizer.state_dict()
+    for (pid, key), val in scalars.items():
+        if pid in sd.get("state", {}):
+            sd["state"][pid][key] = val
+    optimizer.load_state_dict(sd)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    """reference: hvd.broadcast_object (torch/__init__.py:608-648)."""
+    from ..optim import broadcast_object as _bo  # noqa: PLC0415
+
+    return _bo(obj, root_rank=root_rank)

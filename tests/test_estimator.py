@@ -81,6 +81,25 @@ def test_model_save_load_roundtrip(tmp_path):
     np.testing.assert_array_equal(preds, preds2)
 
 
+def test_final_epoch_always_checkpointed(tmp_path):
+    """epochs not a multiple of the cadence: the last epoch must still be
+    saved so Model.load matches the fitted Model."""
+    from horovod_tpu.checkpoint import latest_checkpoint_step
+
+    store = LocalStore(str(tmp_path))
+    est = Estimator(
+        MLP(features=(4,), num_classes=2),
+        optax.sgd(0.1),
+        batch_size=32,
+        epochs=3,
+        checkpoint_every_epochs=5,
+        store=store,
+        run_id="cad",
+    )
+    est.fit(_blobs(n=64))
+    assert latest_checkpoint_step(store.checkpoint_dir("cad")) == 3
+
+
 def test_bad_batch_size_raises():
     est = Estimator(
         MLP(features=(8,), num_classes=2), optax.sgd(0.1),

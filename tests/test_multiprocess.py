@@ -240,6 +240,60 @@ def test_checkpoint_restore_without_shared_filesystem(engine_env, tmp_path):
         assert r == [42.0, 42.0]
 
 
+def _torch_interop_fn():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.interop.torch as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = {}
+    out["allreduce"] = hvd.allreduce(
+        torch.full((3,), float(r + 1)), op=hvd.Sum
+    ).tolist()
+    out["allgather"] = hvd.allgather(
+        torch.full((r + 1, 2), float(r))
+    ).tolist()
+    out["broadcast"] = hvd.broadcast(
+        torch.tensor([float(10 * (r + 1))]), root_rank=1
+    ).tolist()
+
+    # autograd across processes: grad of allreduce is allreduced
+    x = torch.ones(2, requires_grad=True)
+    y = hvd.allreduce(x, op=hvd.Sum)
+    y.backward(torch.full((2,), float(r + 1)))
+    out["grad"] = x.grad.tolist()  # sum of [1,2] per-rank grads = 3
+
+    # DistributedOptimizer: ranks start identical, divergent grads are
+    # averaged, so weights stay identical after step
+    torch.manual_seed(0)
+    model = torch.nn.Linear(2, 1, bias=False)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+    )
+    loss = (model(torch.ones(1, 2)) * float(r + 1)).sum()
+    loss.backward()
+    opt.step()
+    out["weights"] = model.weight.detach().flatten().tolist()
+    hvd.shutdown()
+    return out
+
+
+def test_torch_interop_across_processes(engine_env):
+    results = hvdrun.run(_torch_interop_fn, np=2, use_cpu=True,
+                         timeout=180, env=engine_env)
+    for r in results:
+        assert r["allreduce"] == [3.0, 3.0, 3.0]
+        assert r["allgather"] == [[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]]
+        assert r["broadcast"] == [20.0]
+        assert r["grad"] == [3.0, 3.0]
+    # weight sync: both ranks identical after averaged update
+    assert results[0]["weights"] == results[1]["weights"]
+
+
 def test_estimator_launcher_backend(tmp_path):
     """Estimator fit through the launcher (≙ Spark-task training,
     horovod/spark/runner.py): 2 worker processes, eager gradient averaging."""
