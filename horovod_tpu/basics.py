@@ -198,7 +198,25 @@ def init(comm=None) -> Topology:
             owns_jax_distributed=owns_distributed,
         )
         del local_devices
-        return _topology
+
+    # Start the native eager engine NOW in multi-process worlds (reference
+    # behavior: InitializeHorovodOnce spawns the background thread at init,
+    # operations.cc:604-650).  Every rank's engine must cycle for
+    # negotiation and stall inspection to work even when this rank hasn't
+    # enqueued anything yet.  Only the native engine starts eagerly — it
+    # negotiates over its own TCP mesh; the pure-Python fallback rides jax
+    # collectives, which must not run concurrently with main-thread jit
+    # collectives, so it stays lazy (started on first eager op).
+    if world > 1:
+        choice = os.environ.get("HVDTPU_EAGER_ENGINE", "auto").lower()
+        if choice != "python":
+            from .runtime import native  # noqa: PLC0415
+
+            if choice == "native" or native.native_available():
+                from . import _engine_registry  # noqa: PLC0415
+
+                _engine_registry.get_engine()
+    return _topology
 
 
 def _jax_distributed_active() -> bool:

@@ -240,6 +240,58 @@ def test_checkpoint_restore_without_shared_filesystem(engine_env, tmp_path):
         assert r == [42.0, 42.0]
 
 
+def _stall_fn():
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = None
+    if r == 0:
+        # Submit immediately; rank 1 never will -> stall -> shutdown.
+        try:
+            hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="stalled")
+            out = "no error"
+        except RuntimeError as e:
+            out = str(e)
+    else:
+        time.sleep(20)  # deliberately never submit (reference test_stall.py)
+        out = "slept"
+    try:
+        hvd.shutdown()
+    except Exception:
+        pass
+    return out
+
+
+def test_stall_shutdown_aborts_instead_of_hanging():
+    """Reference test_stall.py: a rank that never submits triggers the
+    stall inspector's warning then coordinated shutdown
+    (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; stall_inspector.cc).
+
+    Native engine only: its background loop starts at init() on every rank
+    (own TCP mesh), so rank 1's controller cycles without rank 1 ever
+    enqueueing — the precondition for observing the stall."""
+    from horovod_tpu.runtime.native import native_available
+
+    if not native_available():
+        pytest.skip("native library not built (make -C cpp)")
+    env = {
+        "HVDTPU_EAGER_ENGINE": "native",
+        "HVDTPU_STALL_CHECK_TIME": "2",
+        "HVDTPU_STALL_SHUTDOWN_TIME": "5",
+    }
+    results = hvdrun.run(_stall_fn, np=2, use_cpu=True, timeout=120, env=env)
+    # The pending op fails with the coordinated shutdown error (reference:
+    # outstanding callbacks get SHUT_DOWN_ERROR, operations.cc:526-532;
+    # the "Stalled tensor ..." detail lands in the rank-0 engine log).
+    assert "stall" in results[0].lower() or "shut down" in results[0].lower()
+    assert results[0] != "no error"
+
+
 def _torch_interop_fn():
     import numpy as np
     import torch

@@ -12,9 +12,10 @@ import horovod_tpu.interop.torch as hvd
 
 @pytest.fixture(autouse=True)
 def _init():
+    # conftest's session fixture owns the framework lifecycle; don't
+    # shutdown here or later test files lose the initialized topology.
     hvd.init()
     yield
-    hvd.shutdown()
 
 
 def test_allreduce_identity_single_process():
