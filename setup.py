@@ -1,0 +1,38 @@
+"""Build hook: compile the native eager engine during package build.
+
+Reference contrast (SURVEY.md §2.8): horovod's setup.py is 1,631 lines of
+compiler/MPI/NCCL/CUDA probing because every framework x transport pair
+needs its own extension.  Here the entire native surface is one shared
+library with no external deps beyond a C++17 toolchain, built by the
+plain Makefile in cpp/ and loaded via ctypes (horovod_tpu/runtime/native.py)
+— no Python C extension, so no per-interpreter ABI builds.  If no C++
+toolchain is available the build degrades gracefully: the pure-Python
+engine is a full functional twin (HVDTPU_EAGER_ENGINE=python).
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        root = Path(__file__).parent
+        if shutil.which("make") and shutil.which("g++"):
+            try:
+                subprocess.run(
+                    ["make", "-C", str(root / "cpp")], check=True
+                )
+            except subprocess.CalledProcessError as e:
+                print(f"warning: native engine build failed ({e}); "
+                      "falling back to the pure-Python engine")
+        else:
+            print("warning: make/g++ not found; packaging without the "
+                  "native engine (pure-Python engine will be used)")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})
