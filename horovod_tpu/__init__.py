@@ -31,6 +31,16 @@ from .basics import (  # noqa: F401
     num_devices,
     device_rank,
     is_homogeneous,
+    xla_collectives_built,
+    native_engine_built,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    gloo_built,
+    gloo_enabled,
+    nccl_built,
+    ccl_built,
+    ddl_built,
     mesh,
     global_topology,
     DP_AXIS,

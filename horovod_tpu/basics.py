@@ -305,6 +305,65 @@ def is_homogeneous() -> bool:
     return global_topology().homogeneous
 
 
+# -- feature probes (reference horovod_mpi_built/_enabled, horovod_gloo_*,
+# horovod_nccl_built, horovod_mpi_threads_supported — operations.cc:726-799,
+# basics.py:131-210).  The TPU build's transports are XLA collectives and
+# the native TCP engine; the reference-named probes answer for migrating
+# scripts that gate on them. --
+
+
+def xla_collectives_built() -> bool:
+    """The jit/SPMD data path (≙ nccl_built): always compiled in."""
+    return True
+
+
+def native_engine_built() -> bool:
+    """The C++ eager engine (≙ gloo_built): True when the shared library
+    is present."""
+    from .runtime import native  # noqa: PLC0415
+
+    return native.native_available()
+
+
+def mpi_built() -> bool:
+    """MPI does not exist in the TPU design (coordination is
+    jax.distributed); always False, so reference scripts take their gloo
+    branch, whose semantics the engine provides."""
+    return False
+
+
+mpi_enabled = mpi_built
+
+
+def mpi_threads_supported() -> bool:
+    """Reference basics.mpi_threads_supported: meaningless without MPI;
+    False (scripts use it only to decide multi-comm setups)."""
+    return False
+
+
+def gloo_built() -> bool:
+    """≙ reference gloo_built: the engine's TCP data path stands in for
+    gloo and is available whenever the package is (native or Python)."""
+    return True
+
+
+gloo_enabled = gloo_built
+
+
+def nccl_built() -> bool:
+    """≙ reference nccl_built: the device collective path here is XLA over
+    ICI, reported through xla_collectives_built; NCCL itself: False."""
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
 def mesh(shape: str = "flat") -> jax.sharding.Mesh:
     """Build (and cache) the named device mesh collectives compile over.
 

@@ -24,11 +24,17 @@ import torch
 from ..basics import (  # noqa: F401  (re-exported API surface)
     cross_rank,
     cross_size,
+    gloo_built,
+    gloo_enabled,
     init,
     is_homogeneous,
     is_initialized,
     local_rank,
     local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
     rank,
     shutdown,
     size,
@@ -40,6 +46,8 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "is_homogeneous",
+    "mpi_built", "mpi_enabled", "mpi_threads_supported",
+    "gloo_built", "gloo_enabled", "nccl_built",
     "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
     "allgather", "allgather_async",

@@ -161,7 +161,7 @@ class ProcessSet:
 
 # Env vars whose values must never appear on a command line (`ps` exposes
 # argv to every local user); they travel over the ssh channel's stdin.
-SENSITIVE_ENV = ("HVDTPU_SECRET",)
+SENSITIVE_ENV = ("HVDTPU_SECRET", "HVDTPU_NIC_SECRET")
 
 
 def make_ssh_command(

@@ -84,6 +84,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         "--check-build", action="store_true", dest="check_build",
         help="Print capability report and exit (reference runner.py:115-150).",
     )
+    parser.add_argument(
+        "--discover-nics", action="store_true", dest="discover_nics",
+        help="Start a task server on every host (-H/--hostfile), ring-probe "
+             "interface reachability, print the NICs usable by every host, "
+             "and exit (reference driver/task NIC discovery, "
+             "driver_service.py:128-197).",
+    )
     parser.add_argument("--verbose", action="store_true", dest="verbose")
 
     params = parser.add_argument_group("tunable parameters")
@@ -183,6 +190,107 @@ def _pick_free_port() -> int:
         return s.getsockname()[1]
 
 
+def _resolve_host_slots(
+    hosts: Optional[str], hostfile: Optional[str], default: str
+):
+    """hosts/hostfile/default cascade shared by launch_job and
+    discover_nics (reference hostfile/LSF resolution, runner.py:552-627)."""
+    if hostfile:
+        return parse_hostfile(hostfile)
+    if hosts:
+        return parse_hosts(hosts)
+    return parse_hosts(default)
+
+
+def _read_port_line(p, deadline: float) -> Optional[int]:
+    """Read the HVDTPU_TASK_PORT= line with a real deadline — readline has
+    no timeout, so it runs on a reaper thread joined with the remaining
+    time (a hung ssh channel must not wedge discovery)."""
+    import threading  # noqa: PLC0415
+    import time  # noqa: PLC0415
+
+    result: List[Optional[int]] = [None]
+
+    def reader():
+        while True:
+            line = p.stdout.readline()
+            if not line:
+                return
+            if line.startswith("HVDTPU_TASK_PORT="):
+                result[0] = int(line.strip().split("=", 1)[1])
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(max(deadline - time.time(), 0.1))
+    return result[0]
+
+
+def discover_nics(
+    hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    *,
+    ssh_port: Optional[int] = None,
+    timeout: float = 30.0,
+) -> List[str]:
+    """Start a task server on every job host, ring-probe reachability,
+    return the interfaces usable by all (reference _run's NIC discovery,
+    runner.py:552-627 + driver/driver_service.py:128-197)."""
+    import subprocess  # noqa: PLC0415
+    import time  # noqa: PLC0415
+
+    from . import driver_service as ds  # noqa: PLC0415
+    from .exec import make_ssh_command  # noqa: PLC0415
+
+    host_slots = _resolve_host_slots(hosts, hostfile, "localhost:1")
+    hostnames = [hs.hostname for hs in host_slots]
+
+    key = ds.make_secret()
+    server_cmd = [sys.executable, "-m", "horovod_tpu.run.driver_service"]
+    procs: List[subprocess.Popen] = []
+    tasks: List[tuple] = []
+    try:
+        for host in hostnames:
+            if is_local_host(host):
+                p = subprocess.Popen(
+                    server_cmd,
+                    env={**os.environ, "HVDTPU_NIC_SECRET": key},
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+                )
+            else:
+                # The secret travels over the ssh channel's stdin
+                # (SENSITIVE_ENV), never on the command line.
+                cmd, stdin_data = make_ssh_command(
+                    host, server_cmd, {"HVDTPU_NIC_SECRET": key}, ssh_port
+                )
+                p = subprocess.Popen(
+                    cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True,
+                )
+                if stdin_data:
+                    p.stdin.write(stdin_data)
+                    p.stdin.flush()
+            procs.append(p)
+        deadline = time.time() + timeout
+        for host, p in zip(hostnames, procs):
+            port = _read_port_line(p, deadline)
+            if port is None:
+                raise RuntimeError(f"task server on {host} did not report a port")
+            tasks.append((host if not is_local_host(host) else "127.0.0.1",
+                          port))
+        return ds.discover_common_interfaces(tasks, key)
+    finally:
+        for p in procs:
+            try:
+                p.stdin.close()  # task server exits on stdin EOF
+            except OSError:
+                pass
+            try:
+                p.terminate()
+            except OSError:
+                pass
+
+
 def build_slot_env(
     slot: SlotInfo,
     coordinator: str,
@@ -224,12 +332,7 @@ def launch_job(
     ``start_timeout`` bounds world formation (exported as
     HVDTPU_START_TIMEOUT, enforced by each rank's jax.distributed init);
     ``job_timeout`` is a whole-job watchdog — unset means run forever."""
-    if hostfile:
-        host_slots = parse_hostfile(hostfile)
-    elif hosts:
-        host_slots = parse_hosts(hosts)
-    else:
-        host_slots = parse_hosts(f"localhost:{np}")
+    host_slots = _resolve_host_slots(hosts, hostfile, f"localhost:{np}")
     slots = allocate(host_slots, np)
 
     first_host = slots[0].hostname
@@ -287,6 +390,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.check_build:
         print(check_build())
         return 0
+    if args.discover_nics:
+        try:
+            for iface in discover_nics(
+                hosts=args.hosts, hostfile=args.hostfile,
+                ssh_port=args.ssh_port,
+            ):
+                print(iface)
+            return 0
+        except (RuntimeError, OSError, TimeoutError, ValueError) as exc:
+            # ValueError covers forged/corrupt signed responses (_unpack).
+            print(f"hvdrun: NIC discovery failed: {exc}", file=sys.stderr)
+            return 1
     if not args.np:
         print("error: -np is required", file=sys.stderr)
         return 2

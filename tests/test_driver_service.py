@@ -1,0 +1,135 @@
+"""Driver/task NIC discovery (reference: horovod/run/driver tests inside
+test_run.py — task-server registration + interface intersection logic,
+driven in-process against localhost servers)."""
+
+import socket
+
+import pytest
+
+from horovod_tpu.run import driver_service as ds
+
+
+def test_local_addresses_excludes_loopback():
+    addrs = ds.local_addresses()
+    for iface, lst in addrs.items():
+        assert iface != "lo"
+        for a in lst:
+            assert not a.startswith("127.")
+
+
+def test_signed_roundtrip_and_bad_signature():
+    key = ds.make_secret()
+    msg = ds._pack(key, {"op": "addresses"})
+    assert ds._unpack(key, msg) == {"op": "addresses"}
+    with pytest.raises(ValueError, match="signature"):
+        ds._unpack("wrong-key", msg)
+
+
+def test_task_server_addresses_and_probe():
+    key = ds.make_secret()
+    srv = ds.TaskServer(key)
+    try:
+        out = ds.probe("127.0.0.1", srv.port, key, {"op": "addresses"})
+        assert out["addresses"] == ds.local_addresses()
+
+        # probe: the server's own port is reachable; a dead port is not
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        out = ds.probe(
+            "127.0.0.1", srv.port, key,
+            {"op": "probe", "candidates": [
+                ["ifup", "127.0.0.1", srv.port],
+                ["ifdown", "127.0.0.1", dead_port],
+            ]},
+        )
+        assert out["reachable"] == ["ifup"]
+    finally:
+        srv.close()
+
+
+def test_task_server_rejects_unsigned_request():
+    key = ds.make_secret()
+    srv = ds.TaskServer(key)
+    try:
+        with pytest.raises((ValueError, OSError)):
+            ds.probe("127.0.0.1", srv.port, "attacker-key",
+                     {"op": "addresses"}, timeout=3)
+    finally:
+        srv.close()
+
+
+def test_discover_common_interfaces_two_hosts_localhost():
+    """Two task servers standing in for two hosts: every iface that can
+    reach the neighbor's task port survives the intersection."""
+    key = ds.make_secret()
+    a, b = ds.TaskServer(key), ds.TaskServer(key)
+    try:
+        ifaces = ds.discover_common_interfaces(
+            [("127.0.0.1", a.port), ("127.0.0.1", b.port)], key
+        )
+        # Every non-loopback NIC of this machine is reachable from itself.
+        assert ifaces == sorted(ds.local_addresses())
+    finally:
+        a.close()
+        b.close()
+
+
+def test_discover_single_host_queries_the_task_server():
+    """One host: the answer must come from that host's task server (a
+    remote single host is not the driver machine)."""
+    key = ds.make_secret()
+    srv = ds.TaskServer(key)
+    try:
+        out = ds.discover_common_interfaces([("127.0.0.1", srv.port)], key)
+        assert out == sorted(ds.local_addresses())
+    finally:
+        srv.close()
+
+
+def test_discover_no_tasks_answers_locally():
+    assert ds.discover_common_interfaces([], ds.make_secret()) == sorted(
+        ds.local_addresses()
+    )
+
+
+def test_task_server_survives_malformed_request():
+    """A bad request must not kill the accept loop (the server would
+    accept but never answer again)."""
+    import socket as _s
+
+    key = ds.make_secret()
+    srv = ds.TaskServer(key)
+    try:
+        with _s.create_connection(("127.0.0.1", srv.port), timeout=5) as c:
+            c.sendall(b"garbage\nnot-json\n")
+        # malformed probe op payload (missing candidates) also survives
+        with pytest.raises(Exception):
+            ds.probe("127.0.0.1", srv.port, key, {"op": "probe"}, timeout=3)
+        out = ds.probe("127.0.0.1", srv.port, key, {"op": "addresses"})
+        assert out["addresses"] == ds.local_addresses()
+    finally:
+        srv.close()
+
+
+def test_discover_nics_end_to_end_two_local_hosts():
+    """Full driver flow: spawn task-server subprocesses for a 2-host job
+    spec (both localhost), intersect, tear down (reference _run NIC
+    discovery; CLI: hvdrun --discover-nics)."""
+    from horovod_tpu.run.runner import discover_nics
+
+    ifaces = discover_nics(hosts="localhost:1,localhost:1")
+    assert ifaces == sorted(ds.local_addresses())
+
+
+def test_discover_nics_cli_flag():
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "--discover-nics"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0
+    assert out.stdout.strip()
