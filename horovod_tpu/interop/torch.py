@@ -57,6 +57,7 @@ __all__ = [
     "DistributedOptimizer",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "Compression",
+    "SyncBatchNorm",
 ]
 
 
@@ -424,6 +425,91 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
 # ---------------------------------------------------------------------------
 # state replication (reference torch/__init__.py:452-648)
 # ---------------------------------------------------------------------------
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    """Cross-rank batch norm (reference horovod/torch/sync_batch_norm.py:
+    forward allreduces sum/sqsum over the global batch; backward allreduces
+    sum_dy / sum_dy_xmu, the standard sync-BN gradient)."""
+
+    @staticmethod
+    def forward(ctx, x, weight, bias, eps):
+        dims = [0] + list(range(2, x.dim()))  # all but channel
+        count = torch.tensor(
+            [float(np.prod([x.shape[d] for d in dims]))]
+        )
+        local_sum = x.sum(dims)
+        local_sqsum = (x * x).sum(dims)
+        total = synchronize(allreduce_async(count, Sum, None))
+        gsum = synchronize(allreduce_async(local_sum, Sum, None))
+        gsqsum = synchronize(allreduce_async(local_sqsum, Sum, None))
+        n = float(total)
+        mean = gsum / n
+        var = gsqsum / n - mean * mean
+        invstd = torch.rsqrt(var + eps)
+        shape = [1, -1] + [1] * (x.dim() - 2)
+        xhat = (x - mean.reshape(shape)) * invstd.reshape(shape)
+        out = xhat * weight.reshape(shape) + bias.reshape(shape)
+        ctx.save_for_backward(xhat, weight, invstd)
+        ctx.n = n
+        ctx.dims = dims
+        return out, mean, var
+
+    @staticmethod
+    def backward(ctx, grad_out, _gm, _gv):
+        xhat, weight, invstd = ctx.saved_tensors
+        dims, n = ctx.dims, ctx.n
+        shape = [1, -1] + [1] * (grad_out.dim() - 2)
+        sum_dy = synchronize(
+            allreduce_async(grad_out.sum(dims).contiguous(), Sum, None)
+        )
+        sum_dy_xhat = synchronize(
+            allreduce_async((grad_out * xhat).sum(dims).contiguous(), Sum, None)
+        )
+        gx = (
+            weight.reshape(shape) * invstd.reshape(shape) / n
+        ) * (
+            n * grad_out
+            - sum_dy.reshape(shape)
+            - xhat * sum_dy_xhat.reshape(shape)
+        )
+        # weight/bias grads stay LOCAL (per-rank), exactly like ordinary
+        # parameter grads — DistributedOptimizer reduces them.
+        gw = (grad_out * xhat).sum(dims)
+        gb = grad_out.sum(dims)
+        return gx, gw, gb, None
+
+
+class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+    """Batch norm synchronized across all ranks (reference
+    hvd.SyncBatchNorm, horovod/torch/sync_batch_norm.py).  Statistics are
+    computed over the GLOBAL batch via engine allreduces; eval mode and
+    worlds of one fall back to the plain local op."""
+
+    def _check_input_dim(self, x):
+        if x.dim() < 2:
+            raise ValueError(f"expected at least 2D input, got {x.dim()}D")
+
+    def forward(self, x):
+        self._check_input_dim(x)
+        if not self.training or size() == 1:
+            return super().forward(x)
+        out, mean, var = _SyncBatchNormFn.apply(
+            x, self.weight, self.bias, self.eps
+        )
+        if self.track_running_stats:
+            with torch.no_grad():
+                m = self.momentum if self.momentum is not None else 0.1
+                dims = [0] + list(range(2, x.dim()))
+                local_n = float(np.prod([x.shape[d] for d in dims]))
+                n = local_n * size()
+                # torch convention: running_var stores the UNBIASED variance
+                # even though normalization uses the biased one.
+                unbiased = var * (n / max(n - 1.0, 1.0))
+                self.running_mean.mul_(1 - m).add_(mean, alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+                self.num_batches_tracked += 1
+        return out
 
 
 def broadcast_parameters(

@@ -376,11 +376,21 @@ class EagerEngine:
     # tensor_queue.h:39-41 zero-tensor substitution).
 
     def _data_allgather(self, local: np.ndarray) -> np.ndarray:
-        """Data-plane allgather over processes -> (world, *local.shape)."""
+        """Data-plane allgather over processes -> (world, *local.shape).
+
+        Transports RAW BYTES (uint8 view): jax without x64 silently casts
+        float64/int64 payloads to 32-bit, so gathering the typed array
+        would corrupt 64-bit tensors; bytes are lossless for every dtype.
+        """
         from jax.experimental import multihost_utils  # noqa: PLC0415
 
-        out = multihost_utils.process_allgather(local)
-        return np.asarray(out).reshape((self.world,) + tuple(local.shape))
+        local = np.ascontiguousarray(local)
+        raw = local.reshape(-1).view(np.uint8)
+        out = multihost_utils.process_allgather(raw)
+        flat = np.asarray(out).reshape(self.world, raw.size)
+        return (
+            flat.view(local.dtype).reshape((self.world,) + tuple(local.shape))
+        )
 
     def _execute_allreduce(self, resp: Response, entries) -> None:
         meta = getattr(resp, "_fuse_meta", None)

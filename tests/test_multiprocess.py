@@ -346,6 +346,46 @@ def test_torch_interop_across_processes(engine_env):
     assert results[0]["weights"] == results[1]["weights"]
 
 
+def _sync_bn_fn():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.interop.torch as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    torch.manual_seed(0)
+    full = torch.randn(8, 3, 4, 4, dtype=torch.float64)
+    x = full[r * 4:(r + 1) * 4].clone().requires_grad_(True)
+
+    sbn = hvd.SyncBatchNorm(3).double()
+    out = sbn(x)
+    g = torch.ones_like(out)
+    out.backward(g)
+
+    # reference: plain BN over the FULL batch on one process
+    ref_x = full.clone().requires_grad_(True)
+    bn = torch.nn.BatchNorm2d(3).double()
+    ref = bn(ref_x)
+    ref.backward(torch.ones_like(ref))
+    ok_fwd = torch.allclose(out, ref[r * 4:(r + 1) * 4], atol=1e-8)
+    ok_bwd = torch.allclose(x.grad, ref_x.grad[r * 4:(r + 1) * 4], atol=1e-8)
+    ok_stats = torch.allclose(
+        sbn.running_mean, bn.running_mean, atol=1e-8
+    ) and torch.allclose(sbn.running_var, bn.running_var, atol=1e-8)
+    hvd.shutdown()
+    return {"fwd": bool(ok_fwd), "bwd": bool(ok_bwd), "stats": bool(ok_stats)}
+
+
+def test_sync_batch_norm_matches_full_batch(engine_env):
+    """SyncBatchNorm over rank-split batches == plain BN over the full
+    batch (reference test_torch.py sync BN cases)."""
+    results = hvdrun.run(_sync_bn_fn, np=2, use_cpu=True, timeout=180,
+                         env=engine_env)
+    for r in results:
+        assert r == {"fwd": True, "bwd": True, "stats": True}
+
+
 def test_estimator_launcher_backend(tmp_path):
     """Estimator fit through the launcher (≙ Spark-task training,
     horovod/spark/runner.py): 2 worker processes, eager gradient averaging."""

@@ -132,6 +132,49 @@ def test_broadcast_optimizer_state():
     assert opt.state_dict()["state"]
 
 
+def test_sync_batch_norm_single_process_matches_local_bn():
+    torch.manual_seed(0)
+    x = torch.randn(8, 4, 5, 5, requires_grad=True)
+    x2 = x.detach().clone().requires_grad_(True)
+    sbn = hvd.SyncBatchNorm(4)
+    bn = torch.nn.BatchNorm2d(4)
+    bn.load_state_dict(sbn.state_dict())
+    # world of 1: must match plain BN exactly (fallback path)
+    out_s, out_b = sbn(x), bn(x2)
+    assert torch.allclose(out_s, out_b, atol=1e-6)
+    out_s.sum().backward()
+    out_b.sum().backward()
+    assert torch.allclose(x.grad, x2.grad, atol=1e-6)
+
+
+def test_sync_batch_norm_fn_gradcheck_single():
+    """The custom Function (stats via engine allreduce) must match plain
+    batch norm numerics in a world of one, forward and backward."""
+    torch.manual_seed(1)
+    x = torch.randn(6, 3, requires_grad=True, dtype=torch.float64)
+    w = torch.ones(3, requires_grad=True, dtype=torch.float64)
+    b = torch.zeros(3, requires_grad=True, dtype=torch.float64)
+    from horovod_tpu.interop.torch import _SyncBatchNormFn
+
+    out, mean, var = _SyncBatchNormFn.apply(x, w, b, 1e-5)
+    ref = torch.nn.functional.batch_norm(
+        x, None, None, w, b, training=True, eps=1e-5
+    )
+    assert torch.allclose(out, ref, atol=1e-8)
+    g = torch.randn_like(out)
+    out.backward(g)
+    x2 = x.detach().clone().requires_grad_(True)
+    w2 = w.detach().clone().requires_grad_(True)
+    b2 = b.detach().clone().requires_grad_(True)
+    ref2 = torch.nn.functional.batch_norm(
+        x2, None, None, w2, b2, training=True, eps=1e-5
+    )
+    ref2.backward(g)
+    assert torch.allclose(x.grad, x2.grad, atol=1e-7)
+    assert torch.allclose(w.grad, w2.grad, atol=1e-7)
+    assert torch.allclose(b.grad, b2.grad, atol=1e-7)
+
+
 def test_compression_fp16_roundtrip():
     t = torch.randn(8)
     wire, ctx = hvd.Compression.fp16.compress(t)
