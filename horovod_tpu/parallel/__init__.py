@@ -2,7 +2,10 @@
 cross x local mesh, cross-replica batch norm, sequence/context parallelism
 (ring attention, Ulysses all-to-all), and sharding helpers."""
 
-from .hierarchical import hierarchical_allreduce  # noqa: F401
+from .hierarchical import (  # noqa: F401
+    hierarchical_adasum,
+    hierarchical_allreduce,
+)
 from .ring_attention import (  # noqa: F401
     local_attention,
     ring_attention,

@@ -26,7 +26,7 @@ from jax import lax
 from ..basics import CROSS_AXIS, LOCAL_AXIS
 from ..ops.collectives import Average, ReduceOp, Sum
 
-__all__ = ["hierarchical_allreduce"]
+__all__ = ["hierarchical_allreduce", "hierarchical_adasum"]
 
 
 def hierarchical_allreduce(
@@ -64,5 +64,49 @@ def hierarchical_allreduce(
         if op == Average:
             out = out / (local_n * lax.axis_size(cross_axis))
         return out
+
+    return jax.tree_util.tree_map(one, tensor)
+
+
+def hierarchical_adasum(
+    tensor,
+    *,
+    local_axis: str = LOCAL_AXIS,
+    cross_axis: str = CROSS_AXIS,
+):
+    """Two-level Adasum (reference AdasumGpuAllreduceOp,
+    horovod/common/ops/adasum_gpu_operations.cc: NCCL ReduceScatter
+    intra-node -> Adasum-MPI VHDD across nodes -> NCCL Allgather).
+
+    Local ranks hold correlated gradients (same data distribution), so a
+    plain sum intra-slice is the right estimator; the Adasum projection is
+    applied only across slices, exactly the reference's hierarchy.  Call
+    inside shard_map over ``mesh("hierarchical")``; the cross axis must be
+    a power of two (VHDD pairing).
+    """
+    from ..ops.adasum import adasum_allreduce  # noqa: PLC0415
+
+    def one(x):
+        x = jnp.asarray(x)
+        shape = x.shape
+        local_n = lax.axis_size(local_axis)
+        flat = jnp.ravel(x)
+        pad = (-flat.size) % local_n
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        # Phase 1 (ICI): reduce-scatter, averaging within the slice (the
+        # reference scales by 1/local_size before the cross-node VHDD —
+        # adasum_gpu_operations.cc ScaleBuffer path).
+        shard = (
+            lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
+            / local_n
+        )
+        # Phase 2 (DCN): Adasum projection on the shards across slices.
+        shard = adasum_allreduce(shard, axis_name=cross_axis)
+        # Phase 3 (ICI): gather the combined shards back.
+        full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
+        if pad:
+            full = full[:-pad]
+        return full.reshape(shape)
 
     return jax.tree_util.tree_map(one, tensor)
