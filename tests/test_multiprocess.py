@@ -249,17 +249,17 @@ def _stall_fn():
 
     hvd.init()
     r = hvd.rank()
-    out = None
+    t0 = time.monotonic()
     if r == 0:
         # Submit immediately; rank 1 never will -> stall -> shutdown.
         try:
             hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="stalled")
-            out = "no error"
+            out = ("no error", 0.0)
         except RuntimeError as e:
-            out = str(e)
+            out = (str(e), time.monotonic() - t0)
     else:
-        time.sleep(20)  # deliberately never submit (reference test_stall.py)
-        out = "slept"
+        time.sleep(25)  # deliberately never submit (reference test_stall.py)
+        out = ("slept", time.monotonic() - t0)
     try:
         hvd.shutdown()
     except Exception:
@@ -281,15 +281,18 @@ def test_stall_shutdown_aborts_instead_of_hanging():
         pytest.skip("native library not built (make -C cpp)")
     env = {
         "HVDTPU_EAGER_ENGINE": "native",
-        "HVDTPU_STALL_CHECK_TIME": "2",
-        "HVDTPU_STALL_SHUTDOWN_TIME": "5",
+        "HVDTPU_STALL_CHECK_TIME_SECONDS": "2",
+        "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS": "5",
     }
     results = hvdrun.run(_stall_fn, np=2, use_cpu=True, timeout=120, env=env)
+    msg, t_err = results[0]
     # The pending op fails with the coordinated shutdown error (reference:
     # outstanding callbacks get SHUT_DOWN_ERROR, operations.cc:526-532;
     # the "Stalled tensor ..." detail lands in the rank-0 engine log).
-    assert "stall" in results[0].lower() or "shut down" in results[0].lower()
-    assert results[0] != "no error"
+    assert "stall" in msg.lower() or "shut down" in msg.lower()
+    # Must be the STALL inspector (fires ~5-7 s in), not rank 1's exit at
+    # 25 s — wrong env names would make this pass via the slow path.
+    assert t_err < 15, f"stall shutdown should fire ~6s in, got {t_err:.0f}s"
 
 
 def _torch_interop_fn():
