@@ -1,0 +1,471 @@
+"""``horovod.tensorflow``-compatible API on host TF tensors.
+
+A drop-in migration surface for reference users
+(horovod/tensorflow/__init__.py, horovod/tensorflow/mpi_ops.py): the same
+``init/rank/size``, ``allreduce`` (with the IndexedSlices -> allgather
+dispatch, reference tensorflow/__init__.py:74-89), ``DistributedOptimizer``
+(:266-311 legacy / keras routing :451-470), ``DistributedGradientTape``
+(:474-531), and ``broadcast_variables`` (:166-191), executed by this
+framework's eager engine over its host data plane.
+
+TensorFlow here is the *host* framework — CPU tensors in, CPU tensors out.
+The TPU compute path remains JAX; this module exists so a reference TF
+script ports one-to-one.  Collectives are wrapped in ``tf.py_function`` so
+they also run from inside ``tf.function`` graphs (the reference's AsyncOp
+kernels are graph ops for the same reason, tensorflow/mpi_ops.cc:287-321).
+
+Gradient parity: ``tf.custom_gradient`` wrappers implement the reference's
+registered gradients — allreduce -> allreduce (tensorflow/mpi_ops.py
+``_allreduce_grad``), allgather -> reduce + slice by rank offsets,
+broadcast -> reduce, zero on non-root ranks.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..basics import (  # noqa: F401  (re-exported API surface)
+    cross_rank,
+    cross_size,
+    gloo_built,
+    gloo_enabled,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    shutdown,
+    size,
+)
+from ..ops import eager
+from ..ops.collectives import Adasum, Average, Max, Min, ReduceOp, Sum  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "is_homogeneous",
+    "mpi_built", "mpi_enabled", "mpi_threads_supported",
+    "gloo_built", "gloo_enabled", "nccl_built",
+    "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
+    "allreduce", "allgather", "broadcast", "alltoall",
+    "join", "barrier",
+    "broadcast_variables", "broadcast_global_variables",
+    "broadcast_object",
+    "DistributedOptimizer", "DistributedGradientTape",
+    "Compression",
+]
+
+
+# ---------------------------------------------------------------------------
+# tensor conversion + compression
+# ---------------------------------------------------------------------------
+
+_WIRE_UPCAST = (tf.bfloat16, tf.float16)  # engine wire is f32 for halves
+
+
+class Compression:
+    """Gradient compression (reference tensorflow/compression.py:20-74):
+    ``none`` passes through, ``fp16`` casts to half for the wire and back
+    after the reduction."""
+
+    class none:  # noqa: N801 — reference spelling
+        @staticmethod
+        def compress(tensor):
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    class fp16:  # noqa: N801
+        @staticmethod
+        def compress(tensor):
+            ctx = tensor.dtype
+            if tensor.dtype.is_floating:
+                tensor = tf.cast(tensor, tf.float16)
+            return tensor, ctx
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            if ctx is not None and tensor.dtype != ctx:
+                tensor = tf.cast(tensor, ctx)
+            return tensor
+
+
+# ---------------------------------------------------------------------------
+# core collectives (graph-safe via py_function, custom gradients)
+# ---------------------------------------------------------------------------
+
+def _run_collective(fn, tensor: tf.Tensor, out_dtype=None) -> tf.Tensor:
+    """Run ``fn(np_array) -> np_array`` as a graph-safe op.  Shapes are
+    restored by the caller (py_function erases static shape info)."""
+    in_dtype = tensor.dtype
+    wire_dtype = tf.float32 if in_dtype in _WIRE_UPCAST else in_dtype
+    out_dtype = out_dtype or in_dtype
+
+    def _impl(x):
+        out = fn(x.numpy())
+        return tf.convert_to_tensor(np.asarray(out))
+
+    cast_in = tf.cast(tensor, wire_dtype) if in_dtype != wire_dtype else tensor
+    result = tf.py_function(_impl, [cast_in], Tout=wire_dtype)
+    if out_dtype != wire_dtype:
+        result = tf.cast(result, out_dtype)
+    return result
+
+
+def _allreduce(tensor, name: Optional[str] = None, op: ReduceOp = Sum,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Sum-allreduce primitive (reference tensorflow/mpi_ops.py:93-117;
+    averaging happens in framework code, tensorflow/__init__.py:76)."""
+    tensor = tf.convert_to_tensor(tensor)
+    name = name or eager._auto_name("HorovodAllreduce")
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _run_collective(
+            lambda v: eager.allreduce(
+                v, op=op, name=name,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            ),
+            x,
+        )
+        y.set_shape(x.shape)
+
+        def grad(dy):
+            # reference _allreduce_grad: the gradient of an allreduce is
+            # the same allreduce of the gradients.
+            return _allreduce(dy, name + "_grad", op,
+                              prescale_factor, postscale_factor)
+
+        return y, grad
+
+    return _fn(tensor)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate along dim 0 across ranks; ragged dim 0 supported
+    (reference tensorflow/mpi_ops.py:120-142, sizes negotiated by the
+    controller)."""
+    tensor = tf.convert_to_tensor(tensor)
+    name = name or eager._auto_name("HorovodAllgather")
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _run_collective(
+            lambda v: eager.allgather(v, name=name), x
+        )
+        y.set_shape([None] + list(x.shape[1:]))
+        # Dynamic shape op, not the static x.shape[0]: under tf.function
+        # with an unknown batch dim the static value is None.
+        d0 = (tf.cast(tf.shape(x)[0], tf.int64)
+              if x.shape.rank else tf.constant(1, tf.int64))
+
+        def grad(dy):
+            # reference allgather gradient: reduce the gathered grads and
+            # slice out this rank's rows by the negotiated offsets.
+            sizes = allgather(tf.reshape(d0, [1]), name + "_sizes")
+            reduced = _allreduce(dy, name + "_grad", Sum)
+            start = tf.reduce_sum(sizes[: rank()])
+            trailing = tf.fill([tf.rank(reduced) - 1],
+                               tf.constant(-1, tf.int64))
+            begin = tf.concat(
+                [[start], tf.zeros([tf.rank(reduced) - 1], tf.int64)], 0
+            )
+            return tf.slice(reduced, begin, tf.concat([[d0], trailing], 0))
+
+        return y, grad
+
+    return _fn(tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    """Broadcast from root (reference tensorflow/mpi_ops.py:145-168)."""
+    tensor = tf.convert_to_tensor(tensor)
+    name = name or eager._auto_name("HorovodBroadcast")
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _run_collective(
+            lambda v: eager.broadcast(v, root_rank, name=name), x
+        )
+        y.set_shape(x.shape)
+
+        def grad(dy):
+            # reference broadcast gradient: reduce grads to the root,
+            # other ranks contribute but receive zero.
+            reduced = _allreduce(dy, name + "_grad", Sum)
+            if rank() == root_rank:
+                return reduced
+            return tf.zeros_like(reduced)
+
+        return y, grad
+
+    return _fn(tensor)
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    tensor = tf.convert_to_tensor(tensor)
+    y = _run_collective(lambda v: eager.alltoall(v, name=name), tensor)
+    y.set_shape([None] + list(tensor.shape[1:]))
+    return y
+
+
+def join() -> int:
+    return eager.join()
+
+
+def barrier() -> None:
+    eager.barrier()
+
+
+# ---------------------------------------------------------------------------
+# user-facing allreduce with IndexedSlices dispatch
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average=None, device_dense="", device_sparse="",
+              compression=Compression.none, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Allreduce a tf.Tensor or tf.IndexedSlices (reference
+    tensorflow/__init__.py:43-118).  IndexedSlices become an allgather of
+    values+indices; ``Average`` is Sum plus a divide in framework code;
+    the ``device_*`` arguments are accepted for source compatibility and
+    ignored (there is one host data plane)."""
+    del device_dense, device_sparse
+    if op is None:
+        op = Sum if average is False else Average
+    true_op = Sum if op == Average else op
+
+    if isinstance(tensor, tf.IndexedSlices):
+        if op == Adasum:
+            raise NotImplementedError(
+                "The Adasum reduction does not currently support sparse "
+                "tensors. As a workaround please pass sparse_as_dense=True "
+                "to DistributedOptimizer"
+            )
+        # reference tensorflow/__init__.py:74-89: two allgathers instead
+        # of an allreduce on the represented dense tensor.
+        values = allgather(tensor.values)
+        indices = allgather(tensor.indices)
+        if op == Average:
+            values = values / tf.cast(size(), values.dtype)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    tensor = tf.convert_to_tensor(tensor)
+    compressed, ctx = compression.compress(tensor)
+    summed = _allreduce(compressed, None, true_op,
+                        prescale_factor, postscale_factor)
+    summed = compression.decompress(summed, ctx)
+    if op == Average:
+        return summed / tf.cast(size(), summed.dtype)
+    return summed
+
+
+# ---------------------------------------------------------------------------
+# variable broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast_variables(variables: Iterable[tf.Variable],
+                        root_rank: int = 0) -> None:
+    """Assign every variable its root-rank value (reference
+    tensorflow/__init__.py:166-191 broadcast_global_variables /
+    broadcast_variables)."""
+    for i, var in enumerate(variables):
+        name = getattr(var, "name", None) or f"var.{i}"
+        value = broadcast(
+            tf.convert_to_tensor(var), root_rank,
+            f"broadcast.{name.replace(':', '_').replace('/', '_')}"
+        )
+        var.assign(tf.cast(value, var.dtype))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1-compat spelling: broadcast tf.compat.v1 global variables
+    (reference tensorflow/__init__.py:129-147)."""
+    try:
+        variables = tf.compat.v1.global_variables()
+    except AttributeError as exc:  # future TF without compat.v1
+        raise NotImplementedError(
+            "broadcast_global_variables requires tf.compat.v1; use "
+            "broadcast_variables(model.variables, root_rank) instead"
+        ) from exc
+    broadcast_variables(variables, root_rank)
+
+
+def broadcast_object(obj, root_rank: int = 0):
+    """Arbitrary-object broadcast via the shared pickle path (reference
+    torch/__init__.py:608-648; the TF frontend reuses it)."""
+    from ..optim import broadcast_object as _bo  # noqa: PLC0415
+
+    return _bo(obj, root_rank=root_rank)
+
+
+# ---------------------------------------------------------------------------
+# optimizers and tapes
+# ---------------------------------------------------------------------------
+
+def _make_allreduce_grads_fn(name, compression, sparse_as_dense, op):
+    """reference tensorflow/__init__.py:230-251."""
+
+    def _one(g):
+        if g is None:
+            return None
+        if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+            g = tf.convert_to_tensor(g)
+        return allreduce(g, compression=compression, op=op)
+
+    def allreduce_grads(grads):
+        # Preserve the caller's structure: tape.gradient with a single
+        # source returns a bare tensor, not a list.
+        if isinstance(grads, (list, tuple)):
+            return type(grads)(_one(g) for g in grads)
+        return _one(grads)
+
+    return allreduce_grads
+
+
+try:
+    _LegacyOptimizer = tf.compat.v1.train.Optimizer
+except AttributeError:
+    _LegacyOptimizer = None
+
+
+if _LegacyOptimizer is not None:
+    class _DistributedOptimizer(_LegacyOptimizer):
+        """Legacy-graph optimizer wrapper: allreduce inside
+        compute_gradients (reference tensorflow/__init__.py:266-311)."""
+
+        def __init__(self, optimizer, name=None, use_locking=False,
+                     compression=Compression.none, sparse_as_dense=False,
+                     op=Average):
+            if name is None:
+                name = f"Distributed{type(optimizer).__name__}"
+            super().__init__(name=name, use_locking=use_locking)
+            self._optimizer = optimizer
+            self._allreduce_grads = _make_allreduce_grads_fn(
+                name, compression, sparse_as_dense, op
+            )
+
+        def compute_gradients(self, *args, **kwargs):
+            gradients = self._optimizer.compute_gradients(*args, **kwargs)
+            if size() > 1:
+                grads, variables = zip(*gradients)
+                avg_grads = self._allreduce_grads(grads)
+                return list(zip(avg_grads, variables))
+            return gradients
+
+        def apply_gradients(self, *args, **kwargs):
+            return self._optimizer.apply_gradients(*args, **kwargs)
+
+        def get_slot(self, *args, **kwargs):
+            return self._optimizer.get_slot(*args, **kwargs)
+
+        def get_slot_names(self, *args, **kwargs):
+            return self._optimizer.get_slot_names(*args, **kwargs)
+
+        def variables(self, *args, **kwargs):
+            return self._optimizer.variables(*args, **kwargs)
+
+
+def _wrap_keras_optimizer(optimizer, compression, sparse_as_dense, op):
+    """Keras optimizer wrapper: allreduce inside apply_gradients
+    (reference _keras/__init__.py:20-87 overrides gradient aggregation;
+    modern Keras makes apply_gradients the one stable seam)."""
+    allreduce_grads = _make_allreduce_grads_fn(
+        "DistributedKeras", compression, sparse_as_dense, op
+    )
+
+    base_cls = optimizer.__class__
+
+    class _DistributedKerasOptimizer(base_cls):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            if size() > 1:
+                grads_and_vars = list(grads_and_vars)
+                grads = [g for g, _ in grads_and_vars]
+                variables = [v for _, v in grads_and_vars]
+                grads = allreduce_grads(grads)
+                grads_and_vars = list(zip(grads, variables))
+            return super().apply_gradients(grads_and_vars, *args, **kwargs)
+
+    _DistributedKerasOptimizer.__name__ = f"Distributed{base_cls.__name__}"
+    return _DistributedKerasOptimizer.from_config(optimizer.get_config())
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         sparse_as_dense=False, backward_passes_per_step=1,
+                         op=Average):
+    """Wrap a TF optimizer so gradients are combined across ranks before
+    they are applied (reference tensorflow/__init__.py:408-470)."""
+    del device_dense, device_sparse
+    if backward_passes_per_step > 1:
+        raise ValueError(
+            "backward_passes_per_step > 1 is not supported by the TF "
+            "frontend; accumulate with optax.MultiSteps on the JAX path"
+        )
+    if _LegacyOptimizer is not None and isinstance(optimizer,
+                                                   _LegacyOptimizer):
+        return _DistributedOptimizer(optimizer, name, use_locking,
+                                     compression, sparse_as_dense, op)
+    if hasattr(optimizer, "apply_gradients") and hasattr(optimizer,
+                                                         "get_config"):
+        return _wrap_keras_optimizer(optimizer, compression,
+                                     sparse_as_dense, op)
+    raise ValueError(
+        "Provided optimizer doesn't inherit from either legacy TensorFlow "
+        f"or Keras optimizer: {optimizer}"
+    )
+
+
+class _DistributedGradientTape(tf.GradientTape):
+    """reference tensorflow/__init__.py:474-493."""
+
+    def __init__(self, tape, compression, sparse_as_dense, op,
+                 persistent=False, watch_accessed_variables=True):
+        super().__init__(persistent=persistent,
+                         watch_accessed_variables=watch_accessed_variables)
+        self._tape = tape
+        self._allreduce_grads = _make_allreduce_grads_fn(
+            "DistributedGradientTape", compression, sparse_as_dense, op
+        )
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *args):
+        return self._tape.__exit__(*args)
+
+    def watch(self, tensor):
+        return self._tape.watch(tensor)
+
+    def gradient(self, target, sources, output_gradients=None):
+        gradients = self._tape.gradient(target, sources, output_gradients)
+        if size() > 1:
+            return self._allreduce_grads(gradients)
+        return gradients
+
+
+def DistributedGradientTape(gradtape, device_dense="", device_sparse="",
+                            compression=Compression.none,
+                            sparse_as_dense=False, op=Average):
+    """Wrap a tf.GradientTape so .gradient() returns rank-combined grads
+    (reference tensorflow/__init__.py:495-531)."""
+    del device_dense, device_sparse
+    return _DistributedGradientTape(
+        gradtape, compression, sparse_as_dense, op,
+        persistent=getattr(gradtape, "_persistent", False),
+    )

@@ -499,7 +499,14 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
         )
         if self.track_running_stats:
             with torch.no_grad():
-                m = self.momentum if self.momentum is not None else 0.1
+                self.num_batches_tracked += 1
+                # momentum=None means cumulative moving average
+                # (torch._BatchNorm.forward contract, factor
+                # 1/num_batches_tracked), not a fixed 0.1.
+                if self.momentum is None:
+                    m = 1.0 / float(self.num_batches_tracked)
+                else:
+                    m = self.momentum
                 dims = [0] + list(range(2, x.dim()))
                 local_n = float(np.prod([x.shape[d] for d in dims]))
                 n = local_n * size()
@@ -508,7 +515,6 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
                 unbiased = var * (n / max(n - 1.0, 1.0))
                 self.running_mean.mul_(1 - m).add_(mean, alpha=m)
                 self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
-                self.num_batches_tracked += 1
         return out
 
 

@@ -241,28 +241,50 @@ def grouped_allreduce(
     axis_name: str = DP_AXIS,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    fusion_threshold_bytes: Optional[int] = None,
 ):
-    """Fused allreduce of a list of tensors via a single flat buffer.
+    """Fused allreduce of a list of tensors via flat buffers.
 
     TPU-native tensor fusion: the reference memcpys entries into a 64 MB
     fusion buffer around one NCCL call
     (horovod/common/fusion_buffer_manager.cc,
-    collective_operations.cc:159-210); here we flatten+concat into one
-    1-D buffer, issue one psum, and split back.  Under jit XLA usually fuses
-    adjacent psums anyway; this makes the fusion explicit and guarantees a
-    single collective launch.
+    collective_operations.cc:159-210); here we flatten+concat into 1-D
+    buffers, issue one psum per buffer, and split back.  Like the
+    reference's FuseResponses (controller.cc:640-761), fused bins are
+    capped at the fusion threshold (HVDTPU_FUSION_THRESHOLD, default
+    64 MB) per dtype, so the flat buffer never materializes an unbounded
+    extra copy of the gradients at peak memory.  A single leaf larger
+    than the threshold gets its own bin (the reference likewise never
+    splits one tensor across fusion buffers).
     """
     leaves, treedef = jax.tree_util.tree_flatten(list(tensors))
     if not leaves:
         return tensors
-    # Promote to a common dtype bucket per dtype, preserving exact dtypes:
-    # fuse only same-dtype runs (the reference fuses per dtype too —
-    # controller.cc:676-689 look-ahead keeps dtypes homogeneous per fusion).
+    if fusion_threshold_bytes is None:
+        from ..utils import env as envmod  # noqa: PLC0415
+
+        fusion_threshold_bytes = envmod.env_int(
+            envmod.FUSION_THRESHOLD, envmod.DEFAULT_FUSION_BYTES
+        )
+    # Fuse only same-dtype runs (the reference fuses per dtype too —
+    # controller.cc:676-689 look-ahead keeps dtypes homogeneous per
+    # fusion), then chunk each dtype's leaves into <=threshold bins.
     out = [None] * len(leaves)
     by_dtype: dict = {}
     for i, leaf in enumerate(leaves):
         by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
-    for dtype, idxs in by_dtype.items():
+
+    def _reduce_bin(idxs):
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = allreduce(
+                jnp.asarray(leaves[i]),
+                op,
+                axis_name=axis_name,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+            return
         flat = jnp.concatenate(
             [jnp.ravel(jnp.asarray(leaves[i])) for i in idxs]
         )
@@ -280,6 +302,20 @@ def grouped_allreduce(
                 jnp.shape(leaves[i])
             )
             offset += n
+
+    for dtype, idxs in by_dtype.items():
+        itemsize = jnp.dtype(dtype).itemsize
+        bin_idxs: list = []
+        bin_bytes = 0
+        for i in idxs:
+            nbytes = jnp.asarray(leaves[i]).size * itemsize
+            if bin_idxs and bin_bytes + nbytes > fusion_threshold_bytes:
+                _reduce_bin(bin_idxs)
+                bin_idxs, bin_bytes = [], 0
+            bin_idxs.append(i)
+            bin_bytes += nbytes
+        if bin_idxs:
+            _reduce_bin(bin_idxs)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -216,8 +216,8 @@ def _read_port_line(p, deadline: float) -> Optional[int]:
             line = p.stdout.readline()
             if not line:
                 return
-            if line.startswith("HVDTPU_TASK_PORT="):
-                result[0] = int(line.strip().split("=", 1)[1])
+            if line.startswith(b"HVDTPU_TASK_PORT="):
+                result[0] = int(line.strip().split(b"=", 1)[1])
                 return
 
     t = threading.Thread(target=reader, daemon=True)
@@ -251,11 +251,14 @@ def discover_nics(
     tasks: List[tuple] = []
     try:
         for host in hostnames:
+            # Binary pipes throughout (like exec.py's ProcessSet.launch):
+            # make_ssh_command returns bytes stdin_data, and mixing
+            # text=True with bytes writes raises TypeError.
             if is_local_host(host):
                 p = subprocess.Popen(
                     server_cmd,
                     env={**os.environ, "HVDTPU_NIC_SECRET": key},
-                    stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 )
             else:
                 # The secret travels over the ssh channel's stdin
@@ -265,7 +268,6 @@ def discover_nics(
                 )
                 p = subprocess.Popen(
                     cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                    text=True,
                 )
                 if stdin_data:
                     p.stdin.write(stdin_data)
@@ -287,6 +289,16 @@ def discover_nics(
                 pass
             try:
                 p.terminate()
+            except OSError:
+                pass
+        for p in procs:
+            # Reap: without wait() a long-lived caller of the Python API
+            # accumulates zombies (the CLI path exits so it never noticed).
+            try:
+                p.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
             except OSError:
                 pass
 

@@ -11,6 +11,7 @@ import os
 
 # Canonical knob names (HVDTPU_* ≙ HOROVOD_* of common.h:62-88).
 FUSION_THRESHOLD = "HVDTPU_FUSION_THRESHOLD"
+DEFAULT_FUSION_BYTES = 64 * 1024 * 1024  # reference operations.cc:419
 CYCLE_TIME = "HVDTPU_CYCLE_TIME"
 TIMELINE = "HVDTPU_TIMELINE"
 TIMELINE_MARK_CYCLES = "HVDTPU_TIMELINE_MARK_CYCLES"

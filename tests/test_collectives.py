@@ -132,6 +132,67 @@ def test_grouped_allreduce_matches_individual():
         )
 
 
+def test_grouped_allreduce_threshold_chunks():
+    """A pytree larger than the fusion threshold is reduced in multiple
+    <=threshold bins (reference FuseResponses 64 MB cap,
+    controller.cc:640-761) — count psums in the jaxpr — with numerics
+    identical to the unchunked result."""
+    # 3 leaves x 1000 f32 = 4000 B each; threshold 9000 B -> leaf 1+2
+    # fuse (8000 B), leaf 3 opens a new bin -> 2 psums (vs 1 uncapped).
+    xs = [stacked((1000,), jnp.float32, seed=i) for i in range(3)]
+
+    def count_psums(threshold):
+        def fn(*vs):
+            outs = hvd.grouped_allreduce(
+                [v[0] for v in vs], op=hvd.Sum,
+                fusion_threshold_bytes=threshold,
+            )
+            return tuple(o[None] for o in outs)
+
+        mesh = hvd.mesh("flat")
+        wrapped = shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=tuple(P(hvd.DP_AXIS) for _ in xs),
+            out_specs=tuple(P(hvd.DP_AXIS) for _ in xs),
+        )
+        jaxpr = str(jax.make_jaxpr(wrapped)(*xs))
+        return jaxpr.count("psum"), wrapped
+
+    n_unchunked, _ = count_psums(1 << 30)
+    n_chunked, wrapped = count_psums(9000)
+    assert n_unchunked == 1
+    assert n_chunked == 2
+    outs = wrapped(*xs)
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(
+            o[0], jnp.sum(x, axis=0), rtol=1e-5
+        )
+
+
+def test_grouped_allreduce_oversize_leaf_own_bin():
+    """A single leaf above the threshold is not split and still reduces
+    correctly alongside small leaves."""
+    xs = [stacked((64,), jnp.float32, seed=0),
+          stacked((5000,), jnp.float32, seed=1)]
+
+    def fn(*vs):
+        outs = hvd.grouped_allreduce(
+            [v[0] for v in vs], op=hvd.Sum, fusion_threshold_bytes=1024
+        )
+        return tuple(o[None] for o in outs)
+
+    mesh = hvd.mesh("flat")
+    outs = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=tuple(P(hvd.DP_AXIS) for _ in xs),
+        out_specs=tuple(P(hvd.DP_AXIS) for _ in xs),
+    )(*xs)
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(o[0], jnp.sum(x, axis=0), rtol=1e-5)
+
+
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.int32])
 def test_allgather(dtype):
     x = stacked((3, 2), dtype)

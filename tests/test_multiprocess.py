@@ -349,6 +349,71 @@ def test_torch_interop_across_processes(engine_env):
     assert results[0]["weights"] == results[1]["weights"]
 
 
+def _tf_interop_fn():
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.interop.tf as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = {}
+    out["allreduce"] = hvd.allreduce(
+        tf.fill((3,), float(r + 1)), op=hvd.Sum
+    ).numpy().tolist()
+    out["allgather"] = hvd.allgather(
+        tf.fill((r + 1, 2), float(r))
+    ).numpy().tolist()
+    out["broadcast"] = hvd.broadcast(
+        tf.constant([float(10 * (r + 1))]), root_rank=1
+    ).numpy().tolist()
+
+    # IndexedSlices across processes: rank r contributes row index r
+    slices = tf.IndexedSlices(
+        values=tf.constant([[float(r + 1), float(r + 1)]]),
+        indices=tf.constant([r], dtype=tf.int64),
+        dense_shape=tf.constant([4, 2], dtype=tf.int64),
+    )
+    red = hvd.allreduce(slices, op=hvd.Sum)
+    out["sparse_values"] = red.values.numpy().tolist()
+    out["sparse_indices"] = red.indices.numpy().tolist()
+
+    # DistributedGradientTape: divergent per-rank grads are averaged
+    v = tf.Variable([2.0])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(v * float(r + 1))
+    grad = tape.gradient(loss, v)
+    out["tape_grad"] = grad.numpy().tolist()  # avg of [1, 2] = 1.5
+
+    # Keras DistributedOptimizer: identical start + averaged grads ->
+    # identical weights after the step
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+    w = tf.Variable([[1.0, 1.0]])
+    hvd.broadcast_variables([w], root_rank=0)
+    with tf.GradientTape() as t2:
+        loss2 = tf.reduce_sum(w * float(r + 1))
+    g2 = t2.gradient(loss2, w)
+    opt.apply_gradients([(g2, w)])
+    out["weights"] = w.numpy().flatten().tolist()
+    hvd.shutdown()
+    return out
+
+
+def test_tf_interop_across_processes(engine_env):
+    pytest.importorskip("tensorflow")
+    results = hvdrun.run(_tf_interop_fn, np=2, use_cpu=True,
+                         timeout=240, env=engine_env)
+    for r in results:
+        assert r["allreduce"] == [3.0, 3.0, 3.0]
+        assert r["allgather"] == [[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]]
+        assert r["broadcast"] == [20.0]
+        assert r["sparse_values"] == [[1.0, 1.0], [2.0, 2.0]]
+        assert r["sparse_indices"] == [0, 1]
+        assert r["tape_grad"] == [1.5]
+    # weight sync: both ranks identical after averaged update
+    assert results[0]["weights"] == results[1]["weights"]
+
+
 def _sync_bn_fn():
     import numpy as np
     import torch
@@ -376,8 +441,24 @@ def _sync_bn_fn():
     ok_stats = torch.allclose(
         sbn.running_mean, bn.running_mean, atol=1e-8
     ) and torch.allclose(sbn.running_var, bn.running_var, atol=1e-8)
+
+    # momentum=None: cumulative moving average (factor 1/num_batches),
+    # matching torch._BatchNorm.forward — NOT a fixed 0.1.
+    sbn_n = hvd.SyncBatchNorm(3, momentum=None).double()
+    bn_n = torch.nn.BatchNorm2d(3, momentum=None).double()
+    for step in range(3):
+        batch = torch.randn(
+            8, 3, 4, 4, dtype=torch.float64,
+            generator=torch.Generator().manual_seed(step),
+        )
+        sbn_n(batch[r * 4:(r + 1) * 4])
+        bn_n(batch)
+    ok_cma = torch.allclose(
+        sbn_n.running_mean, bn_n.running_mean, atol=1e-8
+    ) and torch.allclose(sbn_n.running_var, bn_n.running_var, atol=1e-8)
     hvd.shutdown()
-    return {"fwd": bool(ok_fwd), "bwd": bool(ok_bwd), "stats": bool(ok_stats)}
+    return {"fwd": bool(ok_fwd), "bwd": bool(ok_bwd),
+            "stats": bool(ok_stats), "cma": bool(ok_cma)}
 
 
 def test_sync_batch_norm_matches_full_batch(engine_env):
@@ -386,7 +467,7 @@ def test_sync_batch_norm_matches_full_batch(engine_env):
     results = hvdrun.run(_sync_bn_fn, np=2, use_cpu=True, timeout=180,
                          env=engine_env)
     for r in results:
-        assert r == {"fwd": True, "bwd": True, "stats": True}
+        assert r == {"fwd": True, "bwd": True, "stats": True, "cma": True}
 
 
 def test_estimator_launcher_backend(tmp_path):

@@ -1,0 +1,141 @@
+"""horovod.tensorflow-compatible interop frontend (reference surface:
+test/test_tensorflow.py — op correctness, gradients, DistributedOptimizer,
+DistributedGradientTape, IndexedSlices sparse path; single-process
+identities here, real 2-process semantics in test_multiprocess.py)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.interop.tf as hvd  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    # conftest's session fixture owns the framework lifecycle; don't
+    # shutdown here or later test files lose the initialized topology.
+    hvd.init()
+    yield
+
+
+def test_allreduce_identity_single_process():
+    x = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
+    out = hvd.allreduce(x)
+    assert isinstance(out, tf.Tensor)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+def test_allreduce_sum_bf16_roundtrip():
+    x = tf.ones((8,), dtype=tf.bfloat16)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert out.dtype == tf.bfloat16
+    np.testing.assert_allclose(tf.cast(out, tf.float32).numpy(), np.ones(8))
+
+
+def test_allreduce_inside_tf_function():
+    # py_function keeps the engine call graph-safe (reference runs these
+    # as TF graph ops, tensorflow/mpi_ops.cc).
+    @tf.function
+    def fn(x):
+        return hvd.allreduce(x, op=hvd.Sum)
+
+    out = fn(tf.constant([1.0, 2.0]))
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+
+
+def test_allreduce_indexed_slices_allgathers():
+    # reference tensorflow/__init__.py:74-89: IndexedSlices -> allgather
+    # of values and indices.
+    slices = tf.IndexedSlices(
+        values=tf.constant([[1.0, 2.0], [3.0, 4.0]]),
+        indices=tf.constant([0, 3], dtype=tf.int64),
+        dense_shape=tf.constant([5, 2], dtype=tf.int64),
+    )
+    out = hvd.allreduce(slices, op=hvd.Average)
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(out.values.numpy(), [[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_array_equal(out.indices.numpy(), [0, 3])
+
+
+def test_allreduce_grad_is_allreduced():
+    x = tf.Variable([1.0, 2.0, 3.0])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd._allreduce(x, op=hvd.Sum))
+    grad = tape.gradient(y, x)
+    np.testing.assert_allclose(grad.numpy(), np.ones(3))
+
+
+def test_allgather_and_grad():
+    x = tf.Variable(np.random.randn(2, 3).astype(np.float32))
+    with tf.GradientTape() as tape:
+        g = hvd.allgather(x)
+        loss = tf.reduce_sum(g)
+    assert g.shape == (2, 3)
+    grad = tape.gradient(loss, x)
+    np.testing.assert_allclose(grad.numpy(), np.ones((2, 3)))
+
+
+def test_broadcast_grad_root():
+    x = tf.Variable(np.random.randn(4).astype(np.float32))
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.broadcast(x, root_rank=0))
+    grad = tape.gradient(y, x)
+    # rank 0 IS the root in a single-process world: grads arrive summed
+    np.testing.assert_allclose(grad.numpy(), np.ones(4))
+
+
+def test_broadcast_variables_assigns():
+    v = tf.Variable([5.0, 6.0])
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [5.0, 6.0])
+
+
+def test_distributed_gradient_tape():
+    x = tf.Variable(3.0)
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        y = x * x
+    grad = tape.gradient(y, x)
+    np.testing.assert_allclose(float(grad), 6.0)
+
+
+def test_distributed_keras_optimizer_applies():
+    try:
+        opt = tf.keras.optimizers.SGD(learning_rate=0.5)
+    except Exception:
+        pytest.skip("keras optimizers unavailable")
+    dopt = hvd.DistributedOptimizer(opt)
+    assert type(dopt).__name__.startswith("Distributed")
+    v = tf.Variable(2.0)
+    dopt.apply_gradients([(tf.constant(1.0), v)])
+    np.testing.assert_allclose(float(v), 1.5)
+
+
+def test_distributed_legacy_optimizer_wrap():
+    try:
+        base = tf.compat.v1.train.GradientDescentOptimizer(0.1)
+    except AttributeError:
+        pytest.skip("tf.compat.v1 unavailable")
+    dopt = hvd.DistributedOptimizer(base)
+    assert dopt.get_slot_names() == base.get_slot_names()
+
+
+def test_compression_fp16_roundtrip():
+    x = tf.constant([1.0, 2.0, 3.0])
+    c, ctx = hvd.Compression.fp16.compress(x)
+    assert c.dtype == tf.float16
+    out = hvd.Compression.fp16.decompress(c, ctx)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+def test_alltoall_single_process_identity():
+    x = tf.constant(np.arange(4, dtype=np.float32))
+    out = hvd.alltoall(x)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+def test_feature_probes_answer():
+    assert hvd.size() >= 1
+    assert isinstance(hvd.gloo_built(), bool)
+    assert isinstance(hvd.mpi_built(), bool)
