@@ -28,6 +28,9 @@ enum class RequestType : uint8_t {
   ADASUM = 4,
   ALLTOALL = 5,
   BARRIER = 6,
+  // 7 is reserved: ResponseType::ERROR holds it, and the controller maps
+  // request -> response by numeric value (ConstructResponse).
+  REDUCESCATTER = 8,
 };
 
 enum class ResponseType : uint8_t {
@@ -39,6 +42,7 @@ enum class ResponseType : uint8_t {
   ALLTOALL = 5,
   BARRIER = 6,
   ERROR = 7,
+  REDUCESCATTER = 8,
 };
 
 // Mirrors horovod_tpu/ops/collectives.py ReduceOp (which follows reference

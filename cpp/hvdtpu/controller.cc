@@ -17,6 +17,7 @@ const char* OpName(RequestType t) {
     case RequestType::ADASUM: return "ADASUM";
     case RequestType::ALLTOALL: return "ALLTOALL";
     case RequestType::BARRIER: return "BARRIER";
+    case RequestType::REDUCESCATTER: return "REDUCESCATTER";
   }
   return "?";
 }
@@ -42,6 +43,11 @@ std::string Controller::Validate(const TableEntry& e) const {
     return "Allgather of " + first.tensor_name +
            " requires at least a 1-dimensional tensor (got a scalar).";
   }
+  if (first.request_type == RequestType::REDUCESCATTER &&
+      first.shape.empty()) {
+    return "Reducescatter of " + first.tensor_name +
+           " requires at least a 1-dimensional tensor (got a scalar).";
+  }
   for (const auto& [rank, r] : e.requests) {
     if (r.dtype != first.dtype) {
       return "Mismatched data types for " + first.tensor_name + ": rank " +
@@ -61,6 +67,7 @@ std::string Controller::Validate(const TableEntry& e) const {
       case RequestType::ADASUM:
       case RequestType::BROADCAST:
       case RequestType::ALLTOALL:
+      case RequestType::REDUCESCATTER:
         if (r.shape != first.shape) {
           return "Mismatched shapes for " + first.tensor_name + ": " +
                  ShapeStr(first.shape) + " vs " + ShapeStr(r.shape) + ".";

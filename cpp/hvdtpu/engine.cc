@@ -235,6 +235,7 @@ class Engine {
       case RequestType::ADASUM: return "adasum";
       case RequestType::ALLTOALL: return "alltoall";
       case RequestType::BARRIER: return "barrier";
+      case RequestType::REDUCESCATTER: return "reducescatter";
     }
     return "?";
   }
@@ -249,9 +250,10 @@ class Engine {
       auto elapsed = std::chrono::duration<double, std::milli>(
                          std::chrono::steady_clock::now() - cycle_start)
                          .count();
-      if (elapsed < cycle_ms_) {
+      double cycle_ms = cycle_ms_.load();
+      if (elapsed < cycle_ms) {
         std::this_thread::sleep_for(std::chrono::duration<double, std::milli>(
-            cycle_ms_ - elapsed));
+            cycle_ms - elapsed));
       }
     }
     FailAll(kShutdownError);
@@ -269,7 +271,7 @@ class Engine {
       my_list.shutdown = shutdown_requested_;
       my_list.joined = joined_;
       for (auto& e : pending_) {
-        int32_t slot = cache_->Lookup(e->req);
+        int32_t slot = cache_enabled_ ? cache_->Lookup(e->req) : -1;
         if (slot >= 0) {
           my_list.cache_hits.push_back(static_cast<uint32_t>(slot));
         } else {
@@ -295,6 +297,19 @@ class Engine {
       }
       bool should_shutdown = false;
       rlist = controller_->ComputeResponseList(lists, &should_shutdown);
+      {
+        // Attach the autotuner's latest move so every rank (this one
+        // included) applies it at the same cycle boundary (reference
+        // SynchronizeParameters, controller.cc:33-47).
+        std::lock_guard<std::mutex> l(mu_);
+        if (params_pending_) {
+          rlist.has_params = true;
+          rlist.tuned_fusion_bytes = params_fusion_bytes_;
+          rlist.tuned_cycle_ms = params_cycle_ms_;
+          rlist.tuned_cache_enabled = params_cache_enabled_;
+          params_pending_ = false;
+        }
+      }
       std::vector<uint8_t> out;
       SerializeResponseList(rlist, &out);
       for (int r = 1; r < size_; r++) {
@@ -318,6 +333,18 @@ class Engine {
       }
     }
 
+    // --- apply synced params BEFORE cache updates and fusion: all ranks
+    // must fuse this cycle's responses with the same threshold ---
+    if (rlist.has_params) {
+      fusion_bytes_.store(rlist.tuned_fusion_bytes);
+      cycle_ms_.store(rlist.tuned_cycle_ms);
+      cache_enabled_.store(rlist.tuned_cache_enabled);
+      HVD_LOG(LogLevel::DEBUG, rank_,
+              "autotune applied: fusion=%lld cycle=%.2fms cache=%d",
+              static_cast<long long>(rlist.tuned_fusion_bytes),
+              rlist.tuned_cycle_ms, rlist.tuned_cache_enabled ? 1 : 0);
+    }
+
     // --- reconstruct cached responses, update cache, fuse, execute ---
     std::vector<Response> exec;
     exec.reserve(rlist.cached_slots.size() + rlist.responses.size());
@@ -326,7 +353,8 @@ class Engine {
       cache_->Touch(slot);
     }
     for (auto& resp : rlist.responses) {
-      if (!rlist.cache_frozen && resp.response_type != ResponseType::ERROR &&
+      if (cache_enabled_ && !rlist.cache_frozen &&
+          resp.response_type != ResponseType::ERROR &&
           resp.response_type != ResponseType::JOIN &&
           resp.response_type != ResponseType::BARRIER) {
         std::lock_guard<std::mutex> l(mu_);
@@ -379,12 +407,13 @@ class Engine {
     if (resp.tensor_names.size() > 1)
       names += "+" + std::to_string(resp.tensor_names.size() - 1);
     const char* opname =
-        resp.response_type == ResponseType::ALLREDUCE   ? "ALLREDUCE"
-        : resp.response_type == ResponseType::ALLGATHER ? "ALLGATHER"
-        : resp.response_type == ResponseType::BROADCAST ? "BROADCAST"
-        : resp.response_type == ResponseType::ADASUM    ? "ADASUM"
-        : resp.response_type == ResponseType::ALLTOALL  ? "ALLTOALL"
-                                                        : "BARRIER";
+        resp.response_type == ResponseType::ALLREDUCE       ? "ALLREDUCE"
+        : resp.response_type == ResponseType::ALLGATHER     ? "ALLGATHER"
+        : resp.response_type == ResponseType::BROADCAST     ? "BROADCAST"
+        : resp.response_type == ResponseType::ADASUM        ? "ADASUM"
+        : resp.response_type == ResponseType::ALLTOALL      ? "ALLTOALL"
+        : resp.response_type == ResponseType::REDUCESCATTER ? "REDUCESCATTER"
+                                                            : "BARRIER";
     timeline_.Start(names, opname);
     Status s;
     switch (resp.response_type) {
@@ -400,6 +429,9 @@ class Engine {
         break;
       case ResponseType::ALLTOALL:
         s = ExecAlltoall(resp, entries);
+        break;
+      case ResponseType::REDUCESCATTER:
+        s = ExecReducescatter(resp, entries);
         break;
       case ResponseType::BARRIER:
         if (entries[0]) Complete(entries[0]->handle, nullptr, 0, {});
@@ -466,6 +498,7 @@ class Engine {
       ScaleInPlace(resp.dtype, fused.data(), static_cast<size_t>(total),
                    resp.postscale);
 
+    perf_bytes_ += static_cast<long long>(total) * elem;
     timeline_.ActivityStart(names, "MEMCPY_OUT_FUSION_BUFFER");
     off = 0;
     for (size_t i = 0; i < entries.size(); i++) {
@@ -497,6 +530,7 @@ class Engine {
                    : static_cast<const void*>(out.data());  // 0 elems
     Status s = RingAllgatherv(&mesh_, send, out.data(), counts, resp.dtype);
     if (!s.ok()) return s;
+    perf_bytes_ += static_cast<long long>(out.size());
     if (entries[0]) {
       std::vector<int64_t> out_shape = shape;
       out_shape[0] = total_rows;
@@ -516,6 +550,7 @@ class Engine {
     Status s = TreeBroadcast(&mesh_, buf.data(), n, resp.dtype,
                              resp.root_rank);
     if (!s.ok()) return s;
+    perf_bytes_ += static_cast<long long>(buf.size());
     if (entries[0])
       Complete(entries[0]->handle, buf.data(), buf.size(), resp.shapes[0]);
     return Status::OK();
@@ -541,6 +576,44 @@ class Engine {
     if (!s.ok()) return s;
     if (entries[0])
       Complete(entries[0]->handle, out.data(), out.size(), shape);
+    return Status::OK();
+  }
+
+  Status ExecReducescatter(const Response& resp,
+                           const std::vector<std::shared_ptr<Entry>>& entries) {
+    // Sum across ranks, keep this rank's dim-0 rows; uneven splits give
+    // the first (dim0 % size) ranks one extra row (the convention later
+    // Horovod versions adopted for reducescatter).
+    size_t elem = DataTypeSize(resp.dtype);
+    const auto& shape = resp.shapes[0];
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    int64_t row = shape.empty() ? 1 : n / std::max<int64_t>(shape[0], 1);
+    std::vector<uint8_t> buf(static_cast<size_t>(n) * elem, 0);
+    if (entries[0])
+      std::memcpy(buf.data(), entries[0]->data.data(), buf.size());
+    if (resp.prescale != 1.0)
+      ScaleInPlace(resp.dtype, buf.data(), static_cast<size_t>(n),
+                   resp.prescale);
+    Status s = RingAllreduce(&mesh_, buf.data(), n, resp.dtype, ReduceOp::SUM);
+    if (!s.ok()) return s;
+    if (resp.reduce_op == ReduceOp::AVERAGE)
+      ScaleInPlace(resp.dtype, buf.data(), static_cast<size_t>(n),
+                   1.0 / size_);
+    if (resp.postscale != 1.0)
+      ScaleInPlace(resp.dtype, buf.data(), static_cast<size_t>(n),
+                   resp.postscale);
+    perf_bytes_ += static_cast<long long>(buf.size());
+    if (entries[0]) {
+      int64_t dim0 = shape.empty() ? 1 : shape[0];
+      int64_t base = dim0 / size_, rem = dim0 % size_;
+      int64_t start = rank_ * base + std::min<int64_t>(rank_, rem);
+      int64_t rows = base + (rank_ < rem ? 1 : 0);
+      std::vector<int64_t> out_shape = shape;
+      out_shape[0] = rows;
+      Complete(entries[0]->handle, buf.data() + start * row * elem,
+               static_cast<size_t>(rows * row) * elem, out_shape);
+    }
     return Status::OK();
   }
 
@@ -591,8 +664,35 @@ class Engine {
 
   int rank_ = 0;
   int size_ = 1;
-  int64_t fusion_bytes_ = 64 * 1024 * 1024;
-  double cycle_ms_ = 5.0;
+  // Atomic: written by the RunLoop thread when synced params apply,
+  // read lock-free by the Python autotune thread via hvdtpu_get_*.
+  std::atomic<int64_t> fusion_bytes_{64 * 1024 * 1024};
+  std::atomic<double> cycle_ms_{5.0};
+  std::atomic<bool> cache_enabled_{true};
+
+  // Autotune plumbing: the Python ParameterManager (rank 0) reads the
+  // bytes counter to score bytes/sec and pushes proposals via
+  // hvdtpu_set_params; they ride the next ResponseList to every rank
+  // (reference parameter_manager.cc:528 + controller.cc:33-47).
+  std::atomic<long long> perf_bytes_{0};
+  bool params_pending_ = false;
+  int64_t params_fusion_bytes_ = 0;
+  double params_cycle_ms_ = 0.0;
+  bool params_cache_enabled_ = true;
+
+ public:
+  void SetParams(int64_t fusion_bytes, double cycle_ms, bool cache_enabled) {
+    std::lock_guard<std::mutex> l(mu_);
+    params_pending_ = true;
+    params_fusion_bytes_ = fusion_bytes;
+    params_cycle_ms_ = cycle_ms;
+    params_cache_enabled_ = cache_enabled;
+  }
+  long long PerfBytes() const { return perf_bytes_.load(); }
+  long long FusionBytes() const { return fusion_bytes_.load(); }
+  double CycleMs() const { return cycle_ms_.load(); }
+
+ private:
 
   TcpMesh mesh_;
   std::unique_ptr<Controller> controller_;
@@ -706,5 +806,21 @@ void hvdtpu_shutdown() { hvdtpu::Engine::Get().Shutdown(); }
 int hvdtpu_is_shutdown() {
   return hvdtpu::Engine::Get().IsDone() ? 1 : 0;
 }
+
+// Autotune surface (reference parameter_manager.cc scoring + param sync):
+// rank 0's Python ParameterManager polls the bytes counter and pushes
+// proposals; the engine ships them to all ranks on the next cycle.
+void hvdtpu_set_params(long long fusion_bytes, double cycle_ms,
+                       int cache_enabled) {
+  hvdtpu::Engine::Get().SetParams(fusion_bytes, cycle_ms, cache_enabled != 0);
+}
+
+long long hvdtpu_perf_bytes() { return hvdtpu::Engine::Get().PerfBytes(); }
+
+long long hvdtpu_get_fusion_bytes() {
+  return hvdtpu::Engine::Get().FusionBytes();
+}
+
+double hvdtpu_get_cycle_ms() { return hvdtpu::Engine::Get().CycleMs(); }
 
 }  // extern "C"

@@ -154,6 +154,12 @@ bool ParseRequestList(const uint8_t* data, size_t len, RequestList* out) {
 void SerializeResponseList(const ResponseList& rl, std::vector<uint8_t>* out) {
   Put<uint8_t>(out, rl.shutdown ? 1 : 0);
   Put<uint8_t>(out, rl.cache_frozen ? 1 : 0);
+  Put<uint8_t>(out, rl.has_params ? 1 : 0);
+  if (rl.has_params) {
+    Put<int64_t>(out, rl.tuned_fusion_bytes);
+    Put<double>(out, rl.tuned_cycle_ms);
+    Put<uint8_t>(out, rl.tuned_cache_enabled ? 1 : 0);
+  }
   Put<uint32_t>(out, static_cast<uint32_t>(rl.cached_slots.size()));
   for (auto s : rl.cached_slots) Put<uint32_t>(out, s);
   Put<uint32_t>(out, static_cast<uint32_t>(rl.responses.size()));
@@ -164,6 +170,12 @@ bool ParseResponseList(const uint8_t* data, size_t len, ResponseList* out) {
   Reader rd{data, data + len};
   out->shutdown = rd.Get<uint8_t>() != 0;
   out->cache_frozen = rd.Get<uint8_t>() != 0;
+  out->has_params = rd.Get<uint8_t>() != 0;
+  if (out->has_params) {
+    out->tuned_fusion_bytes = rd.Get<int64_t>();
+    out->tuned_cycle_ms = rd.Get<double>();
+    out->tuned_cache_enabled = rd.Get<uint8_t>() != 0;
+  }
   uint32_t ns = rd.Get<uint32_t>();
   if (!rd.ok || ns > (1u << 20)) return false;
   out->cached_slots.resize(ns);

@@ -71,6 +71,14 @@ struct ResponseList {
   // (the joined rank can't observe new entries; freezing keeps every
   // rank's put/evict sequence identical — the invariant slot ids rest on).
   bool cache_frozen = false;
+  // Autotuned parameter sync (reference SynchronizeParameters,
+  // controller.cc:33-47): rank 0 attaches the tuner's latest move; every
+  // rank applies it at the same cycle boundary, which keeps the fusion
+  // threshold (and therefore fused-response layout) identical everywhere.
+  bool has_params = false;
+  int64_t tuned_fusion_bytes = 0;
+  double tuned_cycle_ms = 0.0;
+  bool tuned_cache_enabled = true;
 };
 
 // Serialization: append to / parse from a byte vector.

@@ -458,12 +458,16 @@ def alltoall(tensor, *, axis_name: str = DP_AXIS):
 def reducescatter(tensor, op: ReduceOp = Average, *, axis_name: str = DP_AXIS):
     """Sum across shards, keep only this shard's dim-0 slice — the first leg
     of the reference's hierarchical allreduce (nccl_operations.cc:218-229)
-    exposed as a user op."""
+    exposed as a user op.  Under tracing this is ``lax.psum_scatter``
+    (dim0 must divide the axis size — XLA static shapes); on concrete
+    arrays the eager engine serves it with the uneven-dim0 convention
+    (first ``dim0 % world`` ranks get one extra row)."""
     if not _is_traced(tensor):
-        raise NotImplementedError(
-            "reducescatter is jit-path only: call it inside shard_map/pjit "
-            "over a mesh axis (the eager engine serves allreduce/allgather/"
-            "broadcast/alltoall)."
+        _check_eager_axis(axis_name)
+        from . import eager  # noqa: PLC0415
+
+        return jax.tree_util.tree_map(
+            lambda x: eager.reducescatter(x, op), tensor
         )
 
     def one(x):

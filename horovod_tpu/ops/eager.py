@@ -29,6 +29,8 @@ __all__ = [
     "allreduce_async_",
     "allgather",
     "allgather_async",
+    "reducescatter",
+    "reducescatter_async",
     "broadcast",
     "broadcast_",
     "broadcast_async",
@@ -122,6 +124,31 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
 
 broadcast_async_ = broadcast_async
 broadcast_ = broadcast
+
+
+def reducescatter_async(
+    tensor,
+    op: ReduceOp = Average,
+    name: Optional[str] = None,
+) -> concurrent.futures.Future:
+    """Sum across ranks, keep this rank's dim-0 rows (the first leg of the
+    reference's hierarchical allreduce, nccl_operations.cc:218-229, as the
+    user op later Horovod versions exposed).  Uneven dim0: the first
+    (dim0 % world) ranks receive one extra row."""
+    from .collectives import ReduceOp as _R  # noqa: PLC0415
+
+    if op not in (_R.AVERAGE, _R.SUM):
+        raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
+    return get_engine().enqueue(
+        RequestType.REDUCESCATTER,
+        name or _auto_name("reducescatter"),
+        _to_host(tensor),
+        reduce_op=int(op),
+    )
+
+
+def reducescatter(tensor, op: ReduceOp = Average, name: Optional[str] = None):
+    return synchronize(reducescatter_async(tensor, op, name))
 
 
 def alltoall_async(tensor, name: Optional[str] = None) -> concurrent.futures.Future:

@@ -66,6 +66,12 @@ def _validate(requests: Dict[int, Request]) -> Optional[str]:
             f"Allgather of {first.tensor_name} requires at least a "
             f"1-dimensional tensor (got a scalar)."
         )
+    if (first.request_type == RequestType.REDUCESCATTER
+            and len(first.shape) == 0):
+        return (
+            f"Reducescatter of {first.tensor_name} requires at least a "
+            f"1-dimensional tensor (got a scalar)."
+        )
     for r in reqs[1:]:
         if r.dtype != first.dtype:
             return (
@@ -84,7 +90,8 @@ def _validate(requests: Dict[int, Request]) -> Optional[str]:
         ):
             return f"Mismatched reduce options for {first.tensor_name}."
         if first.request_type in (RequestType.ALLREDUCE, RequestType.ADASUM,
-                                  RequestType.BROADCAST, RequestType.ALLTOALL):
+                                  RequestType.BROADCAST, RequestType.ALLTOALL,
+                                  RequestType.REDUCESCATTER):
             if tuple(r.shape) != tuple(first.shape):
                 return (
                     f"Mismatched shapes for {first.tensor_name}: "
@@ -118,6 +125,7 @@ def compute_responses(
     stall_warning_secs: float = 60.0,
     stall_shutdown_secs: float = 0.0,
     timeline=None,
+    cache=None,
 ) -> Tuple[List[Response], bool]:
     """One negotiation cycle: merge every rank's RequestList into the
     message table, emit ready Responses (fused), handle join/shutdown.
@@ -169,6 +177,9 @@ def compute_responses(
         if timeline is not None:
             timeline.negotiate_end(name, rtype.name)
         if err is not None:
+            if cache is not None:
+                # a failed renegotiation must not leave a stale entry
+                cache.evict_name(name)
             responses.append(
                 Response(ResponseType.ERROR, [name], error_message=err)
             )
@@ -190,7 +201,8 @@ def compute_responses(
             resp._shapes = [tuple(first.shape)]  # type: ignore[attr-defined]
             resp._dtype = first.dtype  # type: ignore[attr-defined]
             resp._root_rank = first.root_rank  # type: ignore[attr-defined]
-            if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM):
+            if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM,
+                         RequestType.REDUCESCATTER):
                 # Fusion identity + byte size (reference keeps dtype
                 # homogeneous per fusion, controller.cc:676-689).
                 resp._fuse_meta = (  # type: ignore[attr-defined]
@@ -206,6 +218,11 @@ def compute_responses(
                 resp._nbytes = (  # type: ignore[attr-defined]
                     int(np.prod(first.shape)) * itemsize if first.shape else itemsize
                 )
+            if cache is not None:
+                # Insert pre-fusion, in construction order — the identical
+                # order on every rank is what keeps slot indices coherent
+                # (reference response_cache.cc put() from ComputeResponseList).
+                cache.insert(first, resp)
             responses.append(resp)
 
     responses = _fuse(responses, state, fusion_threshold_bytes)

@@ -42,17 +42,19 @@ import numpy as np
 from ..basics import global_topology
 from ..utils import env as envmod
 from ..utils.logging import get_logger
+from . import response_cache as rcache
 from . import timeline as timeline_mod
 from .autotune import ParameterManager, TunedParams
-from .controller import ControllerState, compute_responses
+from .controller import ControllerState, _fuse, compute_responses
 from .messages import Request, RequestList, RequestType, Response, ResponseType
 
 LOG = get_logger("engine")
 
-# Reference defaults: fusion 64 MB (operations.cc:419), cycle 5 ms
-# (operations.cc:427).  The python control plane pays ~1 ms per coordination
-# allgather, so the multi-process default cycle is a touch longer.
-DEFAULT_FUSION_BYTES = 64 * 1024 * 1024
+# Reference defaults: fusion 64 MB (operations.cc:419 — canonical constant
+# in utils/env.py), cycle 5 ms (operations.cc:427).  The python control
+# plane pays ~1 ms per coordination allgather, so the multi-process default
+# cycle is a touch longer.
+DEFAULT_FUSION_BYTES = envmod.DEFAULT_FUSION_BYTES
 DEFAULT_CYCLE_MS_SINGLE = 1.0
 DEFAULT_CYCLE_MS_MULTI = 10.0
 
@@ -129,6 +131,29 @@ class EagerEngine:
         self._done = False
         self._controller = ControllerState(world_size=self.world)
         self._thread: Optional[threading.Thread] = None
+        self._barrier_seq = 0
+
+        # Response cache + steady-state fast path (reference
+        # response_cache.cc / CacheCoordinator): repeated tensor sets vote
+        # fixed-size armed-bit vectors instead of re-exchanging serialized
+        # RequestLists every cycle.
+        self._cache = rcache.ResponseCache(
+            envmod.env_int(envmod.CACHE_CAPACITY, 1024)
+        )
+        self._armed: Dict[int, Request] = {}
+        self._armed_since: Dict[int, float] = {}
+        self._last_armed_stall_check = time.monotonic()
+        self.stats = {
+            "cycles": 0,
+            "fast_cycles": 0,  # cycles with no payload exchange anywhere
+            "payload_cycles": 0,
+            "control_bytes": 0,
+            "payload_bytes": 0,
+            "cache_hits": 0,
+            "cache_misses": 0,
+            "cached_responses": 0,  # ops executed straight from cache votes
+            "negotiated_responses": 0,  # ops through full negotiation
+        }
 
         # Autotuner (reference parameter_manager.cc): rank 0 scores
         # bytes/sec per sample window and proposes new params; peers apply
@@ -221,7 +246,13 @@ class EagerEngine:
         return fut
 
     def barrier(self) -> concurrent.futures.Future:
-        return self.enqueue(RequestType.BARRIER, "hvdtpu.barrier", None)
+        # Sequence-numbered so overlapping barriers queue instead of
+        # colliding with DUPLICATE_NAME; the Nth barrier call on every
+        # rank pairs up (same convention as unnamed-tensor sequence names).
+        with self._lock:
+            self._barrier_seq += 1
+            seq = self._barrier_seq
+        return self.enqueue(RequestType.BARRIER, f"hvdtpu.barrier.{seq}", None)
 
     def shutdown(self) -> None:
         """Coordinated shutdown, reference semantics: ANY rank's shutdown
@@ -261,40 +292,163 @@ class EagerEngine:
         self._done = True
 
     def _run_loop_once(self) -> bool:
-        """One cycle (reference RunLoopOnce, operations.cc:550)."""
+        """One cycle (reference RunLoopOnce, operations.cc:550).
+
+        Steady-state fast path (reference ComputeResponseList
+        controller.cc:174-202 + CacheCoordinator::sync): requests that hit
+        the response cache only arm a slot bit; the cycle exchanges ONE
+        fixed-size control vector, and full serialized RequestLists ride a
+        second exchange only when some rank actually has uncached work."""
         self.timeline.mark_cycle()
         with self._lock:
             requests = list(self._pending)
             self._pending.clear()
-            rlist = RequestList(
-                requests=requests,
-                shutdown=self._shutdown_requested,
-                joined=self._joined,
-                tuned_params=self._pending_params,
-            )
+            shutdown = self._shutdown_requested
+            joined = self._joined
+            params = self._pending_params
             self._pending_params = None
-        all_lists = self._negotiate(rlist)
-        # Parameter sync: every rank (rank 0 included — it may have tuned
-        # last cycle) applies the params riding rank 0's list.
-        if all_lists[0].tuned_params is not None:
-            self._apply_params(TunedParams.from_wire(all_lists[0].tuned_params))
+
+        now = time.monotonic()
+        misses: List[Request] = []
+        for req in requests:
+            status, slot = self._cache.lookup(req)
+            if status == rcache.HIT:
+                self._armed[slot] = req
+                self._armed_since[slot] = now
+                self.stats["cache_hits"] += 1
+            else:
+                misses.append(req)
+                self.stats["cache_misses"] += 1
+
+        payload = b""
+        if misses or params is not None:
+            payload = RequestList(
+                requests=misses, tuned_params=params
+            ).serialize()
+
+        shutdown_ranks, joined_ranks, bits, all_lists = self._exchange(
+            payload, shutdown, joined
+        )
+        self.stats["cycles"] += 1
+
+        state = self._controller
+        state.shutdown_ranks.update(shutdown_ranks)
+        state.joined_ranks.update(joined_ranks)
+
+        # Cache votes: a slot executes when every non-joined rank armed it
+        # (bitvector AND ≙ response_cache.h:133-137 status bits).  Computed
+        # from the GLOBAL bit matrix, not the local _armed dict: a joined
+        # rank armed nothing but must still participate (with zeros) in the
+        # cached collectives its peers execute — same invariant as the
+        # slow path's zero-contribution entries.
+        voted: set = set()
+        union = np.bitwise_or.reduce(bits, axis=0) if len(bits) else bits
+        for byte_i, byte in enumerate(union):
+            b = int(byte)
+            while b:
+                bit = b & -b
+                voted.add(byte_i * 8 + bit.bit_length() - 1)
+                b ^= bit
+        ready: List[int] = []
+        for slot in sorted(voted):
+            if all(
+                ((bits[r, slot >> 3] >> (slot & 7)) & 1)
+                or (r in state.joined_ranks)
+                for r in range(self.world)
+            ):
+                ready.append(slot)
+        cached_responses: List[Response] = []
+        for slot in ready:
+            self._cache.touch(slot)  # LRU in deterministic slot order
+            cached_responses.append(self._cache.response_for(slot))
+            self._armed.pop(slot, None)
+            self._armed_since.pop(slot, None)
+        cached_responses = _fuse(cached_responses, state, self.fusion_bytes)
+        self.stats["cached_responses"] += len(ready)
+        self._check_armed_stalls(now)
+        # Slots any rank is voting on this cycle must survive LRU eviction
+        # during this cycle's insertions: evicting a slot a peer is armed
+        # on would leave it voting for a dead/reassigned slot.  The union
+        # is identical on every rank, so eviction stays coherent.
+        protected = voted
+
+        if all_lists is None:
+            self.stats["fast_cycles"] += 1
+            all_lists = [RequestList() for _ in range(self.world)]
+        else:
+            self.stats["payload_cycles"] += 1
+            # Conflict resolution: a re-submission under a cached name with
+            # different params invalidates the cache entry on EVERY rank
+            # (all see the same payloads); if we were voting on the stale
+            # slot, fall back to renegotiating our own request next cycle.
+            for rlist in all_lists:
+                for req in rlist.requests:
+                    st, slot = self._cache.lookup(req)
+                    if st == rcache.CONFLICT:
+                        stale = self._armed.pop(slot, None)
+                        self._armed_since.pop(slot, None)
+                        self._cache.evict_name(req.tensor_name)
+                        if stale is not None:
+                            with self._lock:
+                                self._pending.append(stale)
+            # Parameter sync: every rank (rank 0 included — it may have
+            # tuned last cycle) applies the params riding rank 0's list.
+            if all_lists[0].tuned_params is not None:
+                self._apply_params(
+                    TunedParams.from_wire(all_lists[0].tuned_params)
+                )
+
+        self._cache.protected = protected
         responses, should_shutdown = compute_responses(
-            self._controller,
+            state,
             all_lists,
             fusion_threshold_bytes=self.fusion_bytes,
             stall_warning_secs=self.stall_warn,
             stall_shutdown_secs=self.stall_shutdown,
             timeline=self.timeline,
+            cache=self._cache,
         )
+        self._cache.protected = frozenset()
+        self.stats["negotiated_responses"] += sum(
+            len(r.tensor_names)
+            for r in responses
+            if r.response_type != ResponseType.JOIN
+        )
+        # Cached responses execute first, then freshly negotiated ones —
+        # the same deterministic order on every rank.
+        for resp in cached_responses:
+            self._perform_operation(resp)
         for resp in responses:
             self._perform_operation(resp)
         if self._pm is not None:
-            for resp in responses:
+            for resp in cached_responses + responses:
                 self._pm.record_bytes(_response_bytes(resp))
             proposal = self._pm.cycle()
             if proposal is not None:
                 self._pending_params = proposal.as_wire()
         return not should_shutdown
+
+    def _check_armed_stalls(self, now: float) -> None:
+        """Armed-but-unready slots live outside the controller's message
+        table, so the stall inspector can't see them; warn here (reference
+        stall_inspector.cc InvalidateStalledCachedTensors)."""
+        if now - self._last_armed_stall_check < min(self.stall_warn, 10.0):
+            return
+        self._last_armed_stall_check = now
+        for slot, since in self._armed_since.items():
+            age = now - since
+            if age > self.stall_warn:
+                LOG.warning(
+                    "Cached tensor %s has been waiting on peer ranks for "
+                    "%.0f s",
+                    self._cache.name_for(slot),
+                    age,
+                )
+                if self.stall_shutdown > 0 and age > self.stall_shutdown:
+                    raise RuntimeError(
+                        f"Stalled cached tensor {self._cache.name_for(slot)} "
+                        f"exceeded shutdown threshold ({self.stall_shutdown}s)"
+                    )
 
     def _apply_params(self, p: TunedParams) -> None:
         """Apply rank-0-tuned params (reference SynchronizeParameters,
@@ -304,25 +458,58 @@ class EagerEngine:
 
     # ---------------------------------------------------------- negotiation
 
-    def _negotiate(self, rlist: RequestList) -> List[RequestList]:
-        """Allgather every rank's RequestList (two-phase, fixed-shape)."""
+    def _exchange(self, payload: bytes, shutdown: bool, joined: bool):
+        """One negotiation round: allgather a fixed-size control vector
+        [flags | payload length | armed cache bits]; gather the serialized
+        RequestList payloads in a second round ONLY if some rank has one
+        (the reference's slow path, mpi_controller.cc:107-199 Gatherv +
+        Bcast; the fast path is the control vector alone, ≙ the bitvector
+        AND/OR allreduce of controller.cc:174-202).
+
+        Returns (shutdown_ranks, joined_ranks, bits, all_lists) where bits
+        is a (world, num_bits) uint8 matrix of armed votes and all_lists is
+        None on a fast (control-only) cycle."""
         from jax.experimental import multihost_utils  # noqa: PLC0415
 
-        payload = rlist.serialize()
-        lengths = multihost_utils.process_allgather(
-            np.asarray([len(payload)], np.int32)
-        ).reshape(-1)
+        nbits = self._cache.num_bits
+        vec = np.zeros(5 + nbits, np.uint8)
+        vec[0] = (
+            (1 if shutdown else 0)
+            | (2 if joined else 0)
+            | (4 if payload else 0)
+        )
+        vec[1:5] = np.frombuffer(
+            np.uint32(len(payload)).tobytes(), np.uint8
+        )
+        for slot in self._armed:
+            vec[5 + (slot >> 3)] |= 1 << (slot & 7)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(vec)
+        ).reshape(self.world, -1)
+        self.stats["control_bytes"] += int(vec.size) * self.world
+
+        flags = gathered[:, 0]
+        shutdown_ranks = {r for r in range(self.world) if flags[r] & 1}
+        joined_ranks = {r for r in range(self.world) if flags[r] & 2}
+        bits = gathered[:, 5:]
+        if not bool((flags & 4).any()):
+            return shutdown_ranks, joined_ranks, bits, None
+
+        lengths = gathered[:, 1:5].copy().view(np.uint32).reshape(-1)
         max_len = int(lengths.max())
         buf = np.zeros(max_len, np.uint8)
         buf[: len(payload)] = np.frombuffer(payload, np.uint8)
-        gathered = multihost_utils.process_allgather(buf)
-        gathered = np.asarray(gathered).reshape(self.world, max_len)
-        return [
-            RequestList.deserialize(
-                gathered[r, : int(lengths[r])].tobytes()
-            )
+        pg = np.asarray(
+            multihost_utils.process_allgather(buf)
+        ).reshape(self.world, max_len)
+        self.stats["payload_bytes"] += max_len * self.world
+        all_lists = [
+            RequestList.deserialize(pg[r, : int(lengths[r])].tobytes())
+            if lengths[r]
+            else RequestList()
             for r in range(self.world)
         ]
+        return shutdown_ranks, joined_ranks, bits, all_lists
 
     # ------------------------------------------------------------ execution
 
@@ -361,6 +548,8 @@ class EagerEngine:
                 self._execute_broadcast(resp, entries)
             elif resp.response_type == ResponseType.ALLTOALL:
                 self._execute_alltoall(resp, entries)
+            elif resp.response_type == ResponseType.REDUCESCATTER:
+                self._execute_reducescatter(resp, entries)
             elif resp.response_type == ResponseType.BARRIER:
                 e = entries[0]
                 if e is not None:
@@ -395,6 +584,27 @@ class EagerEngine:
     def _execute_allreduce(self, resp: Response, entries) -> None:
         meta = getattr(resp, "_fuse_meta", None)
         shapes = getattr(resp, "_shapes", [()] * len(resp.tensor_names))
+        dtype_name, reduce_op, pre, post = (
+            meta if meta else ("float32", 1, 1.0, 1.0)
+        )
+        # Dtype-native wire: the buffer travels in the NEGOTIATED dtype
+        # (bf16 gradients cost 2 bytes/elt on the wire, int64 sums are
+        # exact — the reference likewise reduces dtype-native, half.cc /
+        # mpi_operations.cc).  16-bit floats accumulate in f32, like the
+        # reference's vectorized half kernels accumulate wide.
+        wire_dtype = _np_dtype(dtype_name)
+        is_int = wire_dtype.kind in ("i", "u")
+        acc_dtype = (
+            np.dtype(np.float32)
+            if dtype_name in ("bfloat16", "float16")
+            else wire_dtype
+        )
+        scaled = pre != 1.0 or post != 1.0
+        if scaled and is_int:
+            # pre/post scaling of integer tensors computes in f64 (the
+            # reference's PrescaleFactor path also goes through double);
+            # exactness beyond 2^53 is only guaranteed for scale == 1.
+            acc_dtype = np.dtype(np.float64)
         # Fused buffer: concat all entries (MemcpyInFusionBuffer analog,
         # collective_operations.cc:159-210).  A joined rank has no entry for
         # a tensor its peers are reducing and contributes zeros of the
@@ -402,30 +612,36 @@ class EagerEngine:
         flats = []
         for e, shape in zip(entries, shapes):
             if e is not None and e.tensor is not None:
-                flats.append(np.ravel(np.asarray(e.tensor, np.float64)))
+                flats.append(np.ravel(np.asarray(e.tensor, wire_dtype)))
             else:
-                flats.append(np.zeros(int(np.prod(shape)) if shape else 1))
+                n = int(np.prod(shape)) if shape else 1
+                flats.append(np.zeros(n, wire_dtype))
         buf = np.concatenate(flats) if len(flats) > 1 else flats[0]
-        dtype, reduce_op, pre, post = meta if meta else ("float32", 1, 1.0, 1.0)
         if pre != 1.0:
-            buf = buf * pre
-        gathered = self._data_allgather(buf.astype(np.float64))
+            buf = (buf.astype(acc_dtype) * pre).astype(wire_dtype)
+        gathered = self._data_allgather(buf)
         from ..ops.collectives import ReduceOp  # noqa: PLC0415
 
         if reduce_op == int(ReduceOp.ADASUM):
             from ..ops.adasum import _numpy_adasum_rows  # noqa: PLC0415
 
-            total = _numpy_adasum_rows(gathered)
+            total = _numpy_adasum_rows(
+                gathered.astype(np.float64)
+            ).astype(wire_dtype)
         elif reduce_op == int(ReduceOp.MIN):
-            total = gathered.min(axis=0)
+            total = gathered.astype(acc_dtype).min(axis=0)
         elif reduce_op == int(ReduceOp.MAX):
-            total = gathered.max(axis=0)
+            total = gathered.astype(acc_dtype).max(axis=0)
         else:
-            total = gathered.sum(axis=0)
+            total = gathered.astype(acc_dtype).sum(axis=0)
             if reduce_op == int(ReduceOp.AVERAGE):
-                total = total / self.world
+                if is_int and not scaled:
+                    total = total // self.world  # exact int semantics
+                else:
+                    total = total / self.world
         if post != 1.0:
-            total = total * post
+            total = total.astype(acc_dtype) * post
+        total = np.asarray(total)
         offset = 0
         for e, shape in zip(entries, shapes):
             n = int(np.prod(shape)) if shape else 1
@@ -490,6 +706,46 @@ class EagerEngine:
         )
         e.future.set_result(mine)
 
+    def _execute_reducescatter(self, resp: Response, entries) -> None:
+        """Sum across ranks, keep this rank's dim-0 rows; uneven dim0 gives
+        the first (dim0 % world) ranks one extra row (the convention later
+        Horovod versions adopted for hvd.reducescatter)."""
+        e = entries[0]
+        meta = getattr(resp, "_fuse_meta", None)
+        dtype_name, reduce_op, pre, post = (
+            meta if meta else ("float32", 1, 1.0, 1.0)
+        )
+        wire_dtype = _np_dtype(dtype_name)
+        shape = tuple(getattr(resp, "_shapes", [(0,)])[0])
+        if e is None or e.tensor is None:
+            local = np.zeros(shape, wire_dtype)
+        else:
+            local = np.asarray(e.tensor, wire_dtype)
+        acc_dtype = (
+            np.dtype(np.float32)
+            if dtype_name in ("bfloat16", "float16")
+            else wire_dtype
+        )
+        if pre != 1.0:
+            local = (local.astype(acc_dtype) * pre).astype(wire_dtype)
+        gathered = self._data_allgather(local)
+        total = gathered.astype(acc_dtype).sum(axis=0)
+        from ..ops.collectives import ReduceOp  # noqa: PLC0415
+
+        if reduce_op == int(ReduceOp.AVERAGE):
+            total = total / self.world
+        if post != 1.0:
+            total = total * post
+        if e is None:
+            return
+        dim0 = shape[0]
+        base, rem = divmod(dim0, self.world)
+        start = self.rank * base + min(self.rank, rem)
+        rows = base + (1 if self.rank < rem else 0)
+        e.future.set_result(
+            np.asarray(total[start : start + rows]).astype(e.tensor.dtype)
+        )
+
     # -------------------------------------------------------- single process
 
     def _execute_local(self, entry: TensorTableEntry) -> None:
@@ -505,6 +761,7 @@ class EagerEngine:
         elif req.request_type in (
             RequestType.ALLGATHER,
             RequestType.ALLTOALL,
+            RequestType.REDUCESCATTER,
         ):
             entry.future.set_result(np.asarray(t))
         elif req.request_type == RequestType.BROADCAST:
@@ -526,6 +783,8 @@ class EagerEngine:
         with self._lock:
             entries = list(self._table.values())
             self._table.clear()
+            self._armed.clear()
+            self._armed_since.clear()
             self._done = True
             jf, self._join_future = self._join_future, None
         for e in entries:

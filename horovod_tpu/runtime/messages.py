@@ -26,6 +26,8 @@ class RequestType(enum.IntEnum):
     ADASUM = 4
     ALLTOALL = 5
     BARRIER = 6
+    # 7 reserved (ResponseType.ERROR) — request->response maps by value
+    REDUCESCATTER = 8
 
 
 class ResponseType(enum.IntEnum):
@@ -39,6 +41,7 @@ class ResponseType(enum.IntEnum):
     ALLTOALL = 5
     BARRIER = 6
     ERROR = 7
+    REDUCESCATTER = 8
 
 
 @dataclass(frozen=True)

@@ -97,6 +97,12 @@ def _load() -> ctypes.CDLL:
     lib.hvdtpu_result_copy.argtypes = [ctypes.c_longlong, ctypes.c_void_p]
     lib.hvdtpu_release.argtypes = [ctypes.c_longlong]
     lib.hvdtpu_is_shutdown.restype = ctypes.c_int
+    lib.hvdtpu_set_params.argtypes = [
+        ctypes.c_longlong, ctypes.c_double, ctypes.c_int
+    ]
+    lib.hvdtpu_perf_bytes.restype = ctypes.c_longlong
+    lib.hvdtpu_get_fusion_bytes.restype = ctypes.c_longlong
+    lib.hvdtpu_get_cycle_ms.restype = ctypes.c_double
     return lib
 
 
@@ -127,7 +133,9 @@ class NativeEngine:
 
         addrs = self._exchange_addrs(f"{_my_ip()}:{port}")
 
-        fusion = envmod.env_int(envmod.FUSION_THRESHOLD, 64 * 1024 * 1024)
+        fusion = envmod.env_int(
+            envmod.FUSION_THRESHOLD, envmod.DEFAULT_FUSION_BYTES
+        )
         cycle_ms = envmod.env_float(envmod.CYCLE_TIME, 5.0)
         cache_cap = envmod.env_int(envmod.CACHE_CAPACITY, 1024)
         stall_warn = envmod.env_float(envmod.STALL_CHECK_TIME, 60.0)
@@ -149,10 +157,37 @@ class NativeEngine:
         self._outstanding: Dict[int, tuple] = {}  # handle -> (future, dtype)
         self._pump_wake = threading.Event()
         self._stop = False
+        self._barrier_seq = 0
         self._pump = threading.Thread(
             target=self._pump_loop, name="hvdtpu_native_pump", daemon=True
         )
         self._pump.start()
+
+        # Autotune (reference parameter_manager.cc): rank 0 runs the GP
+        # tuner against the engine's bytes/sec counter; proposals go down
+        # through hvdtpu_set_params and ride the negotiation to every rank.
+        self._tuner: Optional[threading.Thread] = None
+        if self.rank == 0 and envmod.env_bool(envmod.AUTOTUNE):
+            from .autotune import ParameterManager, TunedParams  # noqa: PLC0415
+
+            self._pm = ParameterManager(
+                enabled=True,
+                initial=TunedParams(
+                    fusion_bytes=fusion, cycle_s=cycle_ms / 1000.0
+                ),
+                log_path=os.environ.get(envmod.AUTOTUNE_LOG) or None,
+                # The native engine consumes fusion/cycle (continuous) and
+                # the response-cache toggle (categorical); hierarchical is
+                # not a native-data-plane knob, so it is not explored.
+                categories=[
+                    {"cache_enabled": True, "hierarchical_allreduce": False},
+                    {"cache_enabled": False, "hierarchical_allreduce": False},
+                ],
+            )
+            self._tuner = threading.Thread(
+                target=self._tuner_loop, name="hvdtpu_autotune", daemon=True
+            )
+            self._tuner.start()
 
     # --------------------------------------------------------- rendezvous
 
@@ -188,7 +223,12 @@ class NativeEngine:
         postscale: float = 1.0,
     ) -> concurrent.futures.Future:
         if tensor is not None:
-            arr = np.ascontiguousarray(tensor)
+            # np.ascontiguousarray silently promotes 0-d scalars to shape
+            # (1,), which would bypass the controller's scalar validation;
+            # np.asarray preserves 0-d (and is already contiguous then).
+            arr = np.asarray(tensor)
+            if not arr.flags["C_CONTIGUOUS"]:
+                arr = np.ascontiguousarray(arr)
             dtype_name = str(arr.dtype)
             shape = arr.shape
             data_ptr = arr.ctypes.data_as(ctypes.c_void_p)
@@ -223,7 +263,34 @@ class NativeEngine:
         return fut
 
     def barrier(self) -> concurrent.futures.Future:
-        return self.enqueue(RequestType.BARRIER, "hvdtpu.barrier", None)
+        # Sequence-numbered: overlapping barriers queue instead of hitting
+        # the duplicate-name guard; the Nth call on every rank pairs up.
+        with self._lock:
+            self._barrier_seq += 1
+            seq = self._barrier_seq
+        return self.enqueue(RequestType.BARRIER, f"hvdtpu.barrier.{seq}", None)
+
+    # ----------------------------------------------------------- autotune
+
+    def _tuner_loop(self) -> None:
+        """Rank-0 scoring loop: one tick per engine cycle's worth of wall
+        clock; scores the perf-bytes delta and pushes tuner moves into the
+        engine (reference parameter_manager.cc Update/Tune cadence)."""
+        last_bytes = 0
+        while not self._stop and not self.lib.hvdtpu_is_shutdown():
+            time.sleep(max(self.lib.hvdtpu_get_cycle_ms() / 1000.0, 0.001))
+            now_bytes = self.lib.hvdtpu_perf_bytes()
+            self._pm.record_bytes(now_bytes - last_bytes)
+            last_bytes = now_bytes
+            proposal = self._pm.cycle()
+            if proposal is not None:
+                self.lib.hvdtpu_set_params(
+                    proposal.fusion_bytes,
+                    proposal.cycle_s * 1000.0,
+                    1 if proposal.cache_enabled else 0,
+                )
+            if self._pm.converged:
+                return
 
     def shutdown(self) -> None:
         self._stop = True

@@ -188,8 +188,10 @@ class TestParamSync:
         assert back.tuned_params is None
 
     def test_engine_applies_rank0_params(self, monkeypatch):
-        """A 1-world engine with a stubbed 2-rank negotiation applies the
+        """A 1-world engine with a stubbed 2-rank exchange applies the
         params riding rank 0's list (SynchronizeParameters analog)."""
+        import numpy as np
+
         import horovod_tpu as hvd
         from horovod_tpu.runtime.engine import EagerEngine
 
@@ -199,13 +201,14 @@ class TestParamSync:
         eng._controller.world_size = 2
         tuned = TunedParams(8 * 1048576, 0.002)
 
-        def fake_negotiate(rlist):
-            return [
+        def fake_exchange(payload, shutdown, joined):
+            bits = np.zeros((2, eng._cache.num_bits), np.uint8)
+            return set(), set(), bits, [
                 RequestList(tuned_params=tuned.as_wire()),
                 RequestList(),
             ]
 
-        monkeypatch.setattr(eng, "_negotiate", fake_negotiate)
+        monkeypatch.setattr(eng, "_exchange", fake_exchange)
         eng._run_loop_once()
         assert eng.fusion_bytes == tuned.fusion_bytes
         assert eng.cycle_s == pytest.approx(tuned.cycle_s)

@@ -1,0 +1,92 @@
+"""ResponseCache unit tests (reference response_cache.cc: LRU keyed on
+name+params, deterministic slot allocation, conflict eviction)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runtime.messages import (
+    Request,
+    RequestType,
+    Response,
+    ResponseType,
+)
+from horovod_tpu.runtime import response_cache as rc
+
+
+def _req(name, shape=(2,), dtype="float32", rtype=RequestType.ALLREDUCE,
+         reduce_op=1):
+    return Request(
+        request_rank=0, request_type=rtype, tensor_name=name,
+        dtype=dtype, shape=shape, reduce_op=reduce_op,
+    )
+
+
+def _resp(name, rtype=ResponseType.ALLREDUCE):
+    r = Response(rtype, [name])
+    r._shapes = [(2,)]
+    r._dtype = "float32"
+    r._fuse_meta = ("float32", 1, 1.0, 1.0)
+    r._nbytes = 8
+    return r
+
+
+def test_miss_then_hit():
+    c = rc.ResponseCache(8)
+    req = _req("a")
+    assert c.lookup(req) == (rc.MISS, -1)
+    c.insert(req, _resp("a"))
+    status, slot = c.lookup(req)
+    assert status == rc.HIT
+    out = c.response_for(slot)
+    assert out.tensor_names == ["a"]
+    assert out.response_type == ResponseType.ALLREDUCE
+    assert out._fuse_meta == ("float32", 1, 1.0, 1.0)
+
+
+def test_changed_params_conflict():
+    c = rc.ResponseCache(8)
+    c.insert(_req("a"), _resp("a"))
+    status, _ = c.lookup(_req("a", shape=(3,)))
+    assert status == rc.CONFLICT
+    status, _ = c.lookup(_req("a", dtype="int64"))
+    assert status == rc.CONFLICT
+    c.evict_name("a")
+    assert c.lookup(_req("a")) == (rc.MISS, -1)
+
+
+def test_slot_allocation_is_lowest_free():
+    c = rc.ResponseCache(8)
+    for name in ("a", "b", "c"):
+        c.insert(_req(name), _resp(name))
+    assert [c.lookup(_req(n))[1] for n in ("a", "b", "c")] == [0, 1, 2]
+    c.evict_name("b")
+    c.insert(_req("d"), _resp("d"))
+    assert c.lookup(_req("d"))[1] == 1  # reuses the freed slot
+
+
+def test_lru_eviction_at_capacity():
+    c = rc.ResponseCache(2)
+    c.insert(_req("a"), _resp("a"))
+    c.insert(_req("b"), _resp("b"))
+    c.touch(c.lookup(_req("a"))[1])  # a is now most-recent
+    c.insert(_req("c"), _resp("c"))  # evicts b (least recent)
+    assert c.lookup(_req("a"))[0] == rc.HIT
+    assert c.lookup(_req("b"))[0] == rc.MISS
+    assert c.lookup(_req("c"))[0] == rc.HIT
+
+
+def test_allgather_and_barrier_not_cacheable():
+    c = rc.ResponseCache(8)
+    ag = _req("g", rtype=RequestType.ALLGATHER)
+    c.insert(ag, _resp("g", ResponseType.ALLGATHER))
+    assert c.lookup(ag) == (rc.MISS, -1)
+    assert not rc.cacheable(RequestType.BARRIER)
+    assert not rc.cacheable(RequestType.JOIN)
+    assert rc.cacheable(RequestType.ADASUM)
+
+
+def test_capacity_zero_disables():
+    c = rc.ResponseCache(0)
+    c.insert(_req("a"), _resp("a"))
+    assert c.lookup(_req("a")) == (rc.MISS, -1)
+    assert c.num_bits == 0
