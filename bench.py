@@ -58,28 +58,18 @@ def peak_flops_per_chip(device, dtype: str) -> float:
     return peak
 
 
-def main() -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="resnet50",
-                        choices=["resnet50", "resnet101", "resnet18"])
-    parser.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"],
-                        help="compute dtype (params/accumulators stay fp32)")
-    parser.add_argument("--batch-size", type=int, default=128)
-    parser.add_argument("--image-size", type=int, default=224)
-    parser.add_argument("--iters", type=int, default=30)
-    parser.add_argument("--warmup", type=int, default=5)
-    parser.add_argument("--cpu", action="store_true",
-                        help="force CPU (dev mode; numbers not comparable)")
-    args = parser.parse_args()
+def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 224):
+    """Build the benchmark's jitted training step and its initial state.
 
-    if args.cpu:
-        # Env var too: hvd.init() re-asserts JAX_PLATFORMS from the
-        # environment (to undo site-hook overrides), so config alone would
-        # be flipped back.
-        import os
-
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        jax.config.update("jax_platforms", "cpu")
+    Shared by bench.py (timing) and scripts/profile_bench.py (tracing) so the
+    profiled step is exactly the benchmarked step. Returns
+    ``(step, state, static)`` where ``state = (params, batch_stats,
+    opt_state, images, labels)`` and ``step`` is the un-lowered jit callable.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
 
     import horovod_tpu as hvd
     from horovod_tpu import models
@@ -88,21 +78,21 @@ def main() -> int:
     hvd.init()
     n_chips = hvd.num_devices()
 
-    compute_dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    compute_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     model_cls = {
         "resnet50": models.ResNet50,
         "resnet101": models.ResNet101,
         "resnet18": models.ResNet18,
-    }[args.model]
+    }[model_name]
     model = model_cls(num_classes=1000, compute_dtype=compute_dtype)
 
     rng = jax.random.PRNGKey(0)
-    global_batch = args.batch_size * n_chips
+    global_batch = batch_size * n_chips
     # Inputs in the compute dtype: halves the first conv's HBM read under
     # bf16 and matches what a real bf16 input pipeline would feed.
     images = jnp.asarray(
         np.random.RandomState(0)
-        .randn(global_batch, args.image_size, args.image_size, 3),
+        .randn(global_batch, image_size, image_size, 3),
         dtype=compute_dtype,
     )
     labels = jnp.asarray(
@@ -152,6 +142,39 @@ def main() -> int:
         ),
         donate_argnums=(0, 1, 2),
     )
+    state = (params, batch_stats, opt_state, images, labels)
+    return step, state, {"n_chips": n_chips, "global_batch": global_batch}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "resnet101", "resnet18"])
+    parser.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"],
+                        help="compute dtype (params/accumulators stay fp32)")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force CPU (dev mode; numbers not comparable)")
+    args = parser.parse_args()
+
+    if args.cpu:
+        # Env var too: hvd.init() re-asserts JAX_PLATFORMS from the
+        # environment (to undo site-hook overrides), so config alone would
+        # be flipped back.
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    step, state, static = build_step(
+        args.model, args.dtype, args.batch_size, args.image_size
+    )
+    params, batch_stats, opt_state, images, labels = state
+    n_chips = static["n_chips"]
+    global_batch = static["global_batch"]
 
     # Compiled cost analysis of the ACTUAL step: fwd+bwd+optimizer FLOPs as
     # XLA counts them post-fusion — no hand-derived 3x-forward estimates.

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of the bench step and summarize hot ops.
+
+Dev tool for the perf push (VERDICT r2 item 1). Writes the raw xplane to
+--out (default /tmp/hvdtpu_trace) and prints a per-op-category time
+breakdown parsed from the xplane proto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def summarize_xplane(logdir: str) -> None:
+    paths = glob.glob(
+        os.path.join(logdir, "**", "*.trace.json.gz"), recursive=True
+    )
+    if not paths:
+        print("no trace.json.gz found under", logdir)
+        return
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    pid_names = {}
+    tid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"]["name"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tid_names[(e["pid"], e["tid"])] = e["args"]["name"]
+    # Find TPU device pids (XLA op lines)
+    by_name = defaultdict(float)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pname = pid_names.get(e.get("pid"), "")
+        tname = tid_names.get((e.get("pid"), e.get("tid")), "")
+        # keep only the device XLA-op line
+        if "tpu" not in pname.lower() or "XLA Ops" not in tname:
+            continue
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        by_name[e["name"]] += dur
+        total += dur
+    print(f"== XLA op time by name (total {total:.2f} ms across trace) ==")
+    items = sorted(by_name.items(), key=lambda kv: -kv[1])
+    # group by fusion-category prefix
+    by_cat = defaultdict(float)
+    for name, dur in items:
+        cat = name.split(".")[0].rstrip("0123456789")
+        by_cat[cat] += dur
+    print("-- by category --")
+    for cat, dur in sorted(by_cat.items(), key=lambda kv: -kv[1])[:20]:
+        print(f"{dur:10.2f} ms  {100*dur/total:5.1f}%  {cat}")
+    print("-- top 30 ops --")
+    for name, dur in items[:30]:
+        print(f"{dur:10.2f} ms  {100*dur/total:5.1f}%  {name}")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="/tmp/hvdtpu_trace")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--iters", type=int, default=5)
+    parser.add_argument("--summarize-only", action="store_true")
+    args = parser.parse_args()
+
+    if args.summarize_only:
+        summarize_xplane(args.out)
+        return 0
+
+    import jax
+
+    from bench import build_step  # the EXACT step bench.py times
+
+    step, state, _ = build_step("resnet50", "bf16", args.batch_size)
+    params, batch_stats, opt_state, images, labels = state
+    # warmup/compile
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    float(loss)
+    jax.profiler.start_trace(args.out)
+    for _ in range(args.iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    float(loss)
+    jax.profiler.stop_trace()
+    print("trace written to", args.out)
+    summarize_xplane(args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
