@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# CI matrix driver (reference: .buildkite/gen-pipeline.sh:10-33 crossing
+# {MPI,Gloo,...} x {py} x {framework} images; here the axes that exist in
+# the TPU build: eager engine {python,native} x world size {1,2,4}).
+#
+# Usage: ci/test_matrix.sh            # full matrix
+#        ci/test_matrix.sh quick      # unit suite + np=2 cross-engine only
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== build native engine =="
+make -C cpp
+
+echo "== unit + in-process multiprocess suite (builds cover both engines) =="
+python -m pytest tests/ -x -q
+
+if [ "${1:-full}" = "quick" ]; then
+    exit 0
+fi
+
+# Engine x world-size smoke matrix through the REAL launcher CLI (the
+# reference runs examples under both mpirun and horovodrun for every
+# image, gen-pipeline.sh:134-232).
+for engine in python native; do
+    for np in 1 2 4; do
+        echo "== smoke: engine=$engine np=$np =="
+        HVDTPU_EAGER_ENGINE=$engine \
+        JAX_PLATFORMS=cpu \
+            python -m horovod_tpu.run -np "$np" -H "localhost:$np" \
+            python examples/mnist.py --smoke
+    done
+done
+echo "matrix OK"

@@ -46,11 +46,23 @@ def synthetic_mnist(n=4096, seed=0):
 
 
 def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="tiny run for the CI matrix (ci/test_matrix.sh)",
+    )
+    parser.add_argument("--epochs", type=int, default=3)
+    args = parser.parse_args()
+    if args.smoke:
+        args.epochs = 1
+
     hvd.init()
     model = ConvNet()
     rng = jax.random.PRNGKey(1)
 
-    x, y = synthetic_mnist()
+    x, y = synthetic_mnist(n=512 if args.smoke else 4096)
     # Step 2: shard the data across workers.  On TPU the mesh IS the data
     # sharding: every process builds the same global batch, and
     # P(DP_AXIS) hands each chip its distinct row block — rank-slicing the
@@ -94,7 +106,7 @@ def main():
     )
 
     batch = 32 * hvd.num_devices()
-    for epoch in range(3):
+    for epoch in range(args.epochs):
         t0 = time.time()
         losses = []
         for i in range(0, len(x) - batch + 1, batch):

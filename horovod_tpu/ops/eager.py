@@ -16,6 +16,7 @@ from __future__ import annotations
 import concurrent.futures
 from typing import Optional
 
+import jax
 import numpy as np
 
 from .._engine_registry import get_engine
@@ -54,10 +55,45 @@ def _auto_name(prefix: str) -> str:
     return f"{prefix}.noname.{_name_counter}"
 
 
-def _to_host(tensor) -> np.ndarray:
-    # The eager path owns host<->device movement; jax arrays come to the
-    # host once, the engine's data plane puts fused buffers back on device.
-    return np.asarray(tensor)
+def _ingest(engine, tensor):
+    """Hand a payload to the engine without gratuitous copies.
+
+    Returns ``(payload, device)``; ``device`` non-None marks a device-
+    resident caller whose result must come back as a committed
+    ``jax.Array`` (reference: the GPU path keeps tensors on device end to
+    end, operations.cc:266-291).
+
+    * Python engine + single-device jax.Array: passed through untouched —
+      the engine executes the negotiated op on the XLA device data plane
+      (runtime/device_plane.py), zero host round-trips.
+    * Native engine + jax.Array: the TCP wire needs host bytes; a CPU-
+      backed array is ingested as a **zero-copy dlpack view** (the analog
+      of the reference registering the framework buffer directly with the
+      collective, no staging copy); an accelerator array pays exactly one
+      D2H transfer.
+    * Everything else (numpy, torch, lists): ``np.asarray`` as before.
+    """
+    if tensor is None:
+        return None, None
+    if isinstance(tensor, jax.Array):
+        try:
+            devices = tensor.devices()
+        except Exception:  # deleted/donated
+            devices = set()
+        dev = next(iter(devices)) if len(devices) == 1 else None
+        if getattr(engine, "accepts_device_arrays", False) and dev is not None:
+            return tensor, dev
+        try:
+            return np.from_dlpack(tensor), dev
+        except Exception:  # non-host backing (TPU): one explicit transfer
+            return np.asarray(tensor), dev
+    return np.asarray(tensor), None
+
+
+def _tag(fut: concurrent.futures.Future, dev) -> concurrent.futures.Future:
+    if dev is not None:
+        fut._hvdtpu_device = dev  # consumed by synchronize()
+    return fut
 
 
 def allreduce_async(
@@ -72,13 +108,17 @@ def allreduce_async(
     rtype = (
         RequestType.ADASUM if op == ReduceOp.ADASUM else RequestType.ALLREDUCE
     )
-    return engine.enqueue(
-        rtype,
-        name or _auto_name("allreduce"),
-        _to_host(tensor),
-        reduce_op=int(op),
-        prescale=prescale_factor,
-        postscale=postscale_factor,
+    payload, dev = _ingest(engine, tensor)
+    return _tag(
+        engine.enqueue(
+            rtype,
+            name or _auto_name("allreduce"),
+            payload,
+            reduce_op=int(op),
+            prescale=prescale_factor,
+            postscale=postscale_factor,
+        ),
+        dev,
     )
 
 
@@ -97,8 +137,13 @@ def allgather_async(tensor, name: Optional[str] = None) -> concurrent.futures.Fu
     """reference: hvd.allgather_async (torch/mpi_ops.py:231-260).  Ragged
     dim-0 across ranks is supported — sizes are negotiated (controller
     Response::tensor_sizes)."""
-    return get_engine().enqueue(
-        RequestType.ALLGATHER, name or _auto_name("allgather"), _to_host(tensor)
+    engine = get_engine()
+    payload, dev = _ingest(engine, tensor)
+    return _tag(
+        engine.enqueue(
+            RequestType.ALLGATHER, name or _auto_name("allgather"), payload
+        ),
+        dev,
     )
 
 
@@ -110,11 +155,16 @@ def broadcast_async(
     tensor, root_rank: int, name: Optional[str] = None
 ) -> concurrent.futures.Future:
     """reference: hvd.broadcast_async (torch/mpi_ops.py:330-360)."""
-    return get_engine().enqueue(
-        RequestType.BROADCAST,
-        name or _auto_name("broadcast"),
-        _to_host(tensor),
-        root_rank=root_rank,
+    engine = get_engine()
+    payload, dev = _ingest(engine, tensor)
+    return _tag(
+        engine.enqueue(
+            RequestType.BROADCAST,
+            name or _auto_name("broadcast"),
+            payload,
+            root_rank=root_rank,
+        ),
+        dev,
     )
 
 
@@ -139,11 +189,16 @@ def reducescatter_async(
 
     if op not in (_R.AVERAGE, _R.SUM):
         raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
-    return get_engine().enqueue(
-        RequestType.REDUCESCATTER,
-        name or _auto_name("reducescatter"),
-        _to_host(tensor),
-        reduce_op=int(op),
+    engine = get_engine()
+    payload, dev = _ingest(engine, tensor)
+    return _tag(
+        engine.enqueue(
+            RequestType.REDUCESCATTER,
+            name or _auto_name("reducescatter"),
+            payload,
+            reduce_op=int(op),
+        ),
+        dev,
     )
 
 
@@ -152,8 +207,13 @@ def reducescatter(tensor, op: ReduceOp = Average, name: Optional[str] = None):
 
 
 def alltoall_async(tensor, name: Optional[str] = None) -> concurrent.futures.Future:
-    return get_engine().enqueue(
-        RequestType.ALLTOALL, name or _auto_name("alltoall"), _to_host(tensor)
+    engine = get_engine()
+    payload, dev = _ingest(engine, tensor)
+    return _tag(
+        engine.enqueue(
+            RequestType.ALLTOALL, name or _auto_name("alltoall"), payload
+        ),
+        dev,
     )
 
 
@@ -169,8 +229,21 @@ def poll(handle: concurrent.futures.Future) -> bool:
 def synchronize(handle: concurrent.futures.Future):
     """Block until completion and return the result (reference
     torch/mpi_ops.py:475-491; raises the negotiated error on mismatch,
-    like the reference's ErrorOp -> exception path)."""
-    return handle.result()
+    like the reference's ErrorOp -> exception path).
+
+    Device-resident callers get a committed ``jax.Array`` back on the
+    device their input lived on: device-plane results arrive as device
+    arrays already; host-plane results (native engine's TCP wire, ADASUM)
+    are placed back with one H2D transfer."""
+    result = handle.result()
+    dev = getattr(handle, "_hvdtpu_device", None)
+    if (
+        dev is not None
+        and result is not None
+        and not isinstance(result, jax.Array)
+    ):
+        result = jax.device_put(result, dev)
+    return result
 
 
 def join() -> int:

@@ -277,9 +277,14 @@ def broadcast_parameters(params, root_rank: int = 0):
         # Enqueue every leaf first so the engine can fuse them into a few
         # negotiation cycles (the reference enqueues all parameter
         # broadcasts before synchronizing, torch/__init__.py:452-508).
+        # Leaves pass through as-is: jax.Array leaves ride the device data
+        # plane (no host round-trip); scalars/lists are normalized here.
         leaves, treedef = jax.tree_util.tree_flatten(params)
         handles = [
-            eager.broadcast_async(np.asarray(l), root_rank=root_rank)
+            eager.broadcast_async(
+                l if isinstance(l, (jax.Array, np.ndarray)) else np.asarray(l),
+                root_rank=root_rank,
+            )
             for l in leaves
         ]
         outs = [eager.synchronize(h) for h in handles]

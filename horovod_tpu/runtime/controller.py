@@ -185,6 +185,13 @@ def compute_responses(
             )
             continue
         first = next(iter(entry.requests.values()))
+        # Device-plane vote (reference Request::device): the response runs
+        # as an XLA device collective only when EVERY participating rank's
+        # payload is device-resident — any host buffer demotes the op.
+        # Deterministic (a pure function of the gathered requests), so all
+        # ranks pick the same plane, which is what keeps the collectives
+        # matched.
+        on_device = all(r.device for r in entry.requests.values())
         if rtype == RequestType.ALLGATHER:
             sizes = [
                 entry.requests[r].shape[0] if r in entry.requests else 0
@@ -193,6 +200,7 @@ def compute_responses(
             resp = Response(ResponseType.ALLGATHER, [name], tensor_sizes=sizes)
             resp._shapes = [tuple(first.shape)]  # type: ignore[attr-defined]
             resp._dtype = first.dtype  # type: ignore[attr-defined]
+            resp._device = on_device  # type: ignore[attr-defined]
             responses.append(resp)
         else:
             resp = Response(ResponseType(int(rtype)), [name])
@@ -201,6 +209,7 @@ def compute_responses(
             resp._shapes = [tuple(first.shape)]  # type: ignore[attr-defined]
             resp._dtype = first.dtype  # type: ignore[attr-defined]
             resp._root_rank = first.root_rank  # type: ignore[attr-defined]
+            resp._device = on_device  # type: ignore[attr-defined]
             if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM,
                          RequestType.REDUCESCATTER):
                 # Fusion identity + byte size (reference keeps dtype
@@ -265,7 +274,12 @@ def _fuse(
             flush()
             fused.append(resp)
             continue
-        meta = getattr(resp, "_fuse_meta", None)
+        # Fusion identity includes the data plane: a device-resident fused
+        # buffer can't absorb a host-plane response (and vice versa).
+        meta = (
+            getattr(resp, "_fuse_meta", None),
+            getattr(resp, "_device", False),
+        )
         nbytes = getattr(resp, "_nbytes", 0)
         if (
             pending is None

@@ -1,0 +1,256 @@
+"""Device-resident data plane for the eager engine.
+
+The reference keeps eager collectives on the accelerator with NCCL plus a
+ready-event/finalizer machine (horovod/common/operations.cc:266-291 busy-wait
+on ReadyEvents, torch/ready_event.cc:1-116 cudaEvent readiness, persistent
+device fusion buffers fusion_buffer_manager.cc:20-53).  The TPU-native
+answer needs none of that plumbing: XLA *is* the device collective runtime.
+This module executes each negotiated (fused) eager payload as a compiled
+``shard_map`` collective over a process-spanning mesh — ``psum`` /
+``all_gather`` / ``psum_scatter`` / ``all_to_all`` over ICI/DCN — so a
+``jax.Array`` enqueued on one chip is reduced chip-to-chip and the result
+is committed back to the caller's device with no host round-trip.
+
+Readiness: a ``jax.Array`` handed to the engine may still be being produced
+by an earlier async dispatch; enqueueing it into another XLA computation
+makes the runtime sequence the two on the device stream — the ReadyEvent
+busy-wait of the reference is replaced by XLA's own dataflow order.
+
+Donation: the staging buffer (the ``(world, n)`` stacked array built from
+the fused payload) is always freshly constructed here — eager ``jnp``
+reshapes/concats allocate new buffers — so every jitted collective donates
+it (``donate_argnums=0``): the collective consumes its input allocation
+instead of holding payload memory twice, which is the reference's in-place
+fusion-buffer behavior.
+
+Ordering: the engine calls this plane only for responses that completed
+negotiation, in the deterministic response order every rank computes — so
+all processes issue identical collectives in identical order, which is the
+correctness contract for multi-controller XLA.  (It is the same contract the
+engine's control-plane ``process_allgather`` already relies on, and the
+reason the Python engine documents that user code must not run concurrent
+main-thread collectives while eager ops are in flight.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..basics import global_topology
+from ..utils.logging import get_logger
+
+LOG = get_logger("device_plane")
+
+PROC_AXIS = "hvdtpu_proc"
+
+
+class DevicePlane:
+    """Compiled XLA collectives over a one-device-per-process mesh.
+
+    The plane's mesh row order is process order, which ``basics.init`` pins
+    to the engine's rank order (jax.distributed process_id == HVDTPU_RANK),
+    so "row r" and "engine rank r" coincide by construction.
+    """
+
+    def __init__(self) -> None:
+        topo = global_topology()
+        self.world = topo.process_count
+        self.rank = topo.process_rank
+        by_proc: dict = {}
+        for d in topo.devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        if sorted(by_proc) != list(range(self.world)):
+            raise RuntimeError(
+                f"device/process mismatch: process indices {sorted(by_proc)} "
+                f"vs world {self.world} (is jax.distributed initialized?)"
+            )
+        devs = [min(by_proc[p], key=lambda d: d.id) for p in range(self.world)]
+        self.device = devs[self.rank]
+        if self.device not in jax.local_devices():
+            raise RuntimeError(
+                f"plane device {self.device} for rank {self.rank} is not "
+                "addressable from this process"
+            )
+        self.mesh = Mesh(np.asarray(devs, dtype=object), (PROC_AXIS,))
+
+    # ------------------------------------------------------------- staging
+
+    def stage(self, local: jax.Array) -> jax.Array:
+        """Build the (world, ...) global array whose row r is rank r's
+        buffer — the device analog of the host plane's gathered matrix.
+        The returned array's buffer is fresh (the [None] reshape allocates),
+        so downstream jits may donate it."""
+        if next(iter(local.devices())) != self.device:
+            local = jax.device_put(local, self.device)
+        row = local[None]
+        shape = (self.world,) + tuple(local.shape)
+        sharding = NamedSharding(self.mesh, P(PROC_AXIS))
+        return jax.make_array_from_single_device_arrays(shape, sharding, [row])
+
+    @staticmethod
+    def _local(out: jax.Array) -> jax.Array:
+        """Extract this process's (replicated or shard) copy as a committed
+        single-device array."""
+        return out.addressable_shards[0].data
+
+    # ---------------------------------------------------------- collectives
+
+    @functools.lru_cache(maxsize=256)
+    def _allreduce_fn(self, reduce_op: int, pre: float, post: float,
+                      wire: str, acc: str, exact_int_avg: bool):
+        from ..ops.collectives import ReduceOp  # noqa: PLC0415
+
+        def f(x):  # x: (1, n) local shard in wire dtype
+            v = x[0].astype(acc)
+            if pre != 1.0:
+                v = (v * pre).astype(wire).astype(acc)
+            if reduce_op == int(ReduceOp.MIN):
+                total = lax.pmin(v, PROC_AXIS)
+            elif reduce_op == int(ReduceOp.MAX):
+                total = lax.pmax(v, PROC_AXIS)
+            else:
+                total = lax.psum(v, PROC_AXIS)
+                if reduce_op == int(ReduceOp.AVERAGE):
+                    if exact_int_avg:
+                        total = total // self.world
+                    else:
+                        total = total / self.world
+            if post != 1.0:
+                total = total * post
+            return total.astype(wire)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh, in_specs=P(PROC_AXIS), out_specs=P(),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def allreduce(self, flat: jax.Array, reduce_op: int, pre: float,
+                  post: float, acc_dtype: str, exact_int_avg: bool) -> jax.Array:
+        """Reduce a 1-D fused buffer across processes; returns the reduced
+        buffer (wire dtype) on this plane's device."""
+        fn = self._allreduce_fn(
+            reduce_op, pre, post, str(flat.dtype), acc_dtype, exact_int_avg
+        )
+        return self._local(fn(self.stage(flat)))
+
+    @functools.lru_cache(maxsize=64)
+    def _allgather_fn(self):
+        def f(x):  # x: (1, ...) local shard
+            return lax.all_gather(x[0], PROC_AXIS)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh, in_specs=P(PROC_AXIS), out_specs=P(),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def allgather(self, local: jax.Array) -> jax.Array:
+        """(world, *local.shape) on this plane's device (rows = ranks)."""
+        return self._local(self._allgather_fn()(self.stage(local)))
+
+    @functools.lru_cache(maxsize=64)
+    def _broadcast_fn(self, root: int, wire: str):
+        # One psum of a masked contribution — O(bytes) on the ICI ring,
+        # same trick as the jit path's _broadcast (ops/collectives.py).
+        def f(x):
+            v = x[0]
+            mask = (lax.axis_index(PROC_AXIS) == root)
+            contrib = jnp.where(mask, v, jnp.zeros_like(v))
+            return lax.psum(contrib, PROC_AXIS).astype(wire)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh, in_specs=P(PROC_AXIS), out_specs=P(),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def broadcast(self, local: jax.Array, root: int) -> jax.Array:
+        if local.dtype == jnp.bool_:
+            # psum over bool is invalid; ride uint8
+            out = self.broadcast(local.astype(jnp.uint8), root)
+            return self._cast(out, jnp.bool_)
+        return self._local(
+            self._broadcast_fn(root, str(local.dtype))(self.stage(local))
+        )
+
+    @staticmethod
+    def _cast(x: jax.Array, dtype) -> jax.Array:
+        return x.astype(dtype)
+
+    @functools.lru_cache(maxsize=64)
+    def _reducescatter_fn(self, average: bool, pre: float, post: float,
+                          wire: str, acc: str):
+        def f(x):  # x: (1, n0, ...) — n0 divisible by world
+            v = x[0].astype(acc)
+            if pre != 1.0:
+                v = (v * pre).astype(wire).astype(acc)
+            chunk = lax.psum_scatter(v, PROC_AXIS, scatter_dimension=0,
+                                     tiled=True)
+            if average:
+                chunk = chunk / self.world
+            if post != 1.0:
+                chunk = chunk * post
+            return chunk.astype(wire)[None]
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh, in_specs=P(PROC_AXIS),
+                out_specs=P(PROC_AXIS), check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def reducescatter(self, local: jax.Array, average: bool, pre: float,
+                      post: float, acc_dtype: str) -> jax.Array:
+        """Even-dim0 reduce-scatter; returns this rank's chunk."""
+        fn = self._reducescatter_fn(
+            average, pre, post, str(local.dtype), acc_dtype
+        )
+        out = fn(self.stage(local))
+        return self._local(out)[0]
+
+    @functools.lru_cache(maxsize=64)
+    def _alltoall_fn(self):
+        def f(x):  # x: (1, n0, ...) — n0 divisible by world
+            v = x[0]
+            n = self.world
+            chunks = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+            out = lax.all_to_all(chunks, PROC_AXIS, split_axis=0,
+                                 concat_axis=0, tiled=False)
+            return out.reshape(v.shape)[None]
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh, in_specs=P(PROC_AXIS),
+                out_specs=P(PROC_AXIS), check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def alltoall(self, local: jax.Array) -> jax.Array:
+        out = self._alltoall_fn()(self.stage(local))
+        return self._local(out)[0]
+
+
+def build_plane() -> Optional[DevicePlane]:
+    """Construct the plane, or None (with one log line) when the topology
+    can't support it — the engine then stays on its host data plane."""
+    try:
+        return DevicePlane()
+    except Exception as exc:  # device/process mismatch, no distributed init
+        LOG.warning("device data plane unavailable: %s", exc)
+        return None

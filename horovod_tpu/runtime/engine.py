@@ -89,6 +89,18 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
+def _is_device_tensor(tensor) -> bool:
+    """Single-device jax.Array: the payload kind the device data plane can
+    carry without a host round-trip.  Sharded arrays and host buffers take
+    the host plane."""
+    if not isinstance(tensor, jax.Array):
+        return False
+    try:
+        return len(tensor.devices()) == 1
+    except Exception:  # deleted/donated array
+        return False
+
+
 @dataclass
 class TensorTableEntry:
     """reference common.h:233-250."""
@@ -102,6 +114,10 @@ class TensorTableEntry:
 
 class EagerEngine:
     """Owns the background thread, tensor table, controller state."""
+
+    # jax.Array payloads stay device-resident end to end (device_plane.py);
+    # the native engine's TCP wire needs host bytes instead.
+    accepts_device_arrays = True
 
     def __init__(self):
         topo = global_topology()
@@ -153,7 +169,21 @@ class EagerEngine:
             "cache_misses": 0,
             "cached_responses": 0,  # ops executed straight from cache votes
             "negotiated_responses": 0,  # ops through full negotiation
+            "host_data_ops": 0,  # responses executed on the host data plane
+            "device_data_ops": 0,  # responses executed as XLA collectives
+            "device_payload_bytes": 0,  # bytes that stayed device-resident
         }
+
+        # Device data plane (runtime/device_plane.py): fused payloads whose
+        # tensors are jax.Arrays execute as compiled XLA collectives over a
+        # process mesh — no host round-trip (the analog of the reference's
+        # NCCL device path, operations.cc:266-291).  The kill switch gates
+        # *enqueue* (Request.device=False), so disabling it on any rank
+        # demotes the op globally through negotiation instead of desyncing
+        # the planes.  Built lazily on the first device response.
+        self._device_enabled = envmod.env_bool(envmod.EAGER_DEVICE, default=True)
+        self._device_plane = None
+        self._device_plane_tried = False
 
         # Autotuner (reference parameter_manager.cc): rank 0 scores
         # bytes/sec per sample window and proposes new params; peers apply
@@ -214,7 +244,18 @@ class EagerEngine:
             root_rank=root_rank,
             prescale_factor=prescale,
             postscale_factor=postscale,
+            device=self._device_enabled and _is_device_tensor(tensor),
         )
+        if self.world > 1 and isinstance(tensor, jax.Array):
+            # Snapshot the payload at enqueue (an async device-to-device
+            # copy — still zero host round-trips).  The engine's reference
+            # to the caller's array does not survive jit donation: without
+            # the snapshot a buffer donated between enqueue and the
+            # background cycle would fail materialization on this rank
+            # after peers already negotiated the collective — a distributed
+            # hang.  The reference's enqueue likewise memcpys into its own
+            # buffer (fusion_buffer_manager.cc).
+            tensor = jnp.copy(tensor)
         entry = TensorTableEntry(request=req, tensor=tensor)
         if self.world == 1:
             self._execute_local(entry)
@@ -564,6 +605,34 @@ class EagerEngine:
     # participates with zeros of the negotiated shape (reference
     # tensor_queue.h:39-41 zero-tensor substitution).
 
+    # ------------------------------------------------------ device data plane
+
+    def _plane(self):
+        """Lazily build the XLA device data plane (device_plane.py)."""
+        if not self._device_plane_tried:
+            self._device_plane_tried = True
+            from . import device_plane  # noqa: PLC0415
+
+            self._device_plane = device_plane.build_plane()
+        return self._device_plane
+
+    def _use_device(self, resp: Response) -> bool:
+        """Negotiated plane for this response — identical on all ranks
+        (controller sets _device = AND of every rank's Request.device).  A
+        negotiated-device response with no usable local plane raises: a
+        silent local demotion would execute a host collective while peers
+        run the device one, deadlocking the job."""
+        if not getattr(resp, "_device", False):
+            return False
+        if self._plane() is None:
+            raise RuntimeError(
+                "response negotiated for the device data plane but this "
+                "rank could not build one (see device_plane log); set "
+                f"{envmod.EAGER_DEVICE}=0 on ALL ranks to force the host "
+                "plane"
+            )
+        return True
+
     def _data_allgather(self, local: np.ndarray) -> np.ndarray:
         """Data-plane allgather over processes -> (world, *local.shape).
 
@@ -573,6 +642,7 @@ class EagerEngine:
         """
         from jax.experimental import multihost_utils  # noqa: PLC0415
 
+        self.stats["host_data_ops"] += 1
         local = np.ascontiguousarray(local)
         raw = local.reshape(-1).view(np.uint8)
         out = multihost_utils.process_allgather(raw)
@@ -605,6 +675,51 @@ class EagerEngine:
             # reference's PrescaleFactor path also goes through double);
             # exactness beyond 2^53 is only guaranteed for scale == 1.
             acc_dtype = np.dtype(np.float64)
+        from ..ops.collectives import ReduceOp as _R  # noqa: PLC0415
+
+        # Device-resident path: jax.Array payloads reduce as one compiled
+        # XLA collective — no host round-trip (device_plane.py).  Falls
+        # through to the host plane for ADASUM (numpy VHDD reference math),
+        # scaled ints (need f64) and bools — all conditions derived from
+        # NEGOTIATED fields, so every rank picks the same plane.
+        if (
+            reduce_op != int(_R.ADASUM)
+            and not (scaled and is_int)
+            and wire_dtype.kind != "b"
+            and self._use_device(resp)
+        ):
+            plane = self._plane()
+            wire_j = jnp.dtype(_np_dtype(dtype_name))
+            flats = []
+            for e, shape in zip(entries, shapes):
+                if e is not None and e.tensor is not None:
+                    flats.append(jnp.ravel(e.tensor).astype(wire_j))
+                else:
+                    n = int(np.prod(shape)) if shape else 1
+                    flats.append(jnp.zeros(n, wire_j))
+            buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            total = plane.allreduce(
+                buf,
+                reduce_op,
+                pre,
+                post,
+                acc_dtype="float32"
+                if dtype_name in ("bfloat16", "float16")
+                else dtype_name,
+                exact_int_avg=bool(is_int and reduce_op == int(_R.AVERAGE)),
+            )
+            self.stats["device_data_ops"] += 1
+            self.stats["device_payload_bytes"] += (
+                int(total.size) * wire_dtype.itemsize
+            )
+            offset = 0
+            for e, shape in zip(entries, shapes):
+                n = int(np.prod(shape)) if shape else 1
+                if e is not None:
+                    out = total[offset : offset + n].reshape(shape)
+                    e.future.set_result(out.astype(e.tensor.dtype))
+                offset += n
+            return
         # Fused buffer: concat all entries (MemcpyInFusionBuffer analog,
         # collective_operations.cc:159-210).  A joined rank has no entry for
         # a tensor its peers are reducing and contributes zeros of the
@@ -620,21 +735,19 @@ class EagerEngine:
         if pre != 1.0:
             buf = (buf.astype(acc_dtype) * pre).astype(wire_dtype)
         gathered = self._data_allgather(buf)
-        from ..ops.collectives import ReduceOp  # noqa: PLC0415
-
-        if reduce_op == int(ReduceOp.ADASUM):
+        if reduce_op == int(_R.ADASUM):
             from ..ops.adasum import _numpy_adasum_rows  # noqa: PLC0415
 
             total = _numpy_adasum_rows(
                 gathered.astype(np.float64)
             ).astype(wire_dtype)
-        elif reduce_op == int(ReduceOp.MIN):
+        elif reduce_op == int(_R.MIN):
             total = gathered.astype(acc_dtype).min(axis=0)
-        elif reduce_op == int(ReduceOp.MAX):
+        elif reduce_op == int(_R.MAX):
             total = gathered.astype(acc_dtype).max(axis=0)
         else:
             total = gathered.astype(acc_dtype).sum(axis=0)
-            if reduce_op == int(ReduceOp.AVERAGE):
+            if reduce_op == int(_R.AVERAGE):
                 if is_int and not scaled:
                     total = total // self.world  # exact int semantics
                 else:
@@ -654,6 +767,28 @@ class EagerEngine:
         e = entries[0]
         sizes = resp.tensor_sizes
         max_d0 = max(sizes) if sizes else 0
+        if self._use_device(resp):
+            plane = self._plane()
+            tail = tuple(getattr(resp, "_shapes", [(0,)])[0][1:])
+            wire_j = jnp.dtype(_np_dtype(getattr(resp, "_dtype", "float32")))
+            if e is None or e.tensor is None:
+                local = jnp.zeros((0,) + tail, wire_j)
+            else:
+                local = jnp.asarray(e.tensor)
+            pad = max_d0 - local.shape[0]
+            if pad:
+                local = jnp.concatenate(
+                    [local, jnp.zeros((pad,) + tuple(local.shape[1:]),
+                                      local.dtype)]
+                )
+            gathered = plane.allgather(local)
+            self.stats["device_data_ops"] += 1
+            self.stats["device_payload_bytes"] += int(gathered.nbytes)
+            if e is None:
+                return
+            pieces = [gathered[r, : sizes[r]] for r in range(self.world)]
+            e.future.set_result(jnp.concatenate(pieces, axis=0))
+            return
         if e is None or e.tensor is None:
             # joined rank: participate with an all-pad buffer (its size
             # was negotiated as 0, so no rows of it survive the slicing)
@@ -677,9 +812,27 @@ class EagerEngine:
 
     def _execute_broadcast(self, resp: Response, entries) -> None:
         e = entries[0]
+        shape = tuple(getattr(resp, "_shapes", [()])[0])
+        wire_name = getattr(resp, "_dtype", "float32")
+        if self._use_device(resp):
+            plane = self._plane()
+            root = (
+                e.request.root_rank
+                if e is not None
+                else getattr(resp, "_root_rank", 0)
+            )
+            if e is None or e.tensor is None:
+                local = jnp.zeros(shape, jnp.dtype(_np_dtype(wire_name)))
+            else:
+                local = jnp.asarray(e.tensor)
+            out = plane.broadcast(local, int(root))
+            self.stats["device_data_ops"] += 1
+            self.stats["device_payload_bytes"] += int(out.nbytes)
+            if e is not None:
+                e.future.set_result(out)
+            return
         if e is None or e.tensor is None:
-            shape = tuple(getattr(resp, "_shapes", [()])[0])
-            local = np.zeros(shape, _np_dtype(getattr(resp, "_dtype", "float32")))
+            local = np.zeros(shape, _np_dtype(wire_name))
             self._data_allgather(local)  # participate; result unused
             return
         gathered = self._data_allgather(np.asarray(e.tensor))
@@ -687,8 +840,29 @@ class EagerEngine:
 
     def _execute_alltoall(self, resp: Response, entries) -> None:
         e = entries[0]
+        shape = tuple(getattr(resp, "_shapes", [()])[0])
+        # Even-split device path; the shape is negotiated-identical, so the
+        # divisibility test picks the same plane on every rank.
+        if (
+            shape
+            and shape[0] % self.world == 0
+            and self._use_device(resp)
+        ):
+            plane = self._plane()
+            if e is None or e.tensor is None:
+                local = jnp.zeros(
+                    shape,
+                    jnp.dtype(_np_dtype(getattr(resp, "_dtype", "float32"))),
+                )
+            else:
+                local = jnp.asarray(e.tensor)
+            out = plane.alltoall(local)
+            self.stats["device_data_ops"] += 1
+            self.stats["device_payload_bytes"] += int(out.nbytes)
+            if e is not None:
+                e.future.set_result(out)
+            return
         if e is None or e.tensor is None:
-            shape = tuple(getattr(resp, "_shapes", [()])[0])
             local = np.zeros(shape, _np_dtype(getattr(resp, "_dtype", "float32")))
             self._data_allgather(local)
             return
@@ -717,6 +891,40 @@ class EagerEngine:
         )
         wire_dtype = _np_dtype(dtype_name)
         shape = tuple(getattr(resp, "_shapes", [(0,)])[0])
+        from ..ops.collectives import ReduceOp as _R  # noqa: PLC0415
+
+        # Even-split device path (psum_scatter); uneven dim0 falls back to
+        # the host plane's extra-row convention.  16-bit floats accumulate
+        # f32, ints are excluded (uneven exactness) — all negotiated fields.
+        is_float = wire_dtype.kind == "f" or dtype_name in (
+            "bfloat16", "float16"
+        )
+        if (
+            bool(shape)
+            and shape[0] % self.world == 0
+            and is_float
+            and self._use_device(resp)
+        ):
+            plane = self._plane()
+            wire_j = jnp.dtype(wire_dtype)
+            if e is None or e.tensor is None:
+                local = jnp.zeros(shape, wire_j)
+            else:
+                local = jnp.asarray(e.tensor).astype(wire_j)
+            out = plane.reducescatter(
+                local,
+                average=reduce_op == int(_R.AVERAGE),
+                pre=pre,
+                post=post,
+                acc_dtype="float32"
+                if dtype_name in ("bfloat16", "float16")
+                else dtype_name,
+            )
+            self.stats["device_data_ops"] += 1
+            self.stats["device_payload_bytes"] += int(local.nbytes)
+            if e is not None:
+                e.future.set_result(out.astype(e.tensor.dtype))
+            return
         if e is None or e.tensor is None:
             local = np.zeros(shape, wire_dtype)
         else:
@@ -749,11 +957,14 @@ class EagerEngine:
     # -------------------------------------------------------- single process
 
     def _execute_local(self, entry: TensorTableEntry) -> None:
-        """world==1: collectives are identities (with scaling applied)."""
+        """world==1: collectives are identities (with scaling applied).
+        Device arrays pass through untouched — the ultimate zero-copy."""
         req = entry.request
         t = entry.tensor
+        on_device = isinstance(t, jax.Array)
+        _as = (lambda x: x) if on_device else np.asarray
         if req.request_type in (RequestType.ALLREDUCE, RequestType.ADASUM):
-            out = np.asarray(t)
+            out = _as(t)
             scale = req.prescale_factor * req.postscale_factor
             if scale != 1.0:
                 out = out * scale
@@ -763,7 +974,7 @@ class EagerEngine:
             RequestType.ALLTOALL,
             RequestType.REDUCESCATTER,
         ):
-            entry.future.set_result(np.asarray(t))
+            entry.future.set_result(_as(t))
         elif req.request_type == RequestType.BROADCAST:
             if req.root_rank not in (0, -1):
                 entry.future.set_exception(
@@ -773,7 +984,7 @@ class EagerEngine:
                     )
                 )
             else:
-                entry.future.set_result(np.asarray(t))
+                entry.future.set_result(_as(t))
         elif req.request_type == RequestType.BARRIER:
             entry.future.set_result(None)
         else:

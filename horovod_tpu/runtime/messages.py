@@ -58,6 +58,12 @@ class Request:
     root_rank: int = -1  # BROADCAST only
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # Payload lives on an accelerator (reference Request::device,
+    # message.h:47-100): when every rank's request is device-resident the
+    # response executes on the XLA device data plane; any host payload
+    # demotes the whole op to the host plane.  Part of the negotiated
+    # signature so the plane choice is identical on all ranks.
+    device: bool = False
 
     def key(self) -> tuple:
         """Identity under negotiation (name + everything that must agree)."""
@@ -91,6 +97,7 @@ class RequestList:
                     r.root_rank,
                     r.prescale_factor,
                     r.postscale_factor,
+                    r.device,
                 )
                 for r in self.requests
             ],
@@ -116,8 +123,9 @@ class RequestList:
                     root_rank=g,
                     prescale_factor=h,
                     postscale_factor=i,
+                    device=j,
                 )
-                for (a, b, c, d, e, f, g, h, i) in reqs
+                for (a, b, c, d, e, f, g, h, i, j) in reqs
             ],
             shutdown=shutdown,
             joined=joined,

@@ -121,6 +121,11 @@ def _my_ip() -> str:
 class NativeEngine:
     """Eager engine backed by libhvdtpu.so (drop-in for EagerEngine)."""
 
+    # The TCP data plane moves host bytes; jax.Arrays are ingested as
+    # zero-copy dlpack views (ops/eager.py _ingest) and results committed
+    # back to the caller's device by synchronize().
+    accepts_device_arrays = False
+
     def __init__(self):
         topo = global_topology()
         self.rank = topo.process_rank

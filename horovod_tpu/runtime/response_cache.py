@@ -40,6 +40,12 @@ def request_signature(req: Request) -> tuple:
         req.root_rank,
         req.prescale_factor,
         req.postscale_factor,
+        # req.device deliberately EXCLUDED: residency may legitimately
+        # differ across ranks (host buffer on one, jax.Array on another),
+        # and a rank-varying field in the signature would make mixed
+        # submissions permanently thrash HIT/CONFLICT.  The executed plane
+        # is the NEGOTIATED one stored on the slot (_Slot.device), identical
+        # everywhere.
     )
 
 
@@ -66,6 +72,7 @@ class _Slot:
     root_rank: int
     fuse_meta: Optional[tuple]
     nbytes: int
+    device: bool = False
     lru_tick: int = 0
 
 
@@ -141,6 +148,7 @@ class ResponseCache:
             root_rank=req.root_rank,
             fuse_meta=getattr(resp, "_fuse_meta", None),
             nbytes=getattr(resp, "_nbytes", 0),
+            device=getattr(resp, "_device", False),
             lru_tick=self._tick,
         )
         self._by_name[req.tensor_name] = slot
@@ -156,6 +164,7 @@ class ResponseCache:
         if s.fuse_meta is not None:
             resp._fuse_meta = s.fuse_meta  # type: ignore[attr-defined]
         resp._nbytes = s.nbytes  # type: ignore[attr-defined]
+        resp._device = s.device  # type: ignore[attr-defined]
         return resp
 
     def name_for(self, slot: int) -> str:

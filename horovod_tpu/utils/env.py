@@ -23,6 +23,9 @@ HIERARCHICAL_ALLREDUCE = "HVDTPU_HIERARCHICAL_ALLREDUCE"
 AUTOTUNE = "HVDTPU_AUTOTUNE"
 AUTOTUNE_LOG = "HVDTPU_AUTOTUNE_LOG"
 LOG_LEVEL = "HVDTPU_LOG_LEVEL"
+# Device-resident eager data plane (no reference analog by name: the
+# reference's equivalent switch is compile-time HOROVOD_GPU_ALLREDUCE).
+EAGER_DEVICE = "HVDTPU_EAGER_DEVICE"
 
 
 def env_int(name: str, default: int) -> int:

@@ -24,8 +24,28 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("HVDTPU_TEST_MODE", "1")
 
+import shutil  # noqa: E402
+import subprocess  # noqa: E402
+from pathlib import Path  # noqa: E402
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# Build the native engine up front so its test coverage is real on a fresh
+# checkout: `make -C cpp` is incremental (no-op when the .so is current)
+# and the reference CI likewise bakes the build into every test image
+# (docker-compose.test.yml).  Without a toolchain the native-gated tests
+# skip with an explicit reason — but never silently on a buildable box.
+_repo = Path(__file__).resolve().parent.parent
+if shutil.which("make") and shutil.which("g++"):
+    _build = subprocess.run(
+        ["make", "-C", str(_repo / "cpp")], capture_output=True, text=True
+    )
+    if _build.returncode != 0:
+        raise RuntimeError(
+            "native engine build failed — fix cpp/ or remove the toolchain "
+            f"to run Python-engine-only:\n{_build.stdout}\n{_build.stderr}"
+        )
 
 # The container's sitecustomize imports jax at interpreter start with
 # JAX_PLATFORMS=axon already latched into jax.config; env edits above are too

@@ -89,3 +89,64 @@ def test_metric_average_eager():
 
     out = MetricAverageCallback()({"loss": np.float32(2.5)})
     np.testing.assert_allclose(out["loss"], 2.5)
+
+
+# ---------------------------------------------------------------------------
+# device-resident eager path (VERDICT r2 item 2)
+# ---------------------------------------------------------------------------
+
+
+def test_device_array_passthrough_no_copy():
+    """world==1: a jax.Array payload passes through the engine untouched —
+    device array in, THE SAME buffer out (zero copies, donation trivially
+    honored)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(6.0, dtype=jnp.float32)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert isinstance(out, jax.Array)
+    assert out.unsafe_buffer_pointer() == x.unsafe_buffer_pointer()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_device_array_broadcast_allgather_stay_on_device():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((3, 2), jnp.bfloat16)
+    for out in (
+        hvd.allreduce(x, op=hvd.Average),
+        hvd.synchronize(hvd.allgather_async(x)),
+        hvd.synchronize(hvd.broadcast_async(x, root_rank=0)),
+    ):
+        assert isinstance(out, jax.Array)
+        assert out.dtype == jnp.bfloat16
+
+
+def test_native_ingest_is_zero_copy_view():
+    """The native engine's TCP wire ingests CPU-backed jax.Arrays as dlpack
+    views sharing the buffer — no staging copy (the analog of the reference
+    registering framework buffers directly with the collective)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops.eager import _ingest
+
+    class _FakeNative:
+        accepts_device_arrays = False
+
+    x = jnp.arange(8.0, dtype=jnp.float32)
+    payload, dev = _ingest(_FakeNative(), x)
+    assert isinstance(payload, np.ndarray)
+    assert dev is not None
+    assert payload.__array_interface__["data"][0] == x.unsafe_buffer_pointer()
+
+
+def test_request_device_flag_marks_device_payloads():
+    import jax.numpy as jnp
+
+    from horovod_tpu.runtime.engine import _is_device_tensor
+
+    assert _is_device_tensor(jnp.ones(3))
+    assert not _is_device_tensor(np.ones(3))
+    assert not _is_device_tensor(None)
