@@ -287,10 +287,22 @@ class Engine {
     if (rank_ == 0) {
       std::vector<RequestList> lists(static_cast<size_t>(size_));
       lists[0] = std::move(my_list);
+      // Poll-multiplexed gather: one framed RequestList from every worker,
+      // consumed in arrival order — the coordinator's cycle cost does not
+      // serialize behind a slow worker (reference gathers with a single
+      // MPI_Gatherv, mpi_controller.cc:107-150).
+      std::vector<std::vector<uint8_t>> bufs(static_cast<size_t>(size_));
+      std::vector<int> workers;
+      workers.reserve(static_cast<size_t>(size_) - 1);
+      for (int r = 1; r < size_; r++) workers.push_back(r);
+      if (!mesh_.RecvMsgMulti(workers, &bufs).ok()) {
+        FailAll("negotiation transport failed (worker unreachable)");
+        return false;
+      }
       for (int r = 1; r < size_; r++) {
-        std::vector<uint8_t> buf;
-        if (!mesh_.RecvMsg(r, &buf).ok() ||
-            !ParseRequestList(buf.data(), buf.size(), &lists[r])) {
+        if (!ParseRequestList(bufs[static_cast<size_t>(r)].data(),
+                              bufs[static_cast<size_t>(r)].size(),
+                              &lists[r])) {
           FailAll("negotiation transport failed (worker unreachable)");
           return false;
         }

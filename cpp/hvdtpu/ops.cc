@@ -115,72 +115,89 @@ Status PairwiseAlltoall(TcpMesh* mesh, const void* send, void* recv,
 
 namespace {
 
-// Sequential binary-tree adasum over gathered rows — mirrors the Python
-// engine's _numpy_adasum_rows (ops/adasum.py) so both engines agree
-// bit-for-bit on the non-power-of-2 path.
-void TreeAdasum(std::vector<std::vector<double>>& rows, int lo, int hi,
-                std::vector<double>* out) {
-  if (hi - lo == 1) {
-    *out = rows[lo];
-    return;
-  }
-  int half = (hi - lo) / 2;
-  std::vector<double> a, b;
-  TreeAdasum(rows, lo, lo + half, &a);
-  TreeAdasum(rows, lo + half, hi, &b);
+// Wire codecs: the Adasum buffer travels point-to-point in its OWN dtype
+// (bf16/f16 at 2 B/elt — half the f32 bytes, a quarter of the old f64
+// wire), while dots/norms/coefficients accumulate in double, matching the
+// reference's fp16 kernels that widen only in registers
+// (adasum.h:101-120 DispatchComputeDotAndNormSqrds, ComputeDotAndNormSqrdsfp16).
+struct F32Codec {
+  using wire_t = float;
+  static double Load(wire_t v) { return v; }
+  static wire_t Store(double v) { return static_cast<float>(v); }
+};
+struct F64Codec {
+  using wire_t = double;
+  static double Load(wire_t v) { return v; }
+  static wire_t Store(double v) { return v; }
+};
+struct Bf16Codec {
+  using wire_t = uint16_t;
+  static double Load(wire_t v) { return Bf16ToF32(v); }
+  static wire_t Store(double v) { return F32ToBf16(static_cast<float>(v)); }
+};
+struct F16Codec {
+  using wire_t = uint16_t;
+  static double Load(wire_t v) { return F16ToF32(v); }
+  static wire_t Store(double v) { return F32ToF16(static_cast<float>(v)); }
+};
+
+// Pairwise full-vector combine, w as "A" and other as "B":
+// w = coefA * w + coefB * other, inner products in double (reference
+// adasum.h:239-263).
+template <typename C>
+void PairCombine(typename C::wire_t* w, const typename C::wire_t* other,
+                 int64_t count) {
   double dot = 0, na2 = 0, nb2 = 0;
-  for (size_t i = 0; i < a.size(); i++) {
-    dot += a[i] * b[i];
-    na2 += a[i] * a[i];
-    nb2 += b[i] * b[i];
+  for (int64_t i = 0; i < count; i++) {
+    double a = C::Load(w[i]);
+    double b = C::Load(other[i]);
+    dot += a * b;
+    na2 += a * a;
+    nb2 += b * b;
   }
-  double ac = 1.0 - dot / (2.0 * std::max(na2, 1e-30));
-  double bc = 1.0 - dot / (2.0 * std::max(nb2, 1e-30));
-  out->resize(a.size());
-  for (size_t i = 0; i < a.size(); i++) (*out)[i] = ac * a[i] + bc * b[i];
+  double ca = 1.0 - dot / (2.0 * std::max(na2, 1e-30));
+  double cb = 1.0 - dot / (2.0 * std::max(nb2, 1e-30));
+  for (int64_t i = 0; i < count; i++) {
+    w[i] = C::Store(ca * C::Load(w[i]) + cb * C::Load(other[i]));
+  }
 }
 
-}  // namespace
-
-Status AdasumAllreduce(TcpMesh* mesh, void* buf, int64_t count,
-                       DataType dtype) {
+// VHDD over the power-of-2 group {0..p-1} with a fold-in pre/post phase
+// for the extra ranks {p..n-1} (the standard VHDD extension; replaces the
+// old gather-to-rank-0 tree, which funneled all rows through one host).
+//
+// Grouping (mirrored by the Python engine's _numpy_adasum_rows so both
+// engines agree on non-power-of-2 worlds):
+//   pre:  extra rank p+j sends its vector to rank j, which pair-combines.
+//   core: VHDD (reference adasum.h:167-299) over ranks 0..p-1.
+//   post: rank j sends the finished vector back to extra p+j.
+template <typename C>
+Status AdasumImpl(TcpMesh* mesh, typename C::wire_t* w, int64_t count) {
+  using W = typename C::wire_t;
   int n = mesh->size(), rank = mesh->rank();
-  if (n == 1) return Status::OK();
-  std::vector<double> d(static_cast<size_t>(count));
-  ToDouble(dtype, buf, d.data(), static_cast<size_t>(count));
+  int p = 1;
+  while (p * 2 <= n) p *= 2;
+  int extras = n - p;
+  size_t nbytes = static_cast<size_t>(count) * sizeof(W);
 
-  bool pow2 = (n & (n - 1)) == 0;
-  if (!pow2) {
-    // Gather rows to rank 0, binary-tree combine, broadcast back.
-    if (rank == 0) {
-      std::vector<std::vector<double>> rows(static_cast<size_t>(n));
-      rows[0] = d;
-      for (int r = 1; r < n; r++) {
-        rows[r].resize(static_cast<size_t>(count));
-        Status s = mesh->RecvBytes(r, rows[r].data(), rows[r].size() * 8);
-        if (!s.ok()) return s;
-      }
-      std::vector<double> out;
-      TreeAdasum(rows, 0, n, &out);
-      d = out;
-    } else {
-      Status s = mesh->SendBytes(0, d.data(), d.size() * 8);
-      if (!s.ok()) return s;
-    }
-    Status s = TreeBroadcast(mesh, d.data(), count, DataType::FLOAT64, 0);
+  if (rank >= p) {  // extra: fold in, then receive the final result
+    int partner = rank - p;
+    Status s = mesh->SendBytes(partner, w, nbytes);
     if (!s.ok()) return s;
-    FromDouble(dtype, d.data(), buf, static_cast<size_t>(count));
-    return Status::OK();
+    return mesh->RecvBytes(partner, w, nbytes);
+  }
+  std::vector<W> other;
+  if (rank < extras) {  // fold-in target: absorb the extra's contribution
+    other.resize(static_cast<size_t>(count));
+    Status s = mesh->RecvBytes(p + rank, other.data(), nbytes);
+    if (!s.ok()) return s;
+    PairCombine<C>(w, other.data(), count);
   }
 
-  // VHDD (reference ops/adasum/adasum.h:167-299): log2(n) halving levels
-  // with partner rank^distance, per-level full-vector dots via a recursive-
-  // doubling sum over the 2*distance-rank block, then the mirror doubling
-  // phase to reassemble the full vector.
+  // --- VHDD halving phase over the p-group ---
   int64_t start = 0, len = count;
   std::vector<std::pair<int64_t, int64_t>> seg_stack;
-  std::vector<double> other;
-  for (int distance = 1; distance < n; distance <<= 1) {
+  for (int distance = 1; distance < p; distance <<= 1) {
     int partner = rank ^ distance;
     seg_stack.emplace_back(start, len);
     int64_t h = len / 2;
@@ -197,31 +214,32 @@ Status AdasumAllreduce(TcpMesh* mesh, void* buf, int64_t count,
       send_len = h;
     }
     other.resize(static_cast<size_t>(my_len));
-    Status s = mesh->SendRecv(partner, d.data() + send_off,
-                              static_cast<size_t>(send_len) * 8, partner,
-                              other.data(), static_cast<size_t>(my_len) * 8);
+    Status s = mesh->SendRecv(partner, w + send_off,
+                              static_cast<size_t>(send_len) * sizeof(W),
+                              partner, other.data(),
+                              static_cast<size_t>(my_len) * sizeof(W));
     if (!s.ok()) return s;
 
-    // Partial inner products on my piece.  Orient (normA, normB) by block:
-    // the lower block's subtree vector is "A" group-wide, so upper-block
-    // ranks swap their locals before the group sum (reference adasum.h
-    // does the same reorientation before SumAllreduceWithComm).
+    // Partial inner products on my piece, oriented so the lower block's
+    // subtree vector is "A" group-wide (reference adasum.h reorients
+    // before SumAllreduceWithComm).
     double dot = 0, mine2 = 0, theirs2 = 0;
     for (int64_t i = 0; i < my_len; i++) {
-      double a = d[static_cast<size_t>(my_start + i)];
-      double b = other[static_cast<size_t>(i)];
+      double a = C::Load(w[my_start + i]);
+      double b = C::Load(other[static_cast<size_t>(i)]);
       dot += a * b;
       mine2 += a * a;
       theirs2 += b * b;
     }
     bool lower = (rank & distance) == 0;
-    double triple[3] = {lower ? mine2 : theirs2, lower ? theirs2 : mine2, dot};
-    // Recursive-doubling sum across the 2*distance block (partners rank^bit
-    // all lie inside the block).
+    double triple[3] = {lower ? mine2 : theirs2, lower ? theirs2 : mine2,
+                        dot};
+    // Recursive-doubling sum across the 2*distance block.
     for (int bit = 1; bit < 2 * distance; bit <<= 1) {
-      int p = rank ^ bit;
+      int q = rank ^ bit;
       double in[3];
-      Status st = mesh->SendRecv(p, triple, sizeof(triple), p, in, sizeof(in));
+      Status st =
+          mesh->SendRecv(q, triple, sizeof(triple), q, in, sizeof(in));
       if (!st.ok()) return st;
       triple[0] += in[0];
       triple[1] += in[1];
@@ -235,18 +253,16 @@ Status AdasumAllreduce(TcpMesh* mesh, void* buf, int64_t count,
     double my_coef = lower ? coefA : coefB;
     double their_coef = lower ? coefB : coefA;
     for (int64_t i = 0; i < my_len; i++) {
-      d[static_cast<size_t>(my_start + i)] =
-          my_coef * d[static_cast<size_t>(my_start + i)] +
-          their_coef * other[static_cast<size_t>(i)];
+      w[my_start + i] =
+          C::Store(my_coef * C::Load(w[my_start + i]) +
+                   their_coef * C::Load(other[static_cast<size_t>(i)]));
     }
     start = my_start;
     len = my_len;
   }
 
-  // Distance-doubling reassembly (mirror of the halving, reference
-  // adasum.h second phase): exchange my combined piece with the level's
-  // partner to rebuild the parent segment.
-  for (int distance = n >> 1; distance >= 1; distance >>= 1) {
+  // --- distance-doubling reassembly (mirror of the halving) ---
+  for (int distance = p >> 1; distance >= 1; distance >>= 1) {
     int partner = rank ^ distance;
     auto [pstart, plen] = seg_stack.back();
     seg_stack.pop_back();
@@ -259,17 +275,46 @@ Status AdasumAllreduce(TcpMesh* mesh, void* buf, int64_t count,
       their_off = pstart;
       their_len = h;
     }
-    Status s = mesh->SendRecv(partner, d.data() + start,
-                              static_cast<size_t>(len) * 8, partner,
-                              d.data() + their_off,
-                              static_cast<size_t>(their_len) * 8);
+    Status s = mesh->SendRecv(partner, w + start,
+                              static_cast<size_t>(len) * sizeof(W), partner,
+                              w + their_off,
+                              static_cast<size_t>(their_len) * sizeof(W));
     if (!s.ok()) return s;
     start = pstart;
     len = plen;
   }
 
-  FromDouble(dtype, d.data(), buf, static_cast<size_t>(count));
+  if (rank < extras) {  // hand the result back to the folded-in extra
+    return mesh->SendBytes(p + rank, w, nbytes);
+  }
   return Status::OK();
+}
+
+}  // namespace
+
+Status AdasumAllreduce(TcpMesh* mesh, void* buf, int64_t count,
+                       DataType dtype) {
+  if (mesh->size() == 1 || count == 0) return Status::OK();
+  switch (dtype) {
+    case DataType::FLOAT32:
+      return AdasumImpl<F32Codec>(mesh, static_cast<float*>(buf), count);
+    case DataType::FLOAT64:
+      return AdasumImpl<F64Codec>(mesh, static_cast<double*>(buf), count);
+    case DataType::BFLOAT16:
+      return AdasumImpl<Bf16Codec>(mesh, static_cast<uint16_t*>(buf), count);
+    case DataType::FLOAT16:
+      return AdasumImpl<F16Codec>(mesh, static_cast<uint16_t*>(buf), count);
+    default: {
+      // Exotic dtypes (ints): widen to a double scratch vector, run the
+      // same distributed scheme, narrow back.  Correctness path only.
+      std::vector<double> d(static_cast<size_t>(count));
+      ToDouble(dtype, buf, d.data(), static_cast<size_t>(count));
+      Status s = AdasumImpl<F64Codec>(mesh, d.data(), count);
+      if (!s.ok()) return s;
+      FromDouble(dtype, d.data(), buf, static_cast<size_t>(count));
+      return s;
+    }
+  }
 }
 
 }  // namespace hvdtpu

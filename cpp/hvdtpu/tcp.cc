@@ -175,6 +175,84 @@ Status TcpMesh::RecvMsg(int from, std::vector<uint8_t>* out) {
   return RecvAll(fds_[from], out->data(), hdr);
 }
 
+Status TcpMesh::RecvMsgMulti(const std::vector<int>& peers,
+                             std::vector<std::vector<uint8_t>>* out) {
+  // Per-peer incremental framing state; bytes are consumed from whichever
+  // socket poll() reports readable, so one slow worker never serializes
+  // the others behind it.
+  struct PeerState {
+    int peer = -1;
+    uint64_t hdr = 0;
+    size_t hdr_got = 0;   // bytes of the 8-byte length header received
+    size_t body_got = 0;  // bytes of the payload received
+    bool done = false;
+  };
+  std::vector<PeerState> states(peers.size());
+  for (size_t i = 0; i < peers.size(); i++) states[i].peer = peers[i];
+  size_t remaining = peers.size();
+
+  std::vector<pollfd> pfds(peers.size());
+  while (remaining > 0) {
+    size_t n = 0;
+    for (auto& st : states) {
+      if (st.done) continue;
+      pfds[n].fd = fds_[st.peer];
+      pfds[n].events = POLLIN;
+      pfds[n].revents = 0;
+      n++;
+    }
+    int r = poll(pfds.data(), static_cast<nfds_t>(n),
+                 kConnectTimeoutSec * 1000);
+    if (r == 0)
+      return Status::Error(StatusCode::UNKNOWN_ERROR,
+                           "negotiation recv timed out");
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return Errno("poll");
+    }
+    size_t pi = 0;
+    for (auto& st : states) {
+      if (st.done) continue;
+      const pollfd& p = pfds[pi++];
+      if (!(p.revents & (POLLIN | POLLERR | POLLHUP))) continue;
+      // One read per readiness event; partial reads park the state until
+      // the socket is ready again.
+      if (st.hdr_got < sizeof(st.hdr)) {
+        ssize_t k = read(p.fd,
+                         reinterpret_cast<uint8_t*>(&st.hdr) + st.hdr_got,
+                         sizeof(st.hdr) - st.hdr_got);
+        if (k == 0)
+          return Status::Error(StatusCode::ABORTED, "peer closed connection");
+        if (k < 0) return Errno("read (negotiation header)");
+        st.hdr_got += static_cast<size_t>(k);
+        if (st.hdr_got == sizeof(st.hdr)) {
+          if (st.hdr > (1ull << 34))
+            return Status::Error(StatusCode::UNKNOWN_ERROR,
+                                 "oversized message");
+          (*out)[static_cast<size_t>(st.peer)].resize(st.hdr);
+          if (st.hdr == 0) {
+            st.done = true;
+            remaining--;
+          }
+        }
+        continue;
+      }
+      auto& buf = (*out)[static_cast<size_t>(st.peer)];
+      ssize_t k = read(p.fd, buf.data() + st.body_got,
+                       buf.size() - st.body_got);
+      if (k == 0)
+        return Status::Error(StatusCode::ABORTED, "peer closed connection");
+      if (k < 0) return Errno("read (negotiation payload)");
+      st.body_got += static_cast<size_t>(k);
+      if (st.body_got == buf.size()) {
+        st.done = true;
+        remaining--;
+      }
+    }
+  }
+  return Status::OK();
+}
+
 Status TcpMesh::SendBytes(int to, const void* data, size_t len) {
   return SendAll(fds_[to], data, len);
 }

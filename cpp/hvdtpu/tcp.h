@@ -39,6 +39,16 @@ class TcpMesh {
   Status SendMsg(int to, const uint8_t* data, size_t len);
   Status RecvMsg(int from, std::vector<uint8_t>* out);
 
+  // Poll-multiplexed receive of ONE framed message from EACH listed peer,
+  // consuming bytes from whichever socket is ready (reference contrast:
+  // MPIController gathers all workers' requests in one MPI_Gatherv,
+  // mpi_controller.cc:107-150 — a serial per-worker blocking recv loop
+  // would make the coordinator's cycle time linear in world size when any
+  // worker is slow).  out->at(peer) receives that peer's payload; entries
+  // for ranks not in `peers` are left untouched.
+  Status RecvMsgMulti(const std::vector<int>& peers,
+                      std::vector<std::vector<uint8_t>>* out);
+
   // Raw byte transfer (data plane; no frame header).
   Status SendBytes(int to, const void* data, size_t len);
   Status RecvBytes(int from, void* data, size_t len);

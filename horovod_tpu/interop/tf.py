@@ -68,7 +68,10 @@ __all__ = [
 # tensor conversion + compression
 # ---------------------------------------------------------------------------
 
-_WIRE_UPCAST = (tf.bfloat16, tf.float16)  # engine wire is f32 for halves
+# Halves ride the wire natively: the engines are dtype-native (bf16/f16 at
+# 2 B/elt with f32 accumulation — the analog of the reference's custom fp16
+# MPI op, half.cc:42-78), and TF's .numpy() yields ml_dtypes arrays the
+# engines accept directly, so Compression.fp16 actually halves wire bytes.
 
 
 class Compression:
@@ -104,20 +107,31 @@ class Compression:
 # core collectives (graph-safe via py_function, custom gradients)
 # ---------------------------------------------------------------------------
 
-def _run_collective(fn, tensor: tf.Tensor, out_dtype=None) -> tf.Tensor:
-    """Run ``fn(np_array) -> np_array`` as a graph-safe op.  Shapes are
-    restored by the caller (py_function erases static shape info)."""
+def _run_collective(fn, tensor: tf.Tensor, out_dtype=None,
+                    preserve_shape: bool = True) -> tf.Tensor:
+    """Run ``fn(np_array) -> np_array`` as a graph-safe op in the tensor's
+    own dtype (halves stay halves on the wire).  Static shapes are restored
+    by the caller (py_function erases them); ``preserve_shape`` puts the
+    ELEMENT shape right at runtime — the host data plane flattens 0-d
+    scalars to shape (1,) (np.ascontiguousarray quirk; the torch frontend
+    reshapes via its `like` tensor, _from_np).  Allgather passes False:
+    its dim 0 legitimately changes."""
     in_dtype = tensor.dtype
-    wire_dtype = tf.float32 if in_dtype in _WIRE_UPCAST else in_dtype
     out_dtype = out_dtype or in_dtype
 
     def _impl(x):
-        out = fn(x.numpy())
-        return tf.convert_to_tensor(np.asarray(out))
+        xnp = x.numpy()
+        out = np.asarray(fn(xnp))
+        if (
+            preserve_shape
+            and out.shape != np.shape(xnp)
+            and out.size == np.size(xnp)
+        ):
+            out = out.reshape(np.shape(xnp))
+        return tf.convert_to_tensor(out)
 
-    cast_in = tf.cast(tensor, wire_dtype) if in_dtype != wire_dtype else tensor
-    result = tf.py_function(_impl, [cast_in], Tout=wire_dtype)
-    if out_dtype != wire_dtype:
+    result = tf.py_function(_impl, [tensor], Tout=in_dtype)
+    if out_dtype != in_dtype:
         result = tf.cast(result, out_dtype)
     return result
 
@@ -162,7 +176,8 @@ def allgather(tensor, name: Optional[str] = None):
     @tf.custom_gradient
     def _fn(x):
         y = _run_collective(
-            lambda v: eager.allgather(v, name=name), x
+            lambda v: eager.allgather(v, name=name), x,
+            preserve_shape=False,
         )
         y.set_shape([None] + list(x.shape[1:]))
         # Dynamic shape op, not the static x.shape[0]: under tf.function
@@ -377,15 +392,17 @@ if _LegacyOptimizer is not None:
             return self._optimizer.variables(*args, **kwargs)
 
 
-def _wrap_keras_optimizer(optimizer, compression, sparse_as_dense, op):
-    """Keras optimizer wrapper: allreduce inside apply_gradients
-    (reference _keras/__init__.py:20-87 overrides gradient aggregation;
-    modern Keras makes apply_gradients the one stable seam)."""
+def _make_distributed_keras_class(base_cls, compression=Compression.none,
+                                  sparse_as_dense=False, op=Average):
+    """Build the ``Distributed<Base>`` Keras optimizer class: allreduce
+    inside apply_gradients (reference _keras/__init__.py:20-87 overrides
+    gradient aggregation; modern Keras makes apply_gradients the one
+    stable seam).  Also used by tf_keras.load_model as the
+    ``custom_objects`` entry that deserializes saved wrapped optimizers
+    (reference _keras/__init__.py:113-128)."""
     allreduce_grads = _make_allreduce_grads_fn(
         "DistributedKeras", compression, sparse_as_dense, op
     )
-
-    base_cls = optimizer.__class__
 
     class _DistributedKerasOptimizer(base_cls):
         _hvd_wrapped = True
@@ -400,7 +417,14 @@ def _wrap_keras_optimizer(optimizer, compression, sparse_as_dense, op):
             return super().apply_gradients(grads_and_vars, *args, **kwargs)
 
     _DistributedKerasOptimizer.__name__ = f"Distributed{base_cls.__name__}"
-    return _DistributedKerasOptimizer.from_config(optimizer.get_config())
+    return _DistributedKerasOptimizer
+
+
+def _wrap_keras_optimizer(optimizer, compression, sparse_as_dense, op):
+    cls = _make_distributed_keras_class(
+        optimizer.__class__, compression, sparse_as_dense, op
+    )
+    return cls.from_config(optimizer.get_config())
 
 
 def DistributedOptimizer(optimizer, name=None, use_locking=False,

@@ -76,19 +76,34 @@ def _check_cpu(t: torch.Tensor) -> None:
 
 
 def _to_np(t: torch.Tensor) -> np.ndarray:
-    """Zero-copy when possible; bf16/f16 upcast to f32 for the wire (the
-    reference registers a custom fp16 MPI op instead, half.cc:42-78)."""
+    """Zero-copy handoff, halves included: bf16 is reinterpreted through a
+    uint16 view into an ml_dtypes array (numpy has no native bf16), f16 maps
+    to np.float16 directly.  The engines are dtype-native — halves cost
+    2 B/elt on the wire and accumulate in f32, the analog of the reference's
+    custom fp16 MPI op (half.cc:42-78) — so no f32 upcast happens anywhere."""
     _check_cpu(t)
     t = t.detach()
-    if t.dtype in (torch.bfloat16, torch.float16):
-        t = t.float()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes  # noqa: PLC0415
+
+        return t.contiguous().view(torch.uint16).numpy().view(
+            ml_dtypes.bfloat16
+        )
     return t.numpy()
 
 
 def _from_np(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
-    out = torch.from_numpy(np.ascontiguousarray(a))
-    if like.dtype in (torch.bfloat16, torch.float16):
-        out = out.to(like.dtype)
+    a = np.ascontiguousarray(a)
+    if like.dtype == torch.bfloat16:
+        import ml_dtypes  # noqa: PLC0415
+
+        if a.dtype != np.dtype(ml_dtypes.bfloat16):
+            a = a.astype(ml_dtypes.bfloat16)
+        out = torch.from_numpy(a.view(np.uint16)).view(torch.bfloat16)
+    else:
+        out = torch.from_numpy(a)
+        if out.dtype != like.dtype:
+            out = out.to(like.dtype)
     if out.shape != like.shape and out.numel() == like.numel():
         # the engine's data plane flattens 0-d scalars to shape (1,)
         out = out.reshape(like.shape)

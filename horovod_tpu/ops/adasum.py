@@ -36,21 +36,38 @@ __all__ = ["adasum_allreduce", "adasum_combine"]
 
 def _numpy_adasum_rows(rows):
     """Host-side recursive adasum of ``rows[i]`` = rank i's flat buffer —
-    the eager engine's reduction kernel (same binary tree as adasum.h:167-299).
+    the eager engine's reduction kernel.
+
+    Combination order mirrors the native engine's distributed scheme
+    (cpp/hvdtpu/ops.cc AdasumImpl): for non-power-of-2 worlds, extra rank
+    ``p + j`` (p = largest power of 2 <= n) folds into rank ``j`` first,
+    then the balanced VHDD binary tree (reference adasum.h:167-299) runs
+    over the p-group — so both engines agree bit-for-bit at any world size.
     """
     import numpy as np
 
     vecs = [np.asarray(r, np.float64) for r in rows]
 
-    def rec(vs):
-        if len(vs) == 1:
-            return vs[0]
-        half = len(vs) // 2
-        a, b = rec(vs[:half]), rec(vs[half:])
+    def combine(a, b):
         dot = float(np.dot(a, b))
         na2 = max(float(np.dot(a, a)), 1e-30)
         nb2 = max(float(np.dot(b, b)), 1e-30)
         return (1.0 - dot / (2 * na2)) * a + (1.0 - dot / (2 * nb2)) * b
+
+    p = 1
+    while p * 2 <= len(vecs):
+        p *= 2
+    extras = len(vecs) - p
+    vecs = [
+        combine(vecs[j], vecs[p + j]) if j < extras else vecs[j]
+        for j in range(p)
+    ]
+
+    def rec(vs):
+        if len(vs) == 1:
+            return vs[0]
+        half = len(vs) // 2
+        return combine(rec(vs[:half]), rec(vs[half:]))
 
     return rec(vecs)
 

@@ -55,6 +55,35 @@ def _auto_name(prefix: str) -> str:
     return f"{prefix}.noname.{_name_counter}"
 
 
+def _uncommit(x):
+    """Rebuild a single-device jax.Array WITHOUT device commitment.
+
+    Collective results built by the device plane are committed to their
+    device; a caller that passed an UNCOMMITTED array (the normal state of
+    model.init output) must get an uncommitted array back, or feeding the
+    result into a jit over a multi-device mesh fails with "incompatible
+    devices" — the exact broadcast_parameters -> jit train-step flow.
+    Uses the ArrayImpl constructor (stable across the pinned jax version);
+    falls back to one host round-trip if the internals move."""
+    if not isinstance(x, jax.Array) or not getattr(x, "_committed", False):
+        return x
+    try:
+        from jax._src.array import ArrayImpl  # noqa: PLC0415
+
+        shards = x.addressable_shards
+        if len(shards) != 1:
+            return x
+        buf = shards[0].data
+        return ArrayImpl(
+            x.aval,
+            jax.sharding.SingleDeviceSharding(next(iter(x.devices()))),
+            [buf if buf is not x else x],
+            committed=False,
+        )
+    except Exception:
+        return jax.device_put(np.asarray(x))
+
+
 def _ingest(engine, tensor):
     """Hand a payload to the engine without gratuitous copies.
 
@@ -81,12 +110,13 @@ def _ingest(engine, tensor):
         except Exception:  # deleted/donated
             devices = set()
         dev = next(iter(devices)) if len(devices) == 1 else None
+        committed = bool(getattr(tensor, "_committed", True))
         if getattr(engine, "accepts_device_arrays", False) and dev is not None:
-            return tensor, dev
+            return tensor, (dev, committed)
         try:
-            return np.from_dlpack(tensor), dev
+            return np.from_dlpack(tensor), (dev, committed)
         except Exception:  # non-host backing (TPU): one explicit transfer
-            return np.asarray(tensor), dev
+            return np.asarray(tensor), (dev, committed)
     return np.asarray(tensor), None
 
 
@@ -231,18 +261,33 @@ def synchronize(handle: concurrent.futures.Future):
     torch/mpi_ops.py:475-491; raises the negotiated error on mismatch,
     like the reference's ErrorOp -> exception path).
 
-    Device-resident callers get a committed ``jax.Array`` back on the
-    device their input lived on: device-plane results arrive as device
-    arrays already; host-plane results (native engine's TCP wire, ADASUM)
-    are placed back with one H2D transfer."""
+    Device-resident callers get a ``jax.Array`` back on the device their
+    input lived on, with the input's commitment preserved: device-plane
+    results arrive as device arrays already; host-plane results (native
+    engine's TCP wire, ADASUM) are placed back with one H2D transfer.  An
+    uncommitted input (model.init's normal state) yields an uncommitted
+    result so it flows into any downstream jit/mesh placement."""
     result = handle.result()
-    dev = getattr(handle, "_hvdtpu_device", None)
-    if (
-        dev is not None
-        and result is not None
-        and not isinstance(result, jax.Array)
-    ):
-        result = jax.device_put(result, dev)
+    tag = getattr(handle, "_hvdtpu_device", None)
+    if tag is None or result is None:
+        return result
+    dev, committed = tag
+    if not isinstance(result, jax.Array):
+        result = (
+            jax.device_put(result, dev) if committed and dev is not None
+            else jax.device_put(result)
+        )
+    elif committed and dev is not None:
+        # Device-plane results live on the plane's device (the lowest-id
+        # local device); a caller committed elsewhere gets its result moved
+        # back — "on the device their input lived on", literally.
+        try:
+            if next(iter(result.devices())) != dev:
+                result = jax.device_put(result, dev)
+        except Exception:
+            pass
+    if not committed:
+        result = _uncommit(result)
     return result
 
 

@@ -89,6 +89,14 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
+# Dtypes the staged-XLA host path can carry exactly.  64-bit types are
+# excluded (jax without x64 truncates them; they ride the raw-bytes gather),
+# bool rides the gather too (psum over bool is undefined).
+_STAGEABLE_DTYPES = frozenset(
+    {"float32", "float16", "bfloat16", "int32", "int8", "uint8"}
+)
+
+
 def _is_device_tensor(tensor) -> bool:
     """Single-device jax.Array: the payload kind the device data plane can
     carry without a host round-trip.  Sharded arrays and host buffers take
@@ -156,6 +164,10 @@ class EagerEngine:
         self._cache = rcache.ResponseCache(
             envmod.env_int(envmod.CACHE_CAPACITY, 1024)
         )
+        # Live cache toggle (reference parameter_manager.h cache_enabled):
+        # flipped by tuned params, which apply on the same cycle boundary on
+        # every rank, so arming stays coherent.
+        self.cache_enabled = True
         self._armed: Dict[int, Request] = {}
         self._armed_since: Dict[int, float] = {}
         self._last_armed_stall_check = time.monotonic()
@@ -170,6 +182,10 @@ class EagerEngine:
             "cached_responses": 0,  # ops executed straight from cache votes
             "negotiated_responses": 0,  # ops through full negotiation
             "host_data_ops": 0,  # responses executed on the host data plane
+            "host_wire_bytes": 0,  # local payload bytes shipped per gather
+            "host_recv_bytes": 0,  # bytes received: O(world x bytes) for
+            # raw gathers, O(bytes) for staged XLA reduces
+            "host_staged_ops": 0,  # host payloads reduced via staged psum
             "device_data_ops": 0,  # responses executed as XLA collectives
             "device_payload_bytes": 0,  # bytes that stayed device-resident
         }
@@ -180,10 +196,18 @@ class EagerEngine:
         # NCCL device path, operations.cc:266-291).  The kill switch gates
         # *enqueue* (Request.device=False), so disabling it on any rank
         # demotes the op globally through negotiation instead of desyncing
-        # the planes.  Built lazily on the first device response.
+        # the planes.  Built at engine start; every cycle's control vector
+        # carries a "no plane" bit, so plane selection for BOTH the
+        # negotiated-device path and the staged host path is a function of
+        # data all ranks share — a rank whose plane failed to build demotes
+        # the whole job to the host gather instead of desyncing collectives.
         self._device_enabled = envmod.env_bool(envmod.EAGER_DEVICE, default=True)
         self._device_plane = None
-        self._device_plane_tried = False
+        if self.world > 1 and self._device_enabled:
+            from . import device_plane  # noqa: PLC0415
+
+            self._device_plane = device_plane.build_plane()
+        self._plane_ok_all = self._device_plane is not None
 
         # Autotuner (reference parameter_manager.cc): rank 0 scores
         # bytes/sec per sample window and proposes new params; peers apply
@@ -199,12 +223,14 @@ class EagerEngine:
                     fusion_bytes=self.fusion_bytes, cycle_s=self.cycle_s
                 ),
                 log_path=os.environ.get(envmod.AUTOTUNE_LOG) or None,
-                # This engine consumes only the continuous knobs (fusion
-                # threshold, cycle time) — see _apply_params.  The cache /
-                # hierarchical categorical axes belong to engines with those
-                # code paths; listing them here would burn tuning budget on
-                # configurations that don't exist.
-                categories=[{}],
+                # Continuous knobs (fusion, cycle) plus the response-cache
+                # toggle — a real code path in this engine (the bit-vote
+                # fast path).  Hierarchical stays out: it is not a python-
+                # data-plane knob.
+                categories=[
+                    {"cache_enabled": True, "hierarchical_allreduce": False},
+                    {"cache_enabled": False, "hierarchical_allreduce": False},
+                ],
             )
 
     # ------------------------------------------------------------------ API
@@ -352,7 +378,11 @@ class EagerEngine:
         now = time.monotonic()
         misses: List[Request] = []
         for req in requests:
-            status, slot = self._cache.lookup(req)
+            status, slot = (
+                self._cache.lookup(req)
+                if self.cache_enabled
+                else (rcache.MISS, -1)
+            )
             if status == rcache.HIT:
                 self._armed[slot] = req
                 self._armed_since[slot] = now
@@ -496,6 +526,7 @@ class EagerEngine:
         controller.cc:33-47)."""
         self.fusion_bytes = p.fusion_bytes
         self.cycle_s = p.cycle_s
+        self.cache_enabled = p.cache_enabled
 
     # ---------------------------------------------------------- negotiation
 
@@ -518,6 +549,7 @@ class EagerEngine:
             (1 if shutdown else 0)
             | (2 if joined else 0)
             | (4 if payload else 0)
+            | (8 if self._device_plane is None else 0)  # "no device plane"
         )
         vec[1:5] = np.frombuffer(
             np.uint32(len(payload)).tobytes(), np.uint8
@@ -532,6 +564,10 @@ class EagerEngine:
         flags = gathered[:, 0]
         shutdown_ranks = {r for r in range(self.world) if flags[r] & 1}
         joined_ranks = {r for r in range(self.world) if flags[r] & 2}
+        # Plane coherence: the device/staged data planes are used only when
+        # EVERY rank has one — evaluated from this same gathered vector, so
+        # the decision is identical everywhere this cycle.
+        self._plane_ok_all = not bool((flags & 8).any())
         bits = gathered[:, 5:]
         if not bool((flags & 4).any()):
             return shutdown_ranks, joined_ranks, bits, None
@@ -608,30 +644,20 @@ class EagerEngine:
     # ------------------------------------------------------ device data plane
 
     def _plane(self):
-        """Lazily build the XLA device data plane (device_plane.py)."""
-        if not self._device_plane_tried:
-            self._device_plane_tried = True
-            from . import device_plane  # noqa: PLC0415
-
-            self._device_plane = device_plane.build_plane()
         return self._device_plane
 
     def _use_device(self, resp: Response) -> bool:
-        """Negotiated plane for this response — identical on all ranks
-        (controller sets _device = AND of every rank's Request.device).  A
-        negotiated-device response with no usable local plane raises: a
-        silent local demotion would execute a host collective while peers
-        run the device one, deadlocking the job."""
-        if not getattr(resp, "_device", False):
-            return False
-        if self._plane() is None:
-            raise RuntimeError(
-                "response negotiated for the device data plane but this "
-                "rank could not build one (see device_plane log); set "
-                f"{envmod.EAGER_DEVICE}=0 on ALL ranks to force the host "
-                "plane"
-            )
-        return True
+        """Negotiated plane for this response — identical on all ranks:
+        the controller sets _device = AND of every rank's Request.device,
+        and _plane_ok_all is computed from the SAME cycle's gathered
+        control flags, so no rank can demote to the host plane while a
+        peer runs the device collective."""
+        return bool(getattr(resp, "_device", False)) and self._plane_ok_all
+
+    def _use_staged(self) -> bool:
+        """Whether host payloads may reduce via the staged XLA plane —
+        like _use_device, a function of data every rank shares."""
+        return self._plane_ok_all
 
     def _data_allgather(self, local: np.ndarray) -> np.ndarray:
         """Data-plane allgather over processes -> (world, *local.shape).
@@ -644,6 +670,8 @@ class EagerEngine:
 
         self.stats["host_data_ops"] += 1
         local = np.ascontiguousarray(local)
+        self.stats["host_wire_bytes"] += int(local.nbytes)
+        self.stats["host_recv_bytes"] += int(local.nbytes) * self.world
         raw = local.reshape(-1).view(np.uint8)
         out = multihost_utils.process_allgather(raw)
         flat = np.asarray(out).reshape(self.world, raw.size)
@@ -732,6 +760,41 @@ class EagerEngine:
                 n = int(np.prod(shape)) if shape else 1
                 flats.append(np.zeros(n, wire_dtype))
         buf = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        # Host payloads of device-native dtypes reduce as a STAGED XLA
+        # collective: one H2D, a real O(bytes) reduce over the plane's
+        # gloo/ICI ring, one D2H — instead of the O(world x bytes)
+        # gather-everything fallback (reference's GlooAllreduce ring,
+        # gloo_operations.cc:107-142).  64-bit dtypes stay on the exact
+        # raw-bytes gather (jax without x64 would truncate them).
+        if (
+            reduce_op != int(_R.ADASUM)
+            and not (scaled and is_int)
+            and dtype_name in _STAGEABLE_DTYPES
+            and self._use_staged()
+        ):
+            plane = self._plane()
+            total_dev = plane.allreduce(
+                jnp.asarray(buf),
+                reduce_op,
+                pre,
+                post,
+                acc_dtype="float32"
+                if dtype_name in ("bfloat16", "float16")
+                else dtype_name,
+                exact_int_avg=bool(is_int and reduce_op == int(_R.AVERAGE)),
+            )
+            total = np.asarray(total_dev)
+            self.stats["host_staged_ops"] += 1
+            self.stats["host_wire_bytes"] += int(buf.nbytes)
+            self.stats["host_recv_bytes"] += int(buf.nbytes)
+            offset = 0
+            for e, shape in zip(entries, shapes):
+                n = int(np.prod(shape)) if shape else 1
+                if e is not None:
+                    out = total[offset : offset + n].reshape(shape)
+                    e.future.set_result(out.astype(e.tensor.dtype))
+                offset += n
+            return
         if pre != 1.0:
             buf = (buf.astype(acc_dtype) * pre).astype(wire_dtype)
         gathered = self._data_allgather(buf)
@@ -830,6 +893,26 @@ class EagerEngine:
             self.stats["device_payload_bytes"] += int(out.nbytes)
             if e is not None:
                 e.future.set_result(out)
+            return
+        # Staged host broadcast: O(bytes) masked psum instead of gathering
+        # every rank's buffer to deliver one root's tensor.
+        if wire_name in _STAGEABLE_DTYPES and self._use_staged():
+            plane = self._plane()
+            root = (
+                e.request.root_rank
+                if e is not None
+                else getattr(resp, "_root_rank", 0)
+            )
+            if e is None or e.tensor is None:
+                local = np.zeros(shape, _np_dtype(wire_name))
+            else:
+                local = np.asarray(e.tensor)
+            out = np.asarray(plane.broadcast(jnp.asarray(local), int(root)))
+            self.stats["host_staged_ops"] += 1
+            self.stats["host_wire_bytes"] += int(local.nbytes)
+            self.stats["host_recv_bytes"] += int(local.nbytes)
+            if e is not None:
+                e.future.set_result(out.astype(local.dtype))
             return
         if e is None or e.tensor is None:
             local = np.zeros(shape, _np_dtype(wire_name))

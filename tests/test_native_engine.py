@@ -75,18 +75,10 @@ def _four_rank_fn():
     return out
 
 
-def _numpy_adasum(rows):
-    def rec(vs):
-        if len(vs) == 1:
-            return vs[0]
-        half = len(vs) // 2
-        a, b = rec(vs[:half]), rec(vs[half:])
-        dot = float(np.dot(a, b))
-        na2 = max(float(np.dot(a, a)), 1e-30)
-        nb2 = max(float(np.dot(b, b)), 1e-30)
-        return (1 - dot / (2 * na2)) * a + (1 - dot / (2 * nb2)) * b
-
-    return rec([np.asarray(r, np.float64) for r in rows])
+# Canonical reference combination order (fold-in + balanced VHDD tree) —
+# shared with the Python engine so both engines and this expectation agree
+# at any world size.
+from horovod_tpu.ops.adasum import _numpy_adasum_rows as _numpy_adasum  # noqa: E402
 
 
 def test_four_process_native_world():
@@ -116,7 +108,9 @@ def test_four_process_native_world():
 
 
 def _three_rank_adasum_fn():
-    # Non-power-of-2 world exercises the gather+tree fallback path.
+    # Non-power-of-2 world exercises the distributed fold-in path (largest
+    # power-of-2 subgroup + extras folded into their partners — no rank-0
+    # funnel).
     import numpy as np
 
     import horovod_tpu as hvd
@@ -129,7 +123,7 @@ def _three_rank_adasum_fn():
     return {"v": v.tolist(), "out": out}
 
 
-def test_three_process_adasum_fallback():
+def test_three_process_adasum_distributed():
     results = hvdrun.run(_three_rank_adasum_fn, np=3, use_cpu=True,
                          timeout=240, env=ENV)
     rows = [np.asarray(r["v"], np.float64) for r in results]
@@ -137,6 +131,77 @@ def test_three_process_adasum_fallback():
     for r in results:
         np.testing.assert_allclose(
             np.asarray(r["out"], np.float64), expect, rtol=1e-4, atol=1e-5
+        )
+
+
+def _six_rank_adasum_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    rng = np.random.RandomState(100 + r)
+    v = rng.randn(257).astype(np.float32)  # odd length: uneven VHDD halves
+    out = hvd.allreduce(v, op=hvd.Adasum, name="ada6").tolist()
+    hvd.shutdown()
+    return {"v": v.tolist(), "out": out}
+
+
+def test_six_process_adasum_distributed():
+    """np=6 = pow2 group {0..3} + two folded extras: VHDD numerics hold
+    without any rank-0 funneling (VERDICT r2 item 6)."""
+    results = hvdrun.run(_six_rank_adasum_fn, np=6, use_cpu=True,
+                         timeout=240, env=ENV)
+    rows = [np.asarray(r["v"], np.float64) for r in results]
+    expect = _numpy_adasum(rows)
+    for r in results:
+        np.testing.assert_allclose(
+            np.asarray(r["out"], np.float64), expect, rtol=1e-3, atol=1e-4
+        )
+
+
+def _bf16_adasum_wire_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu._engine_registry import get_engine
+
+    hvd.init()
+    r = hvd.rank()
+    eng = get_engine()
+    try:
+        import ml_dtypes
+
+        n = 2048
+        base = np.linspace(0.1, 1.0, n).astype(np.float32) * (r + 1)
+        b0 = eng.lib.hvdtpu_perf_bytes()
+        out32 = hvd.allreduce(base, op=hvd.Adasum, name="a32")
+        b1 = eng.lib.hvdtpu_perf_bytes()
+        out16 = hvd.allreduce(
+            base.astype(ml_dtypes.bfloat16), op=hvd.Adasum, name="a16"
+        )
+        b2 = eng.lib.hvdtpu_perf_bytes()
+        return {
+            "f32_bytes": int(b1 - b0),
+            "bf16_bytes": int(b2 - b1),
+            "out32": np.asarray(out32, np.float64).tolist(),
+            "out16": np.asarray(out16, np.float64).tolist(),
+        }
+    finally:
+        hvd.shutdown()
+
+
+def test_adasum_bf16_halves_wire_bytes():
+    """bf16 Adasum payloads ride the wire at 2 B/elt (the engine's perf-
+    bytes counter is dtype-aware) with f32/double accumulation only in
+    registers — half the f32 bytes, a quarter of the old f64 wire."""
+    results = hvdrun.run(_bf16_adasum_wire_fn, np=2, use_cpu=True,
+                         timeout=240, env=ENV)
+    for r in results:
+        assert r["bf16_bytes"] * 2 == r["f32_bytes"], r
+        np.testing.assert_allclose(
+            r["out16"], r["out32"], rtol=0.05, atol=0.05
         )
 
 
@@ -202,3 +267,39 @@ def test_native_timeline_written(tmp_path):
     cats = {e.get("cat") for e in events}
     assert "NEGOTIATE_ALLREDUCE" in cats
     assert "ALLREDUCE" in cats
+
+
+def _np8_fn():
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    t0 = time.monotonic()
+    rounds = 20
+    for i in range(rounds):
+        # ~256 KB payload per rank per round: big enough that a coordinator
+        # draining workers one-at-a-time in rank order (the old serial
+        # RecvMsg loop) would stall senders behind full kernel buffers.
+        out = hvd.allreduce(
+            np.full(65536, float(r + 1), np.float32), op=hvd.Sum,
+            name=f"big{i}",
+        )
+    elapsed = time.monotonic() - t0
+    hvd.shutdown()
+    return {"ok": bool((np.asarray(out) == 36.0).all()),
+            "elapsed": elapsed}
+
+
+def test_np8_poll_multiplexed_negotiation():
+    """np=8 native world (7 workers feeding the rank-0 coordinator through
+    the poll-multiplexed gather): 20 negotiation+data rounds complete
+    correctly and promptly (VERDICT r2 item 5)."""
+    results = hvdrun.run(_np8_fn, np=8, use_cpu=True, timeout=300, env=ENV)
+    assert all(r["ok"] for r in results)
+    # generous bound: catches gross serialization (the serial-recv
+    # pathology is worker sends blocking on undrained sockets), not jitter
+    assert max(r["elapsed"] for r in results) < 60, results

@@ -139,3 +139,86 @@ def test_feature_probes_answer():
     assert hvd.size() >= 1
     assert isinstance(hvd.gloo_built(), bool)
     assert isinstance(hvd.mpi_built(), bool)
+
+
+# ---------------------------------------------------------------------------
+# Keras frontend (reference horovod.tensorflow.keras; VERDICT r2 item 7)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_model(lr=0.1):
+    import tensorflow as tf
+
+    import horovod_tpu.interop.tf_keras as hvk
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+    )
+    model.compile(
+        optimizer=hvk.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=lr)
+        ),
+        loss="mse",
+    )
+    return model
+
+
+def test_keras_fit_with_callbacks_single_process():
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.interop.tf_keras as hvk
+
+    x = np.random.RandomState(0).randn(32, 2).astype(np.float32)
+    y = (x @ np.asarray([[1.0], [2.0]], np.float32)).astype(np.float32)
+    model = _tiny_model()
+    hist = model.fit(
+        x, y, epochs=2, batch_size=8, verbose=0,
+        callbacks=[
+            hvk.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvk.callbacks.MetricAverageCallback(),
+            # no steps_per_epoch: must auto-fill from Keras's fit params
+            hvk.callbacks.LearningRateWarmupCallback(
+                initial_lr=0.1, warmup_epochs=2
+            ),
+        ],
+    )
+    assert "loss" in hist.history
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    # warmup ramps toward initial_lr (world==1: multiplier is 1 throughout)
+    assert abs(hvk._lr_value(model.optimizer) - 0.1) < 1e-6
+
+
+def test_keras_lr_schedule_staircase():
+    import numpy as np
+
+    import horovod_tpu.interop.tf_keras as hvk
+
+    x = np.zeros((8, 2), np.float32)
+    y = np.zeros((8, 1), np.float32)
+    model = _tiny_model(lr=1.0)
+    cb = hvk.callbacks.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda epoch: 0.5 ** epoch
+    )
+    hist = model.fit(x, y, epochs=3, batch_size=8, verbose=0, callbacks=[cb])
+    # epoch e runs at lr = 0.5^e; logs record it
+    assert hist.history["lr"] == [1.0, 0.5, 0.25]
+
+
+def test_keras_load_model_rewraps_optimizer(tmp_path):
+    import numpy as np
+
+    import horovod_tpu.interop.tf_keras as hvk
+
+    x = np.random.RandomState(0).randn(16, 2).astype(np.float32)
+    y = np.zeros((16, 1), np.float32)
+    model = _tiny_model()
+    model.fit(x, y, epochs=1, batch_size=8, verbose=0)
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+    restored = hvk.load_model(path)
+    assert getattr(restored.optimizer, "_hvd_wrapped", False), (
+        "load_model must return a model whose optimizer is re-wrapped in "
+        "DistributedOptimizer (reference _keras/__init__.py:113-128)"
+    )
+    restored.fit(x, y, epochs=1, batch_size=8, verbose=0)  # still trains
