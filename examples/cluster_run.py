@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Run a horovod_tpu job inside cluster task slots (reference:
+horovod.spark.run — examples/keras_spark_rossmann_run.py topology).
+
+With Spark:
+
+    import horovod_tpu.cluster as cluster
+    results = cluster.run_on_cluster(
+        train_fn, num_proc=sc.defaultParallelism,
+        executor=cluster.spark_executor(sc))
+
+This example uses the local subprocess executor so it runs anywhere:
+
+    python examples/cluster_run.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.cluster import local_executor, run_on_cluster
+
+
+def train_fn(steps: int):
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    total = 0.0
+    for step in range(steps):
+        g = np.full(8, float(r + 1 + step), np.float32)
+        total += float(hvd.allreduce(g, op=hvd.Average).sum())
+    hvd.shutdown()
+    return {"rank": r, "metric": total}
+
+
+def main() -> int:
+    results = run_on_cluster(
+        train_fn, (5,), num_proc=2,
+        executor=local_executor(),
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    for res in results:
+        print(f"rank {res['rank']}: metric {res['metric']:.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,328 @@
+"""Cluster-manager integration: run a horovod_tpu job inside task slots a
+cluster scheduler already allocated.
+
+Reference: ``horovod.spark.run`` (horovod/spark/runner.py:100-189) — one
+Horovod process per Spark task: a driver service waits for every task to
+register, assigns ranks grouped by host hash (barrel-shifted so rank 0
+lands on the first host), ships the pickled function to each task, and
+collects per-rank results.
+
+TPU redesign: the driver is this package's HMAC-signed HTTP KV store (the
+same rendezvous the launcher uses, run/rendezvous.py ≙ the reference's
+RendezvousServer), and the coordination service is ``jax.distributed``
+bootstrapped by whichever task is assigned rank 0.  The cluster manager
+only has to run ``task_main(index, driver, secret)`` in each of its task
+slots — adapters:
+
+* :func:`local_executor` — subprocess slots on this machine (the test
+  topology, and a correctness reference for any adapter).
+* :func:`spark_executor` — one task per Spark partition, exactly the
+  reference's ``_make_spark_thread`` shape (imports pyspark lazily).
+
+Any other scheduler (k8s indexed Jobs, Slurm steps, Ray actors) integrates
+by invoking ``python -m horovod_tpu.cluster --task <i> --driver <addr>
+--secret <key>`` in each slot.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import cloudpickle
+
+from .run.allocate import routable_ip
+from .run.rendezvous import KVStoreClient, KVStoreServer, make_secret
+
+START_TIMEOUT_DEFAULT = 600.0
+
+
+# ---------------------------------------------------------------------------
+# rank assignment (reference spark/runner.py:186-205: host-hash grouping +
+# barrel shift so index 0's host holds rank 0)
+# ---------------------------------------------------------------------------
+
+
+def assign_ranks(task_hosts: Dict[int, str]) -> List[dict]:
+    """task index -> host hash, to per-task slot dicts (rank, local_rank,
+    local_size, cross_rank, cross_size, size)."""
+    by_host: Dict[str, List[int]] = {}
+    for idx in sorted(task_hosts):
+        by_host.setdefault(task_hosts[idx], []).append(idx)
+    hosts = sorted(by_host)
+    # Barrel shift until task index 0 is in the first host.
+    first = task_hosts[0]
+    while hosts[0] != first:
+        hosts = hosts[1:] + hosts[:1]
+    slots = [None] * len(task_hosts)
+    rank = 0
+    for cross_rank, h in enumerate(hosts):
+        for local_rank, idx in enumerate(by_host[h]):
+            slots[idx] = {
+                "rank": rank,
+                "local_rank": local_rank,
+                "local_size": len(by_host[h]),
+                "cross_rank": cross_rank,
+                "cross_size": len(hosts),
+                "size": len(task_hosts),
+            }
+            rank += 1
+    return slots
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def run_on_cluster(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    *,
+    num_proc: int,
+    executor: Callable[[int, str, str], object],
+    start_timeout: float = START_TIMEOUT_DEFAULT,
+    job_timeout: Optional[float] = None,
+    env: Optional[Dict[str, str]] = None,
+):
+    """Run ``fn`` as a ``num_proc``-rank horovod_tpu job inside cluster
+    task slots; returns the per-rank results in rank order (reference
+    horovod.spark.run contract).
+
+    ``start_timeout`` bounds task START-UP (scheduling + registration —
+    the reference's start_timeout semantics, spark/runner.py); the
+    training function itself may run as long as it likes unless
+    ``job_timeout`` is set.
+
+    ``executor(num_tasks, driver_addr, secret)`` must arrange for
+    :func:`task_main`-equivalent execution in each slot and may return an
+    object with ``.join()``/``.check()`` for error propagation.
+    """
+    server = KVStoreServer(secret=(secret := make_secret()))
+    port = server.start()
+    addr = f"{routable_ip('127.0.0.1')}:{port}"
+    from .run.api import _pickle_func  # noqa: PLC0415
+
+    kv = KVStoreClient(addr, secret)
+    kv.put("job", "program", _pickle_func(fn, args, kwargs or {}))
+    kv.put("job", "env", pickle.dumps(env or {}))
+
+    handle = executor(num_proc, addr, secret)
+    deadline = time.monotonic() + start_timeout
+    try:
+        # 1. registration (reference: driver.task_host_hash_indices)
+        task_hosts: Dict[int, str] = {}
+        for i in range(num_proc):
+            raw = kv.wait(
+                "register", str(i),
+                timeout=max(deadline - time.monotonic(), 1.0),
+            )
+            task_hosts[i] = pickle.loads(raw)["host_hash"]
+        # 2. rank assignment, published per task
+        slots = assign_ranks(task_hosts)
+        for i, slot in enumerate(slots):
+            kv.put("slot", str(i), pickle.dumps(slot))
+        # 3. results, in rank order (bounded only by job_timeout; a task
+        # that died without posting is detected through the executor
+        # handle rather than a timeout)
+        job_deadline = (
+            time.monotonic() + job_timeout if job_timeout else None
+        )
+        results = [None] * num_proc
+        for i in range(num_proc):
+            while True:
+                # KV first: a task that raised posts its traceback BEFORE
+                # exiting non-zero, and that diagnostic must win over the
+                # generic died-without-result error.
+                try:
+                    raw = kv.wait("result", str(i), timeout=10.0)
+                    break
+                except TimeoutError:
+                    pass
+                if job_deadline and time.monotonic() > job_deadline:
+                    raise TimeoutError(
+                        f"cluster job exceeded job_timeout={job_timeout}s"
+                    )
+                failed = getattr(handle, "failed", None)
+                if failed is not None and failed():
+                    raise RuntimeError(
+                        f"cluster task {i} died before reporting a result "
+                        "(see its slot's logs)"
+                    )
+            ok, value = pickle.loads(raw)
+            if not ok:
+                raise RuntimeError(
+                    f"cluster task {i} (rank {slots[i]['rank']}) raised:\n"
+                    f"{value}"
+                )
+            results[slots[i]["rank"]] = pickle.loads(value)
+        return results
+    finally:
+        joiner = getattr(handle, "join", None)
+        if joiner is not None:
+            try:
+                joiner()
+            except Exception:
+                pass
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# task side
+# ---------------------------------------------------------------------------
+
+
+def task_main(index: int, driver_addr: str, secret: str) -> None:
+    """Body of one cluster task slot: register, learn the rank, bootstrap
+    the coordination service, run the user function, report the result
+    (reference horovod/spark/task/__init__.py + task_service)."""
+    kv = KVStoreClient(driver_addr, secret)
+    try:
+        kv.put(
+            "register", str(index),
+            pickle.dumps({"host_hash": socket.gethostname(),
+                          "pid": os.getpid()}),
+        )
+        slot = pickle.loads(kv.wait("slot", str(index), timeout=600))
+        extra_env = pickle.loads(kv.wait("job", "env", timeout=60))
+        os.environ.update(extra_env)
+        os.environ.update({
+            "HVDTPU_RANK": str(slot["rank"]),
+            "HVDTPU_SIZE": str(slot["size"]),
+            "HVDTPU_LOCAL_RANK": str(slot["local_rank"]),
+            "HVDTPU_LOCAL_SIZE": str(slot["local_size"]),
+            "HVDTPU_CROSS_RANK": str(slot["cross_rank"]),
+            "HVDTPU_CROSS_SIZE": str(slot["cross_size"]),
+        })
+        # rank 0 hosts the jax.distributed coordinator; everyone else
+        # learns its address through the driver KV (≙ the reference's
+        # task-to-task address registration, spark/runner.py:193-199)
+        if slot["rank"] == 0:
+            with socket.socket() as s:
+                s.bind(("", 0))
+                coord = f"{routable_ip(driver_addr.rsplit(':', 1)[0])}:" \
+                        f"{s.getsockname()[1]}"
+            kv.put("job", "coordinator", coord.encode())
+        else:
+            coord = kv.wait("job", "coordinator", timeout=600).decode()
+        os.environ["HVDTPU_COORDINATOR"] = coord
+
+        fn, args, kwargs = cloudpickle.loads(
+            kv.wait("job", "program", timeout=60)
+        )
+        result = fn(*args, **kwargs)
+        kv.put("result", str(index),
+               pickle.dumps((True, pickle.dumps(result))))
+    except BaseException:  # noqa: BLE001 — report, then re-raise
+        import traceback
+
+        try:
+            kv.put("result", str(index),
+                   pickle.dumps((False, traceback.format_exc())))
+        except Exception:
+            pass
+        raise
+
+
+def _main() -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="horovod_tpu cluster task entry (run one per slot)"
+    )
+    parser.add_argument("--task", type=int, required=True)
+    parser.add_argument("--driver", required=True)
+    parser.add_argument("--secret", required=True)
+    a = parser.parse_args()
+    task_main(a.task, a.driver, a.secret)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# executors
+# ---------------------------------------------------------------------------
+
+
+class _LocalHandle:
+    def __init__(self, procs: List[subprocess.Popen]):
+        self.procs = procs
+
+    def join(self) -> None:
+        for p in self.procs:
+            p.wait()
+
+    def failed(self) -> bool:
+        """True when any slot process exited non-zero (a task that died
+        without posting its result — the driver stops waiting)."""
+        return any(
+            p.poll() is not None and p.poll() != 0 for p in self.procs
+        )
+
+
+def local_executor(base_env: Optional[Dict[str, str]] = None):
+    """Task slots as local subprocesses — the test topology, and the
+    template for writing adapters (every slot just needs to exec the
+    module entry with its index)."""
+
+    def launch(num_tasks: int, driver_addr: str, secret: str) -> _LocalHandle:
+        procs = []
+        for i in range(num_tasks):
+            env = dict(os.environ)
+            env.update(base_env or {})
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "horovod_tpu.cluster",
+                     "--task", str(i), "--driver", driver_addr,
+                     "--secret", secret],
+                    env=env,
+                )
+            )
+        return _LocalHandle(procs)
+
+    return launch
+
+
+def spark_executor(spark_context=None):
+    """One horovod_tpu process per Spark task, the reference's topology
+    (spark/runner.py _make_spark_thread + mapPartitionsWithIndex).  Lazily
+    imports pyspark; raises a clear error when Spark is absent."""
+
+    def launch(num_tasks: int, driver_addr: str, secret: str):
+        try:
+            import pyspark  # noqa: PLC0415
+        except ImportError as exc:  # pragma: no cover - no pyspark in CI
+            raise RuntimeError(
+                "spark_executor requires pyspark; install it or use "
+                "local_executor / a custom adapter"
+            ) from exc
+        sc = spark_context or pyspark.SparkContext._active_spark_context
+        if sc is None:  # pragma: no cover
+            raise RuntimeError(
+                "no active SparkContext; create one before spark_executor"
+            )
+
+        def _task(index, _iterator):
+            task_main(index, driver_addr, secret)
+            yield index
+
+        thread = threading.Thread(
+            target=lambda: sc.parallelize(
+                range(num_tasks), num_tasks
+            ).mapPartitionsWithIndex(_task).collect(),
+            daemon=True,
+        )
+        thread.start()
+        return thread
+
+    return launch
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

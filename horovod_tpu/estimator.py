@@ -93,8 +93,11 @@ class Estimator:
         checkpoint_every_epochs: int = 1,
         verbose: bool = False,
     ):
-        if backend not in ("local", "launcher"):
-            raise ValueError(f"unknown backend {backend!r}")
+        if backend not in ("local", "launcher") and not callable(backend):
+            raise ValueError(
+                f"unknown backend {backend!r}: expected 'local', 'launcher' "
+                "or a horovod_tpu.cluster executor"
+            )
         self.model = model
         self.optimizer = optimizer
         self.loss = loss or _default_loss
@@ -127,6 +130,12 @@ class Estimator:
             )
         if self.backend == "local":
             params, history = _train_local(self._config(), x, y)
+        elif callable(self.backend):
+            # Cluster-manager backend: any horovod_tpu.cluster executor
+            # (spark_executor, local_executor, or a custom adapter) — the
+            # analog of the reference Estimators training inside Spark
+            # tasks (spark/keras/estimator.py over horovod.spark.run).
+            params, history = _train_cluster(self._config(), x, y)
         else:
             params, history = _train_launcher(self._config(), x, y)
         if self.store is not None:
@@ -165,6 +174,7 @@ class Estimator:
             ),
             "run_id": self.run_id,
             "np_workers": self.np_workers,
+            "backend_executor": self.backend if callable(self.backend) else None,
             "use_cpu": self.use_cpu,
             "timeout": self.timeout,
             "checkpoint_every_epochs": self.checkpoint_every_epochs,
@@ -347,6 +357,24 @@ def _train_launcher(cfg: dict, x: np.ndarray, y: np.ndarray):
     results = hvdrun.run(
         _launcher_worker, (cfg, x, y), np=np_workers,
         use_cpu=cfg["use_cpu"], timeout=cfg["timeout"],
+    )
+    return results[0]
+
+
+def _train_cluster(cfg: dict, x: np.ndarray, y: np.ndarray):
+    """Train inside cluster task slots (reference: the Spark estimators
+    launching horovod.spark.run over the executors)."""
+    from .cluster import run_on_cluster
+
+    executor = cfg["backend_executor"]
+    # The executor may close over unpicklable scheduler handles (a
+    # SparkContext); the workers never need it.
+    worker_cfg = {k: v for k, v in cfg.items() if k != "backend_executor"}
+    np_workers = cfg["np_workers"] or 2
+    env = {"JAX_PLATFORMS": "cpu"} if cfg["use_cpu"] else {}
+    results = run_on_cluster(
+        _launcher_worker, (worker_cfg, x, y), num_proc=np_workers,
+        executor=executor, job_timeout=cfg["timeout"], env=env,
     )
     return results[0]
 

@@ -90,7 +90,14 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
         try:
             model.optimizer = wrapped
         except AttributeError:  # older Keras: optimizer set via compile only
-            model.compile(optimizer=wrapped, loss=model.loss)
+            # Recompile with the FULL restored compile config (metrics,
+            # loss_weights, ...) — not just the loss.
+            try:
+                cfg = model.get_compile_config()
+                cfg["optimizer"] = wrapped
+                model.compile_from_config(cfg)
+            except Exception:
+                model.compile(optimizer=wrapped, loss=model.loss)
     return model
 
 
@@ -193,6 +200,7 @@ class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
         self.momentum_correction = momentum_correction
         self.steps_per_epoch = steps_per_epoch
         self.current_epoch = 0
+        self._restore_momentum = None
         if callable(multiplier):
             self.multiplier = multiplier
         else:
@@ -218,9 +226,21 @@ class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
         return self.end_epoch is None or epoch < self.end_epoch
 
     def _adjust(self, epoch) -> None:
-        if self._in_range(int(epoch)):
-            _set_lr(self.model.optimizer,
-                    self.initial_lr * self.multiplier(epoch))
+        if not self._in_range(int(epoch)):
+            return
+        opt = self.model.optimizer
+        old_lr = _lr_value(opt)
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        _set_lr(opt, new_lr)
+        # Momentum correction (reference _keras/callbacks.py, after Goyal
+        # et al. 2017): Keras folds lr into the velocity update, so an LR
+        # change perturbs the effective velocity unless momentum is scaled
+        # by new_lr/old_lr for the next update, then restored.
+        if self.momentum_correction and old_lr > 0 and new_lr != old_lr:
+            m = getattr(opt, "momentum", None)
+            if isinstance(m, (int, float)) and m:
+                self._restore_momentum = float(m)
+                opt.momentum = float(m) * new_lr / old_lr
 
     def on_epoch_begin(self, epoch, logs=None):
         self.current_epoch = epoch
@@ -230,6 +250,11 @@ class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
     def on_batch_begin(self, batch, logs=None):
         if not self.staircase and self.steps_per_epoch:
             self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        if self._restore_momentum is not None:
+            self.model.optimizer.momentum = self._restore_momentum
+            self._restore_momentum = None
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is not None:

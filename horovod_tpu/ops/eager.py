@@ -73,11 +73,10 @@ def _uncommit(x):
         shards = x.addressable_shards
         if len(shards) != 1:
             return x
-        buf = shards[0].data
         return ArrayImpl(
             x.aval,
             jax.sharding.SingleDeviceSharding(next(iter(x.devices()))),
-            [buf if buf is not x else x],
+            [shards[0].data],
             committed=False,
         )
     except Exception:

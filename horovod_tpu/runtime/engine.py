@@ -679,6 +679,35 @@ class EagerEngine:
             flat.view(local.dtype).reshape((self.world,) + tuple(local.shape))
         )
 
+    @staticmethod
+    def _scatter_results(entries, shapes, total) -> None:
+        """Slice the reduced fused buffer back to per-entry futures
+        (MemcpyOutFusionBuffer analog); works on numpy and jax totals."""
+        offset = 0
+        for e, shape in zip(entries, shapes):
+            n = int(np.prod(shape)) if shape else 1
+            if e is not None:
+                out = total[offset : offset + n].reshape(shape)
+                e.future.set_result(out.astype(e.tensor.dtype))
+            offset += n
+
+    def _plane_allreduce(self, buf, dtype_name, reduce_op, pre, post,
+                         is_int):
+        """One XLA-plane reduce of a fused buffer — shared by the device
+        path (jax buf in, jax total out) and the staged host path."""
+        from ..ops.collectives import ReduceOp as _R  # noqa: PLC0415
+
+        return self._plane().allreduce(
+            buf,
+            reduce_op,
+            pre,
+            post,
+            acc_dtype="float32"
+            if dtype_name in ("bfloat16", "float16")
+            else dtype_name,
+            exact_int_avg=bool(is_int and reduce_op == int(_R.AVERAGE)),
+        )
+
     def _execute_allreduce(self, resp: Response, entries) -> None:
         meta = getattr(resp, "_fuse_meta", None)
         shapes = getattr(resp, "_shapes", [()] * len(resp.tensor_names))
@@ -705,19 +734,15 @@ class EagerEngine:
             acc_dtype = np.dtype(np.float64)
         from ..ops.collectives import ReduceOp as _R  # noqa: PLC0415
 
+        # The XLA plane serves everything except ADASUM (numpy VHDD
+        # reference math) and scaled ints (need f64) — conditions derived
+        # from NEGOTIATED fields, so every rank picks the same plane.
+        plane_ok = reduce_op != int(_R.ADASUM) and not (scaled and is_int)
+
         # Device-resident path: jax.Array payloads reduce as one compiled
-        # XLA collective — no host round-trip (device_plane.py).  Falls
-        # through to the host plane for ADASUM (numpy VHDD reference math),
-        # scaled ints (need f64) and bools — all conditions derived from
-        # NEGOTIATED fields, so every rank picks the same plane.
-        if (
-            reduce_op != int(_R.ADASUM)
-            and not (scaled and is_int)
-            and wire_dtype.kind != "b"
-            and self._use_device(resp)
-        ):
-            plane = self._plane()
-            wire_j = jnp.dtype(_np_dtype(dtype_name))
+        # XLA collective — no host round-trip (device_plane.py).
+        if plane_ok and wire_dtype.kind != "b" and self._use_device(resp):
+            wire_j = jnp.dtype(wire_dtype)
             flats = []
             for e, shape in zip(entries, shapes):
                 if e is not None and e.tensor is not None:
@@ -726,27 +751,14 @@ class EagerEngine:
                     n = int(np.prod(shape)) if shape else 1
                     flats.append(jnp.zeros(n, wire_j))
             buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-            total = plane.allreduce(
-                buf,
-                reduce_op,
-                pre,
-                post,
-                acc_dtype="float32"
-                if dtype_name in ("bfloat16", "float16")
-                else dtype_name,
-                exact_int_avg=bool(is_int and reduce_op == int(_R.AVERAGE)),
+            total = self._plane_allreduce(
+                buf, dtype_name, reduce_op, pre, post, is_int
             )
             self.stats["device_data_ops"] += 1
             self.stats["device_payload_bytes"] += (
                 int(total.size) * wire_dtype.itemsize
             )
-            offset = 0
-            for e, shape in zip(entries, shapes):
-                n = int(np.prod(shape)) if shape else 1
-                if e is not None:
-                    out = total[offset : offset + n].reshape(shape)
-                    e.future.set_result(out.astype(e.tensor.dtype))
-                offset += n
+            self._scatter_results(entries, shapes, total)
             return
         # Fused buffer: concat all entries (MemcpyInFusionBuffer analog,
         # collective_operations.cc:159-210).  A joined rank has no entry for
@@ -766,34 +778,17 @@ class EagerEngine:
         # gather-everything fallback (reference's GlooAllreduce ring,
         # gloo_operations.cc:107-142).  64-bit dtypes stay on the exact
         # raw-bytes gather (jax without x64 would truncate them).
-        if (
-            reduce_op != int(_R.ADASUM)
-            and not (scaled and is_int)
-            and dtype_name in _STAGEABLE_DTYPES
-            and self._use_staged()
-        ):
-            plane = self._plane()
-            total_dev = plane.allreduce(
-                jnp.asarray(buf),
-                reduce_op,
-                pre,
-                post,
-                acc_dtype="float32"
-                if dtype_name in ("bfloat16", "float16")
-                else dtype_name,
-                exact_int_avg=bool(is_int and reduce_op == int(_R.AVERAGE)),
+        if plane_ok and dtype_name in _STAGEABLE_DTYPES and self._use_staged():
+            total = np.asarray(
+                self._plane_allreduce(
+                    jnp.asarray(buf), dtype_name, reduce_op, pre, post,
+                    is_int,
+                )
             )
-            total = np.asarray(total_dev)
             self.stats["host_staged_ops"] += 1
             self.stats["host_wire_bytes"] += int(buf.nbytes)
             self.stats["host_recv_bytes"] += int(buf.nbytes)
-            offset = 0
-            for e, shape in zip(entries, shapes):
-                n = int(np.prod(shape)) if shape else 1
-                if e is not None:
-                    out = total[offset : offset + n].reshape(shape)
-                    e.future.set_result(out.astype(e.tensor.dtype))
-                offset += n
+            self._scatter_results(entries, shapes, total)
             return
         if pre != 1.0:
             buf = (buf.astype(acc_dtype) * pre).astype(wire_dtype)
@@ -817,14 +812,7 @@ class EagerEngine:
                     total = total / self.world
         if post != 1.0:
             total = total.astype(acc_dtype) * post
-        total = np.asarray(total)
-        offset = 0
-        for e, shape in zip(entries, shapes):
-            n = int(np.prod(shape)) if shape else 1
-            if e is not None:
-                out = total[offset : offset + n].reshape(shape)
-                e.future.set_result(out.astype(e.tensor.dtype))
-            offset += n
+        self._scatter_results(entries, shapes, np.asarray(total))
 
     def _execute_allgather(self, resp: Response, entries) -> None:
         e = entries[0]

@@ -1,0 +1,113 @@
+"""Cluster-integration tests (reference: horovod.spark.run semantics,
+test_spark.py's driver/task registration coverage — SURVEY.md §2.7).
+
+The generic protocol (register -> host-hash rank assignment -> function
+shipping -> result collection) is exercised end-to-end with the local
+subprocess executor; the rank-assignment math is unit-tested against the
+reference's barrel-shift behavior (spark/runner.py:186-205)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.cluster import assign_ranks, local_executor, run_on_cluster
+
+pytestmark = pytest.mark.multiprocess
+
+
+def test_assign_ranks_single_host():
+    slots = assign_ranks({0: "hostA", 1: "hostA", 2: "hostA"})
+    assert [s["rank"] for s in slots] == [0, 1, 2]
+    assert [s["local_rank"] for s in slots] == [0, 1, 2]
+    assert all(s["local_size"] == 3 and s["cross_size"] == 1 for s in slots)
+
+
+def test_assign_ranks_multi_host_barrel_shift():
+    # task 0 lives on hostB: the barrel shift must make hostB the first
+    # host so rank 0 is task 0's host (reference spark/runner.py:186-190)
+    slots = assign_ranks({0: "hostB", 1: "hostA", 2: "hostB", 3: "hostA"})
+    assert slots[0]["rank"] == 0 and slots[0]["cross_rank"] == 0
+    assert slots[2]["rank"] == 1 and slots[2]["local_rank"] == 1
+    assert slots[1]["rank"] == 2 and slots[1]["cross_rank"] == 1
+    assert slots[3]["rank"] == 3
+    assert all(s["local_size"] == 2 and s["cross_size"] == 2 for s in slots)
+    # ranks are a permutation of 0..n-1
+    assert sorted(s["rank"] for s in slots) == [0, 1, 2, 3]
+
+
+def _cluster_fn(scale):
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = hvd.allreduce(np.full(3, float(r + 1) * scale, np.float32),
+                        op=hvd.Sum)
+    res = {
+        "rank": r,
+        "size": hvd.size(),
+        "local_rank": hvd.local_rank(),
+        "sum": np.asarray(out).tolist(),
+    }
+    hvd.shutdown()
+    return res
+
+
+def test_run_on_cluster_local_executor():
+    """Full protocol end-to-end: 2 task slots register with the driver,
+    get host-hash ranks, bootstrap jax.distributed, run a collective, and
+    the driver returns results in rank order."""
+    results = run_on_cluster(
+        _cluster_fn, (10.0,), num_proc=2,
+        executor=local_executor(),
+        start_timeout=180,
+        env={"JAX_PLATFORMS": "cpu", "HVDTPU_EAGER_ENGINE": "python"},
+    )
+    assert [r["rank"] for r in results] == [0, 1]
+    assert all(r["size"] == 2 for r in results)
+    # same host -> contiguous local ranks
+    assert sorted(r["local_rank"] for r in results) == [0, 1]
+    for r in results:
+        assert r["sum"] == [30.0, 30.0, 30.0]  # 10 + 20
+
+
+def test_run_on_cluster_task_failure_propagates():
+    def boom():
+        raise ValueError("cluster task exploded")
+
+    with pytest.raises(RuntimeError, match="cluster task exploded"):
+        run_on_cluster(
+            boom, num_proc=2, executor=local_executor(),
+            start_timeout=120,
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+
+
+def test_estimator_cluster_backend(tmp_path):
+    """Estimator trains through a cluster executor — the reference's
+    Spark-estimator topology (KerasEstimator over horovod.spark.run)."""
+    from horovod_tpu.checkpoint import LocalStore
+    from horovod_tpu.estimator import Estimator
+    from horovod_tpu.models import ConvNet
+
+    import optax
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(64,)).astype(np.int32)
+
+    est = Estimator(
+        ConvNet(),
+        optax.adam(1e-3),
+        store=LocalStore(str(tmp_path)),
+        epochs=1,
+        batch_size=16,
+        np_workers=2,
+        backend=local_executor(),
+        use_cpu=True,
+        timeout=180,
+        verbose=0,
+    )
+    model = est.fit({"features": x, "label": y})
+    preds = model.transform({"features": x})
+    assert preds["prediction"].shape[0] == 64

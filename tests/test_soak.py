@@ -1,0 +1,85 @@
+"""Soak: sustained mixed eager traffic across processes — steady-state
+cache cycling, periodic renegotiation, mixed host/device payloads, fusion,
+and a Join finale, on both engines.  Guards the interactions the focused
+tests can't see (cache eviction under live votes, plane selection flapping
+between ops, fused responses straddling cache hits and misses)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.run as hvdrun
+
+pytestmark = pytest.mark.multiprocess
+
+
+@pytest.fixture(params=["python", "native"])
+def engine_env(request):
+    if request.param == "native":
+        from horovod_tpu.runtime.native import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C cpp)")
+    return {"HVDTPU_EAGER_ENGINE": request.param}
+
+
+def _soak_fn(steps):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    world = hvd.size()
+    errors = []
+    for step in range(steps):
+        # steady-state names (cache HITs after round one)
+        hs = [
+            hvd.allreduce_async(
+                np.full(64, float(r + 1 + k), np.float32),
+                op=hvd.Sum, name=f"grad_{k}",
+            )
+            for k in range(4)
+        ]
+        for k, h in enumerate(hs):
+            got = hvd.synchronize(h)
+            want = sum(float(i + 1 + k) for i in range(world))
+            if not np.allclose(np.asarray(got), want):
+                errors.append(f"step{step} grad_{k}: {got[0]} != {want}")
+        # device payload every step (python engine: XLA plane)
+        dv = hvd.allreduce(jnp.full((8,), float(r + 1), jnp.bfloat16),
+                           op=hvd.Average, name="dev_grad")
+        if not np.allclose(np.asarray(dv, np.float32), (1 + world) / 2):
+            errors.append(f"step{step} dev_grad wrong")
+        # fresh name every 10 steps: forces slow-path negotiation and,
+        # eventually, cache insertions alongside live votes
+        if step % 10 == 0:
+            fresh = hvd.allreduce(
+                np.ones(16, np.float32), op=hvd.Sum, name=f"fresh_{step}"
+            )
+            if not np.allclose(np.asarray(fresh), world):
+                errors.append(f"step{step} fresh wrong")
+        # a broadcast and a ragged allgather in the same cycles
+        b = hvd.broadcast(
+            np.full(5, float(100 * (r + 1)), np.float32), root_rank=0,
+            name="bcast",
+        )
+        if not np.allclose(np.asarray(b), 100.0):
+            errors.append(f"step{step} bcast wrong")
+    hvd.join()
+    from horovod_tpu._engine_registry import peek_engine
+
+    eng = peek_engine()
+    stats = dict(getattr(eng, "stats", {}))
+    hvd.shutdown()
+    return {"errors": errors[:5], "n_errors": len(errors), "stats": stats}
+
+
+def test_soak_mixed_traffic(engine_env):
+    results = hvdrun.run(_soak_fn, (60,), np=2, use_cpu=True, timeout=300,
+                         env=engine_env)
+    for res in results:
+        assert res["n_errors"] == 0, res["errors"]
+    if "fast_cycles" in (results[0]["stats"] or {}):
+        # python engine: the steady-state fast path must have engaged
+        assert results[0]["stats"]["cache_hits"] > 100

@@ -222,3 +222,31 @@ def test_keras_load_model_rewraps_optimizer(tmp_path):
         "DistributedOptimizer (reference _keras/__init__.py:113-128)"
     )
     restored.fit(x, y, epochs=1, batch_size=8, verbose=0)  # still trains
+
+
+def test_keras_warmup_momentum_correction_restores():
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.interop.tf_keras as hvk
+
+    x = np.zeros((16, 2), np.float32)
+    y = np.zeros((16, 1), np.float32)
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+    )
+    model.compile(
+        optimizer=hvk.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)
+        ),
+        loss="mse",
+    )
+    model.fit(
+        x, y, epochs=2, batch_size=8, verbose=0,
+        callbacks=[hvk.callbacks.LearningRateWarmupCallback(
+            initial_lr=0.1, warmup_epochs=2
+        )],
+    )
+    # per-batch LR changes temporarily rescale momentum (Goyal et al.
+    # correction) and must restore it after every batch
+    assert abs(float(model.optimizer.momentum) - 0.9) < 1e-9
