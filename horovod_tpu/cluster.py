@@ -91,6 +91,7 @@ def run_on_cluster(
     start_timeout: float = START_TIMEOUT_DEFAULT,
     job_timeout: Optional[float] = None,
     env: Optional[Dict[str, str]] = None,
+    driver_host: Optional[str] = None,
 ):
     """Run ``fn`` as a ``num_proc``-rank horovod_tpu job inside cluster
     task slots; returns the per-rank results in rank order (reference
@@ -99,31 +100,72 @@ def run_on_cluster(
     ``start_timeout`` bounds task START-UP (scheduling + registration —
     the reference's start_timeout semantics, spark/runner.py); the
     training function itself may run as long as it likes unless
-    ``job_timeout`` is set.
+    ``job_timeout`` is set.  ``driver_host`` overrides the advertised
+    driver address for networks where the outbound-interface probe picks
+    the wrong NIC.
 
     ``executor(num_tasks, driver_addr, secret)`` must arrange for
-    :func:`task_main`-equivalent execution in each slot and may return an
-    object with ``.join()``/``.check()`` for error propagation.
+    :func:`task_main`-equivalent execution in each slot; returning an
+    object with ``failed()`` / ``join()`` / ``terminate()`` gives the
+    driver fast failure detection and cleanup.
     """
-    server = KVStoreServer(secret=(secret := make_secret()))
+    # Bind every interface and advertise the outbound-interface address:
+    # task slots generally live on OTHER hosts (same logic as the
+    # launcher's KV server, run/api.py bind_all=not all_local; the probe
+    # address is never contacted — routable_ip uses a connected UDP
+    # socket only to pick the interface).
+    server = KVStoreServer(secret=(secret := make_secret()), bind_all=True)
     port = server.start()
-    addr = f"{routable_ip('127.0.0.1')}:{port}"
+    advertised = f"{driver_host or routable_ip('192.0.2.1')}:{port}"
     from .run.api import _pickle_func  # noqa: PLC0415
 
-    kv = KVStoreClient(addr, secret)
+    kv = KVStoreClient(f"127.0.0.1:{port}", secret)
     kv.put("job", "program", _pickle_func(fn, args, kwargs or {}))
     kv.put("job", "env", pickle.dumps(env or {}))
 
-    handle = executor(num_proc, addr, secret)
-    deadline = time.monotonic() + start_timeout
+    handle = executor(num_proc, advertised, secret)
+
+    def posted_failure():
+        """A task that raised posts its traceback BEFORE exiting; that
+        diagnostic must win over the generic died-without-result error."""
+        for j in range(num_proc):
+            raw = kv.get("result", str(j))
+            if raw is not None:
+                ok, value = pickle.loads(raw)
+                if not ok:
+                    return j, value
+        return None
+
+    def wait_kv(scope: str, key: str, deadline, what: str) -> bytes:
+        """Poll the KV in short slices, interleaving executor-death checks
+        so a crashed slot fails the job promptly instead of burning the
+        whole timeout."""
+        while True:
+            try:
+                return kv.wait(scope, key, timeout=5.0)
+            except TimeoutError:
+                pass
+            failed = getattr(handle, "failed", None)
+            if failed is not None and failed():
+                post = posted_failure()
+                if post is not None:
+                    j, tb = post
+                    raise RuntimeError(f"cluster task {j} raised:\n{tb}")
+                raise RuntimeError(
+                    f"a cluster task died during {what} without reporting "
+                    "a result (see its slot's logs)"
+                )
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"cluster {what} timed out waiting for {scope}/{key}"
+                )
+
     try:
         # 1. registration (reference: driver.task_host_hash_indices)
+        start_deadline = time.monotonic() + start_timeout
         task_hosts: Dict[int, str] = {}
         for i in range(num_proc):
-            raw = kv.wait(
-                "register", str(i),
-                timeout=max(deadline - time.monotonic(), 1.0),
-            )
+            raw = wait_kv("register", str(i), start_deadline, "start-up")
             task_hosts[i] = pickle.loads(raw)["host_hash"]
         # 2. rank assignment, published per task
         slots = assign_ranks(task_hosts)
@@ -137,33 +179,25 @@ def run_on_cluster(
         )
         results = [None] * num_proc
         for i in range(num_proc):
-            while True:
-                # KV first: a task that raised posts its traceback BEFORE
-                # exiting non-zero, and that diagnostic must win over the
-                # generic died-without-result error.
-                try:
-                    raw = kv.wait("result", str(i), timeout=10.0)
-                    break
-                except TimeoutError:
-                    pass
-                if job_deadline and time.monotonic() > job_deadline:
-                    raise TimeoutError(
-                        f"cluster job exceeded job_timeout={job_timeout}s"
-                    )
-                failed = getattr(handle, "failed", None)
-                if failed is not None and failed():
-                    raise RuntimeError(
-                        f"cluster task {i} died before reporting a result "
-                        "(see its slot's logs)"
-                    )
-            ok, value = pickle.loads(raw)
+            ok, value = pickle.loads(
+                wait_kv("result", str(i), job_deadline, "job")
+            )
             if not ok:
                 raise RuntimeError(
                     f"cluster task {i} (rank {slots[i]['rank']}) raised:\n"
                     f"{value}"
                 )
             results[slots[i]["rank"]] = pickle.loads(value)
-        return results
+    except BaseException:
+        # Error path: peers may be blocked mid-negotiation on the dead
+        # rank — tear the slots down rather than joining forever.
+        terminate = getattr(handle, "terminate", None)
+        if terminate is not None:
+            try:
+                terminate()
+            except Exception:
+                pass
+        raise
     finally:
         joiner = getattr(handle, "join", None)
         if joiner is not None:
@@ -172,6 +206,7 @@ def run_on_cluster(
             except Exception:
                 pass
         server.stop()
+    return results
 
 
 # ---------------------------------------------------------------------------
@@ -203,12 +238,17 @@ def task_main(index: int, driver_addr: str, secret: str) -> None:
         })
         # rank 0 hosts the jax.distributed coordinator; everyone else
         # learns its address through the driver KV (≙ the reference's
-        # task-to-task address registration, spark/runner.py:193-199)
+        # task-to-task address registration, spark/runner.py:193-199).
+        # The reserving socket stays OPEN (SO_REUSEADDR) until just before
+        # the user fn runs, shrinking the port-reuse window to the init
+        # prologue rather than the whole fan-out of the address.
+        reserve = None
         if slot["rank"] == 0:
-            with socket.socket() as s:
-                s.bind(("", 0))
-                coord = f"{routable_ip(driver_addr.rsplit(':', 1)[0])}:" \
-                        f"{s.getsockname()[1]}"
+            reserve = socket.socket()
+            reserve.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            reserve.bind(("", 0))
+            coord = f"{routable_ip(driver_addr.rsplit(':', 1)[0])}:" \
+                    f"{reserve.getsockname()[1]}"
             kv.put("job", "coordinator", coord.encode())
         else:
             coord = kv.wait("job", "coordinator", timeout=600).decode()
@@ -217,6 +257,8 @@ def task_main(index: int, driver_addr: str, secret: str) -> None:
         fn, args, kwargs = cloudpickle.loads(
             kv.wait("job", "program", timeout=60)
         )
+        if reserve is not None:
+            reserve.close()
         result = fn(*args, **kwargs)
         kv.put("result", str(index),
                pickle.dumps((True, pickle.dumps(result))))
@@ -254,9 +296,27 @@ class _LocalHandle:
     def __init__(self, procs: List[subprocess.Popen]):
         self.procs = procs
 
-    def join(self) -> None:
+    def join(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
         for p in self.procs:
-            p.wait()
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def terminate(self) -> None:
+        """Tear down surviving slots (error path: peers may be blocked
+        mid-negotiation on a dead rank forever)."""
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 5.0
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
 
     def failed(self) -> bool:
         """True when any slot process exited non-zero (a task that died
@@ -312,14 +372,33 @@ def spark_executor(spark_context=None):
             task_main(index, driver_addr, secret)
             yield index
 
-        thread = threading.Thread(
-            target=lambda: sc.parallelize(
-                range(num_tasks), num_tasks
-            ).mapPartitionsWithIndex(_task).collect(),
-            daemon=True,
-        )
-        thread.start()
-        return thread
+        class _SparkHandle:
+            """Exposes failed()/join() like _LocalHandle so the driver
+            detects Spark-side task death (stage failure, executor OOM)
+            instead of polling forever."""
+
+            def __init__(self):
+                self.exc: Optional[BaseException] = None
+                self.thread = threading.Thread(target=self._run, daemon=True)
+                self.thread.start()
+
+            def _run(self):
+                try:
+                    sc.parallelize(
+                        range(num_tasks), num_tasks
+                    ).mapPartitionsWithIndex(_task).collect()
+                except BaseException as e:  # noqa: BLE001
+                    self.exc = e
+
+            def failed(self) -> bool:
+                return self.exc is not None or (
+                    not self.thread.is_alive() and self.exc is not None
+                )
+
+            def join(self, timeout: float = 30.0) -> None:
+                self.thread.join(timeout)
+
+        return _SparkHandle()
 
     return launch
 

@@ -54,6 +54,20 @@ if shutil.which("make") and shutil.which("g++"):
 jax.config.update("jax_platforms", "cpu")
 
 
+@pytest.fixture(params=["python", "native"])
+def engine_env(request):
+    """Run a cross-process test under BOTH eager engines: the pure-Python
+    one (runtime/engine.py) and the native C++ one (cpp/hvdtpu via
+    runtime/native.py) — same tests, same assertions, mirroring how the
+    reference CI crosses its {mpi, gloo} backends (SURVEY.md §4)."""
+    if request.param == "native":
+        from horovod_tpu.runtime.native import native_available
+
+        if not native_available():
+            pytest.skip("native library not built (make -C cpp)")
+    return {"HVDTPU_EAGER_ENGINE": request.param}
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _world():
     import horovod_tpu as hvd

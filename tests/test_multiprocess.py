@@ -15,18 +15,7 @@ import horovod_tpu.run as hvdrun
 pytestmark = pytest.mark.multiprocess
 
 
-@pytest.fixture(params=["python", "native"])
-def engine_env(request):
-    """Run each cross-process test under BOTH eager engines: the pure-Python
-    one (runtime/engine.py) and the native C++ one (cpp/hvdtpu via
-    runtime/native.py) — same tests, same assertions, mirroring how the
-    reference CI crosses its {mpi, gloo} backends (SURVEY.md §4)."""
-    if request.param == "native":
-        from horovod_tpu.runtime.native import native_available
-
-        if not native_available():
-            pytest.skip("native library not built (make -C cpp)")
-    return {"HVDTPU_EAGER_ENGINE": request.param}
+# engine_env fixture (python/native cross) lives in tests/conftest.py.
 
 
 def _world_fn():
@@ -1142,3 +1131,95 @@ def test_keras_fit_across_processes():
     np.testing.assert_allclose(
         results[0]["loss"], results[1]["loss"], rtol=1e-6
     )
+
+
+# ---------------------------------------------------------------------------
+# dtype x dims grid across processes (reference test_torch.py/test_tensorflow
+# strategy: allreduce/allgather/broadcast over dtype and dimension grids)
+# ---------------------------------------------------------------------------
+
+
+def _dtype_grid_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = {}
+    dtypes = ["float32", "float64", "int32", "int64", "uint8", "float16",
+              "bfloat16"]
+    for dt in dtypes:
+        if dt == "bfloat16":
+            import ml_dtypes
+
+            npdt = np.dtype(ml_dtypes.bfloat16)
+        else:
+            npdt = np.dtype(dt)
+        for dim in (1, 2, 3):
+            shape = (2,) * dim
+            x = (np.arange(2 ** dim).reshape(shape) % 3 + r).astype(npdt)
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"grid_{dt}_{dim}")
+            out[f"{dt}_{dim}"] = np.asarray(s, np.float64).tolist()
+    # int64 beyond float64's exact range must survive the wire bit-exactly
+    big = np.asarray([2 ** 60 + 1, -(2 ** 61)], np.int64)
+    s = hvd.allreduce(big, op=hvd.Sum, name="grid_big_i64")
+    out["big_i64"] = [int(v) for v in np.asarray(s)]
+    # scalar (0-d) allreduce and broadcast round-trip with shape intact
+    sc = hvd.allreduce(np.float32(r + 1.0), op=hvd.Sum, name="grid_scalar")
+    out["scalar"] = [float(np.asarray(sc).reshape(-1)[0]),
+                     list(np.asarray(sc).shape)]
+    hvd.shutdown()
+    return out
+
+
+def test_dtype_dims_grid_across_processes(engine_env):
+    results = hvdrun.run(_dtype_grid_fn, np=2, use_cpu=True, timeout=240,
+                         env=engine_env)
+    for res in results:
+        for dt in ["float32", "float64", "int32", "int64", "uint8",
+                   "float16", "bfloat16"]:
+            for dim in (1, 2, 3):
+                base = (np.arange(2 ** dim).reshape((2,) * dim) % 3)
+                want = (2 * base + 1).astype(np.float64)  # ranks 0+1
+                got = np.asarray(res[f"{dt}_{dim}"])
+                np.testing.assert_allclose(got, want.tolist(), rtol=1e-2)
+        assert res["big_i64"] == [2 ** 61 + 2, -(2 ** 62)]
+        assert res["scalar"][0] == 3.0
+
+
+def _device_disabled_fn():
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu._engine_registry import get_engine
+
+    hvd.init()
+    r = hvd.rank()
+    x = jnp.full((4,), float(r + 1), jnp.float32)
+    s = hvd.allreduce(x, op=hvd.Sum)
+    eng = get_engine()
+    out = {
+        "sum": np.asarray(s).tolist(),
+        "is_device_result": isinstance(s, jax.Array),
+        "device_data_ops": eng.stats["device_data_ops"],
+    }
+    hvd.shutdown()
+    return out
+
+
+def test_eager_device_kill_switch_demotes_globally():
+    """HVDTPU_EAGER_DEVICE=0 disables the device plane: jax payloads still
+    work (host plane), results still come back as device arrays, and no
+    device-plane collective runs — on any rank, coherently."""
+    import numpy as np
+
+    results = hvdrun.run(
+        _device_disabled_fn, np=2, use_cpu=True, timeout=180,
+        env={"HVDTPU_EAGER_ENGINE": "python", "HVDTPU_EAGER_DEVICE": "0"},
+    )
+    for r in results:
+        assert r["sum"] == [3.0] * 4
+        assert r["is_device_result"]  # synchronize still restores device
+        assert r["device_data_ops"] == 0

@@ -12,14 +12,7 @@ import horovod_tpu.run as hvdrun
 pytestmark = pytest.mark.multiprocess
 
 
-@pytest.fixture(params=["python", "native"])
-def engine_env(request):
-    if request.param == "native":
-        from horovod_tpu.runtime.native import native_available
-
-        if not native_available():
-            pytest.skip("native library not built (make -C cpp)")
-    return {"HVDTPU_EAGER_ENGINE": request.param}
+# engine_env fixture (python/native cross) lives in tests/conftest.py.
 
 
 def _soak_fn(steps):
