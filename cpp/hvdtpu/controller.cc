@@ -182,6 +182,32 @@ ResponseList Controller::ComputeResponseList(
   }
   out.cache_frozen = !joined_ranks_.empty();
 
+  // Divergence repair: a tuner cache toggle can land on opposite sides of
+  // a straggler enqueue, so one rank classifies a tensor as a cache hit
+  // (slot vote) while another negotiates it as a full request.  Neither
+  // side completes alone — the slot waits on the requesting rank, the
+  // request waits on the voting rank.  Rank 0's replicated cache knows the
+  // slot's identity, so reconcile: fold each voting rank into the request
+  // table using the cached request params, and drop the slot vote.
+  if (cache_ != nullptr) {
+    for (auto it = slot_ready_.begin(); it != slot_ready_.end();) {
+      const Request* cached = cache_->RequestFor(it->first);
+      auto tit = cached ? table_.find(cached->tensor_name) : table_.end();
+      if (tit == table_.end()) {
+        ++it;
+        continue;
+      }
+      for (int32_t r : it->second) {
+        Request req = *cached;
+        req.request_rank = r;
+        tit->second.requests.emplace(r, std::move(req));
+        if (timeline_)
+          timeline_->NegotiateRankReady(cached->tensor_name, r);
+      }
+      it = slot_ready_.erase(it);
+    }
+  }
+
   int needed = cfg_.world_size - static_cast<int>(joined_ranks_.size());
 
   // Cache fast path: slots every non-joined rank marked ready.

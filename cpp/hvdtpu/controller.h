@@ -59,6 +59,10 @@ class Controller {
   // from IncrementTensorCount / response construction).
   void SetTimeline(Timeline* t) { timeline_ = t; }
 
+  // Rank 0's replicated response cache, consulted to reconcile slot votes
+  // against full requests for the same tensor (divergence repair).
+  void SetCache(const ResponseCache* c) { cache_ = c; }
+
  private:
   struct TableEntry {
     std::map<int32_t, Request> requests;  // rank -> request
@@ -71,6 +75,7 @@ class Controller {
   void CheckStalls(bool* should_shutdown);
 
   Timeline* timeline_ = nullptr;
+  const ResponseCache* cache_ = nullptr;
   ControllerConfig cfg_;
   std::unordered_map<std::string, TableEntry> table_;
   std::map<uint32_t, std::set<int32_t>> slot_ready_;  // cache slot -> ranks

@@ -114,6 +114,7 @@ class Engine {
       cfg.stall_warn_secs = stall_warn;
       cfg.stall_shutdown_secs = stall_shutdown;
       controller_ = std::make_unique<Controller>(cfg);
+      controller_->SetCache(cache_.get());
       timeline_.Initialize(timeline_path, rank_, timeline_cycles);
       controller_->SetTimeline(timeline_.enabled() ? &timeline_ : nullptr);
     }
@@ -704,6 +705,12 @@ class Engine {
   long long FusionBytes() const { return fusion_bytes_.load(); }
   double CycleMs() const { return cycle_ms_.load(); }
 
+  // Fault injection (tests only): flip THIS rank's cache gate without the
+  // params sync, recreating the transient divergence a tuner cache toggle
+  // can cause when an enqueue straggles across the flip cycle.  Production
+  // toggles must go through SetParams, which synchronizes all ranks.
+  void InjectLocalCacheEnabled(bool on) { cache_enabled_.store(on); }
+
  private:
 
   TcpMesh mesh_;
@@ -828,6 +835,10 @@ void hvdtpu_set_params(long long fusion_bytes, double cycle_ms,
 }
 
 long long hvdtpu_perf_bytes() { return hvdtpu::Engine::Get().PerfBytes(); }
+
+void hvdtpu_inject_local_cache_enabled(int on) {
+  hvdtpu::Engine::Get().InjectLocalCacheEnabled(on != 0);
+}
 
 long long hvdtpu_get_fusion_bytes() {
   return hvdtpu::Engine::Get().FusionBytes();

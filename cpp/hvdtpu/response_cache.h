@@ -41,6 +41,16 @@ class ResponseCache {
   // The cached response in `slot` (valid until the next Put).
   const Response& Get(uint32_t slot) const { return slots_[slot].response; }
 
+  // The request stored in `slot`, or nullptr when the slot is not live.
+  // Used by the coordinator's divergence repair (see Controller): rank 0's
+  // copy of the (globally coherent) cache identifies which tensor a
+  // worker's slot vote refers to.
+  const Request* RequestFor(uint32_t slot) const {
+    return (slot < slots_.size() && slots_[slot].live)
+               ? &slots_[slot].request
+               : nullptr;
+  }
+
   // Mark slot most-recently-used (call when a cached response executes).
   void Touch(uint32_t slot);
 

@@ -29,6 +29,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import env as envmod
+
 # Continuous search space (log-ish ranges chosen around the reference
 # defaults: fusion 64 MB, cycle 5 ms — operations.cc:419,427).
 FUSION_BOUNDS_MB = (1.0, 128.0)
@@ -214,11 +216,28 @@ class ParameterManager:
         enabled: bool,
         initial: TunedParams,
         log_path: Optional[str] = None,
-        warmup_samples: int = DEFAULT_WARMUP_SAMPLES,
-        steps_per_sample: int = DEFAULT_STEPS_PER_SAMPLE,
-        samples_per_category: int = DEFAULT_BAYES_SAMPLES_PER_CATEGORY,
+        warmup_samples: Optional[int] = None,
+        steps_per_sample: Optional[int] = None,
+        samples_per_category: Optional[int] = None,
         categories: Optional[List[Dict[str, bool]]] = None,
     ):
+        # Sampling-window knobs resolve through the reference's env names
+        # (common.h:67-69 HOROVOD_AUTOTUNE_{WARMUP_SAMPLES,STEPS_PER_SAMPLE,
+        # BAYES_OPT_MAX_SAMPLES}) so tests and deployments can trade tuning
+        # latency for sample quality deterministically.
+        if warmup_samples is None:
+            warmup_samples = envmod.env_int(
+                envmod.AUTOTUNE_WARMUP_SAMPLES, DEFAULT_WARMUP_SAMPLES
+            )
+        if steps_per_sample is None:
+            steps_per_sample = envmod.env_int(
+                envmod.AUTOTUNE_STEPS_PER_SAMPLE, DEFAULT_STEPS_PER_SAMPLE
+            )
+        if samples_per_category is None:
+            samples_per_category = envmod.env_int(
+                envmod.AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
+                DEFAULT_BAYES_SAMPLES_PER_CATEGORY,
+            )
         # `categories` must list only configurations the owning engine
         # actually consumes — every category costs a full Bayesian sweep,
         # so exploring knobs with no consumer wastes 1/len(categories) of

@@ -383,6 +383,18 @@ class EagerEngine:
                 if self.cache_enabled
                 else (rcache.MISS, -1)
             )
+            if (
+                status == rcache.HIT
+                and req.key() in self._controller.message_table
+            ):
+                # Divergence repair, part 1: a peer already negotiated this
+                # name through the slow path (a tuner cache toggle can land
+                # on opposite sides of a straggler enqueue, so ranks may
+                # classify the same tensor differently).  Arming would
+                # deadlock — the slot vote waits on the peer while the
+                # peer's table entry waits on us — so fall through to the
+                # slow path with everyone else.
+                status = rcache.MISS
             if status == rcache.HIT:
                 self._armed[slot] = req
                 self._armed_since[slot] = now
@@ -462,6 +474,18 @@ class EagerEngine:
                         if stale is not None:
                             with self._lock:
                                 self._pending.append(stale)
+                    elif st == rcache.HIT and slot in self._armed:
+                        # Divergence repair, part 2 (see the MISS
+                        # reclassification above): a peer negotiated this
+                        # name slow-path while we already hold it armed.
+                        # The slot vote can never complete (the peer's bit
+                        # will not arrive), so move our armed request back
+                        # through negotiation; the peer's table entry then
+                        # completes on our next payload.
+                        stale = self._armed.pop(slot)
+                        self._armed_since.pop(slot, None)
+                        with self._lock:
+                            self._pending.append(stale)
             # Parameter sync: every rank (rank 0 included — it may have
             # tuned last cycle) applies the params riding rank 0's list.
             if all_lists[0].tuned_params is not None:

@@ -103,6 +103,8 @@ def _load() -> ctypes.CDLL:
     lib.hvdtpu_perf_bytes.restype = ctypes.c_longlong
     lib.hvdtpu_get_fusion_bytes.restype = ctypes.c_longlong
     lib.hvdtpu_get_cycle_ms.restype = ctypes.c_double
+    # fault injection (tests): rank-local cache gate flip — see engine.cc
+    lib.hvdtpu_inject_local_cache_enabled.argtypes = [ctypes.c_int]
     return lib
 
 

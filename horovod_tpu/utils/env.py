@@ -22,6 +22,11 @@ CACHE_CAPACITY = "HVDTPU_CACHE_CAPACITY"
 HIERARCHICAL_ALLREDUCE = "HVDTPU_HIERARCHICAL_ALLREDUCE"
 AUTOTUNE = "HVDTPU_AUTOTUNE"
 AUTOTUNE_LOG = "HVDTPU_AUTOTUNE_LOG"
+# Sampling-window knobs (reference common.h:67-69
+# HOROVOD_AUTOTUNE_{WARMUP_SAMPLES,STEPS_PER_SAMPLE,BAYES_OPT_MAX_SAMPLES}).
+AUTOTUNE_WARMUP_SAMPLES = "HVDTPU_AUTOTUNE_WARMUP_SAMPLES"
+AUTOTUNE_STEPS_PER_SAMPLE = "HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE"
+AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 LOG_LEVEL = "HVDTPU_LOG_LEVEL"
 # Device-resident eager data plane (no reference analog by name: the
 # reference's equivalent switch is compile-time HOROVOD_GPU_ALLREDUCE).
