@@ -58,7 +58,8 @@ def peak_flops_per_chip(device, dtype: str) -> float:
     return peak
 
 
-def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 224):
+def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 224,
+               s2d_stem: bool = False):
     """Build the benchmark's jitted training step and its initial state.
 
     Shared by bench.py (timing) and scripts/profile_bench.py (tracing) so the
@@ -78,13 +79,17 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
     hvd.init()
     n_chips = hvd.num_devices()
 
-    compute_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    compute_dtype = jnp.float32 if dtype == "fp32" else jnp.bfloat16
+    act_store = jnp.float8_e4m3fn if dtype == "fp8" else None
     model_cls = {
         "resnet50": models.ResNet50,
         "resnet101": models.ResNet101,
         "resnet18": models.ResNet18,
     }[model_name]
-    model = model_cls(num_classes=1000, compute_dtype=compute_dtype)
+    model = model_cls(
+        num_classes=1000, compute_dtype=compute_dtype, s2d_stem=s2d_stem,
+        act_store_dtype=act_store,
+    )
 
     rng = jax.random.PRNGKey(0)
     global_batch = batch_size * n_chips
@@ -150,12 +155,16 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50",
                         choices=["resnet50", "resnet101", "resnet18"])
-    parser.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"],
-                        help="compute dtype (params/accumulators stay fp32)")
+    parser.add_argument("--dtype", default="bf16",
+                        choices=["bf16", "fp32", "fp8"],
+                        help="compute dtype (params/accumulators stay fp32; "
+                        "fp8 = bf16 compute with e4m3 activation storage)")
     parser.add_argument("--batch-size", type=int, default=128)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--s2d-stem", action="store_true",
+                        help="space-to-depth stem (MLPerf TPU recipe)")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (dev mode; numbers not comparable)")
     args = parser.parse_args()
@@ -170,7 +179,8 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
 
     step, state, static = build_step(
-        args.model, args.dtype, args.batch_size, args.image_size
+        args.model, args.dtype, args.batch_size, args.image_size,
+        s2d_stem=args.s2d_stem,
     )
     params, batch_stats, opt_state, images, labels = state
     n_chips = static["n_chips"]

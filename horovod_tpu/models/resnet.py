@@ -95,6 +95,19 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC space-to-depth: (N, H, W, C) -> (N, H/b, W/b, C*b*b).
+
+    The MLPerf-era TPU stem trick: folding 2x2 spatial patches into channels
+    turns the 7x7/s2 stem conv (3 input channels — 3/128ths of an MXU column)
+    into a 4x4/s1 conv over 12 channels, quadrupling stem MXU utilization.
+    """
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, c * block * block)
+
+
 class ResNet(nn.Module):
     """Configurable ResNet (stage sizes select 18/34/50/101/152)."""
 
@@ -104,6 +117,15 @@ class ResNet(nn.Module):
     num_filters: int = 64
     compute_dtype: jnp.dtype = jnp.bfloat16
     axis_name: Optional[str] = None  # set for cross-replica batch norm
+    # Space-to-depth stem (MLPerf TPU ResNet recipe): same receptive-field
+    # family as the 7x7/s2 stem but MXU-dense. Off by default so the
+    # headline model matches the reference architecture exactly.
+    s2d_stem: bool = False
+    # Inter-block activation storage dtype (e.g. jnp.float8_e4m3fn): the
+    # step is HBM-bandwidth-bound (docs/performance.md), so storing the
+    # block-boundary activations at 1 B/elt halves the dominant traffic.
+    # Lossy — changes the numerics contract — so opt-in only.
+    act_store_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -129,18 +151,42 @@ class ResNet(nn.Module):
                 dtype=self.compute_dtype,
             )
         conv = partial(nn.Conv, dtype=self.compute_dtype, param_dtype=jnp.float32)
+        if self.act_store_dtype is not None:
+            # Quantized ReLU: every conv input (= every ReLU output) is
+            # materialized at 1 B/elt in HBM; convs read f8 and widen
+            # in-register to the compute dtype.  (Quantizing the backward
+            # cotangent to e5m2 via a custom VJP was tried and rejected:
+            # it stalled XLA:TPU compilation for >9 minutes.)
+            def act(y):
+                return jnp.asarray(
+                    jnp.asarray(nn.relu(y), self.act_store_dtype),
+                    self.compute_dtype,
+                )
+        else:
+            act = nn.relu
 
         x = jnp.asarray(x, self.compute_dtype)
-        x = conv(
-            self.num_filters,
-            (7, 7),
-            (2, 2),
-            padding=[(3, 3), (3, 3)],
-            use_bias=False,
-            name="conv_init",
-        )(x)
+        if self.s2d_stem:
+            x = space_to_depth(x, 2)
+            x = conv(
+                self.num_filters,
+                (4, 4),
+                (1, 1),
+                padding=[(1, 2), (1, 2)],
+                use_bias=False,
+                name="conv_init",
+            )(x)
+        else:
+            x = conv(
+                self.num_filters,
+                (7, 7),
+                (2, 2),
+                padding=[(3, 3), (3, 3)],
+                use_bias=False,
+                name="conv_init",
+            )(x)
         x = norm(name="bn_init")(x)
-        x = nn.relu(x)
+        x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
@@ -150,6 +196,7 @@ class ResNet(nn.Module):
                     strides=strides,
                     conv=conv,
                     norm=norm,
+                    act=act,
                     name=f"stage{i+1}_block{j+1}",
                 )(x)
         x = jnp.mean(x, axis=(1, 2))

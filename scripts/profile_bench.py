@@ -69,6 +69,7 @@ def summarize_xplane(logdir: str) -> None:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="/tmp/hvdtpu_trace")
+    parser.add_argument("--dtype", default="bf16")
     parser.add_argument("--batch-size", type=int, default=128)
     parser.add_argument("--iters", type=int, default=5)
     parser.add_argument("--summarize-only", action="store_true")
@@ -82,7 +83,7 @@ def main() -> int:
 
     from bench import build_step  # the EXACT step bench.py times
 
-    step, state, _ = build_step("resnet50", "bf16", args.batch_size)
+    step, state, _ = build_step("resnet50", args.dtype, args.batch_size)
     params, batch_stats, opt_state, images, labels = state
     # warmup/compile
     for _ in range(3):

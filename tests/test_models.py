@@ -60,6 +60,59 @@ def test_resnet_bf16_compute_fp32_params():
         assert leaf.dtype == jnp.float32
 
 
+def test_resnet_s2d_stem_matches_shapes():
+    """The space-to-depth stem (MLPerf TPU recipe) is architecturally
+    equivalent: same output shape, same downstream stage geometry."""
+    x = jnp.ones((2, 64, 64, 3))
+    base = models.ResNet18(num_classes=10)
+    s2d = models.ResNet18(num_classes=10, s2d_stem=True)
+    vb = base.init(jax.random.PRNGKey(0), x, train=False)
+    vs = s2d.init(jax.random.PRNGKey(0), x, train=False)
+    assert base.apply(vb, x, train=False).shape == (2, 10)
+    assert s2d.apply(vs, x, train=False).shape == (2, 10)
+    # stem conv consumes the folded 12-channel input at stride 1
+    assert vs["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 64)
+    # every non-stem layer is unchanged
+    for k in vb["params"]:
+        if k != "conv_init":
+            assert (
+                jax.tree_util.tree_map(
+                    lambda p: p.shape, vb["params"][k]
+                )
+                == jax.tree_util.tree_map(
+                    lambda p: p.shape, vs["params"][k]
+                )
+            ), k
+
+
+def test_resnet_fp8_activation_storage_trains():
+    """act_store_dtype=float8_e4m3fn: forward/backward stay finite and
+    produce nonzero grads — the lossy storage is numerically viable."""
+    model = models.ResNet18(
+        num_classes=10,
+        compute_dtype=jnp.bfloat16,
+        act_store_dtype=jnp.float8_e4m3fn,
+    )
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+
+    def loss_fn(p):
+        logits, _ = model.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.zeros(2, jnp.int32)
+        ).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+    assert np.isfinite(float(loss))
+    assert any(
+        float(jnp.abs(g).max()) > 0
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+
+
 def test_graft_entry_single_device():
     import __graft_entry__ as g
 
