@@ -78,16 +78,25 @@ def main() -> int:
             ).mean()
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        # sparse embedding-gradient exchange, under tracing -> all_gather
+        # sparse embedding-gradient exchange, under tracing -> all_gather.
+        # Only rows the batch actually touched travel: unique(size=K) keeps
+        # the shape static under jit (K = batch token count, << vocab);
+        # fill slots carry zero values so their scatter-add is a no-op.
         for path, leaf in jax.tree_util.tree_flatten_with_path(grads)[0]:
             if "embed" in str(path).lower() and leaf.ndim == 2:
-                used = jnp.arange(leaf.shape[0])  # static under jit
+                used = jnp.unique(
+                    toks, size=toks.size, fill_value=-1
+                )
+                valid = used >= 0
+                rows = jnp.where(valid, used, 0)
                 sparse = IndexedSlices(
-                    values=leaf[used], indices=used,
+                    values=leaf[rows] * valid[:, None],
+                    indices=rows,
                     dense_shape=leaf.shape,
                 )
                 dense = to_dense(allreduce_sparse(sparse))
-                del dense  # dense grads below reduce the same leaf
+                del dense  # demonstration only: K-row traffic, and the
+                #            dense reduce below owns the real update
                 break
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, hvd.allreduce(loss)

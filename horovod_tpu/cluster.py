@@ -391,9 +391,7 @@ def spark_executor(spark_context=None):
                     self.exc = e
 
             def failed(self) -> bool:
-                return self.exc is not None or (
-                    not self.thread.is_alive() and self.exc is not None
-                )
+                return self.exc is not None
 
             def join(self, timeout: float = 30.0) -> None:
                 self.thread.join(timeout)
