@@ -58,6 +58,72 @@ def peak_flops_per_chip(device, dtype: str) -> float:
     return peak
 
 
+def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int):
+    """GPT causal-LM training step (flash attention) — the long-context
+    counterpart of the ResNet bench.  Returns ``(step, state, static)``
+    like ``build_step``; throughput is reported in tokens/sec/chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.transformer import gpt
+    from horovod_tpu.optim import DistributedOptimizer
+
+    hvd.init()
+    n_chips = hvd.num_devices()
+
+    if dtype == "fp8":
+        # No e4m3 activation-storage path exists for the transformer yet
+        # (TransformerConfig has no act_store_dtype); silently running
+        # bf16 under an fp8 label would corrupt the benchmark series.
+        raise SystemExit("--dtype fp8 is resnet-only (e4m3 act storage)")
+    compute_dtype = jnp.float32 if dtype == "fp32" else jnp.bfloat16
+    model = gpt(size, dtype=compute_dtype, max_len=seq_len)
+    vocab = model.cfg.vocab_size
+
+    global_batch = batch_size * n_chips
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, vocab, size=(global_batch, seq_len + 1)
+        ),
+        jnp.int32,
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens[:2, :-1])
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    tx = DistributedOptimizer(optax.adamw(1e-4))
+    opt_state = tx.init(params)
+
+    def local_step(params, opt_state, toks):
+        def loss_fn(p):
+            logits = model.apply(p, toks[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, toks[:, 1:]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = hvd.mesh("flat")
+    step = jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P(hvd.DP_AXIS)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    state = (params, opt_state, tokens)
+    return step, state, {"n_chips": n_chips, "global_batch": global_batch}
+
+
 def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 224,
                s2d_stem: bool = False):
     """Build the benchmark's jitted training step and its initial state.
@@ -154,13 +220,17 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50",
-                        choices=["resnet50", "resnet101", "resnet18"])
+                        choices=["resnet50", "resnet101", "resnet18",
+                                 "gpt-small", "gpt-medium", "gpt-large"])
     parser.add_argument("--dtype", default="bf16",
                         choices=["bf16", "fp32", "fp8"],
                         help="compute dtype (params/accumulators stay fp32; "
                         "fp8 = bf16 compute with e4m3 activation storage)")
-    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=None,
+                        help="per-chip batch (default: 128 resnet, 8 gpt)")
     parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--seq-len", type=int, default=1024,
+                        help="sequence length for the gpt models")
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--s2d-stem", action="store_true",
@@ -178,11 +248,21 @@ def main() -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
 
-    step, state, static = build_step(
-        args.model, args.dtype, args.batch_size, args.image_size,
-        s2d_stem=args.s2d_stem,
-    )
-    params, batch_stats, opt_state, images, labels = state
+    is_gpt = args.model.startswith("gpt-")
+    if args.batch_size is None:
+        args.batch_size = 8 if is_gpt else 128
+    if is_gpt:
+        step, state, static = build_gpt_step(
+            args.model[len("gpt-"):], args.dtype, args.batch_size,
+            args.seq_len,
+        )
+        carry, const = state[:-1], state[-1:]
+    else:
+        step, state, static = build_step(
+            args.model, args.dtype, args.batch_size, args.image_size,
+            s2d_stem=args.s2d_stem,
+        )
+        carry, const = state[:3], state[3:]
     n_chips = static["n_chips"]
     global_batch = static["global_batch"]
 
@@ -191,9 +271,7 @@ def main() -> int:
     # The AOT executable is also what we run (one compilation, not two);
     # cost_analysis is the post-SPMD-partitioning PER-DEVICE module, so
     # everything downstream is per-chip accounting.
-    compiled = step.lower(
-        params, batch_stats, opt_state, images, labels
-    ).compile()
+    compiled = step.lower(*carry, *const).compile()
     try:
         flops_per_step_per_chip = float(compiled.cost_analysis()["flops"])
     except Exception:
@@ -201,9 +279,7 @@ def main() -> int:
     step = compiled
 
     for _ in range(args.warmup):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
-        )
+        *carry, loss = step(*carry, *const)
     # device_get forces a real host round-trip: on experimental platforms
     # block_until_ready has been observed to return before execution
     # completes, which would make the timing fictitious.
@@ -211,38 +287,37 @@ def main() -> int:
 
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
-        )
+        *carry, loss = step(*carry, *const)
     final_loss = float(loss)
     elapsed = time.perf_counter() - t0
     assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
 
-    img_per_sec = global_batch * args.iters / elapsed
-    per_chip = img_per_sec / n_chips
+    items_per_batch = (
+        global_batch * args.seq_len if is_gpt else global_batch
+    )
+    per_chip = items_per_batch * args.iters / elapsed / n_chips
     peak = peak_flops_per_chip(jax.devices()[0], args.dtype)
     achieved_flops_per_chip = flops_per_step_per_chip * args.iters / elapsed
     mfu = achieved_flops_per_chip / peak
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"{args.model}_{args.dtype}_images_per_sec_per_chip"
-                ),
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(
-                    per_chip / BASELINE_IMG_PER_SEC_PER_ACCEL, 3
-                ),
-                "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
-                "flops_per_image": (
-                    round(flops_per_step_per_chip / args.batch_size / 1e9, 3)
-                    if np.isfinite(flops_per_step_per_chip) else None
-                ),
-                "device": jax.devices()[0].device_kind,
-            }
+    unit = "tokens/sec/chip" if is_gpt else "images/sec/chip"
+    out = {
+        "metric": f"{args.model}_{args.dtype}_{unit.replace('/', '_per_')}",
+        "value": round(per_chip, 2),
+        "unit": unit,
+        # the reference publishes no absolute LM throughput; the ratio is
+        # only meaningful for the conv-net headline (docs/benchmarks.rst:43)
+        "vs_baseline": (
+            None if is_gpt
+            else round(per_chip / BASELINE_IMG_PER_SEC_PER_ACCEL, 3)
+        ),
+        "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
+        "device": jax.devices()[0].device_kind,
+    }
+    if not is_gpt and np.isfinite(flops_per_step_per_chip):
+        out["flops_per_image"] = round(
+            flops_per_step_per_chip / args.batch_size / 1e9, 3
         )
-    )
+    print(json.dumps(out))
     return 0
 
 

@@ -80,12 +80,13 @@ def main() -> int:
         loss, grads = jax.value_and_grad(loss_fn)(params)
         # sparse embedding-gradient exchange, under tracing -> all_gather.
         # Only rows the batch actually touched travel: unique(size=K) keeps
-        # the shape static under jit (K = batch token count, << vocab);
-        # fill slots carry zero values so their scatter-add is a no-op.
+        # the shape static under jit, K = min(batch tokens, vocab) — the
+        # unique count can exceed neither, and real vocabularies dwarf a
+        # batch; fill slots carry zero values so their scatter-add no-ops.
         for path, leaf in jax.tree_util.tree_flatten_with_path(grads)[0]:
             if "embed" in str(path).lower() and leaf.ndim == 2:
                 used = jnp.unique(
-                    toks, size=toks.size, fill_value=-1
+                    toks, size=min(toks.size, leaf.shape[0]), fill_value=-1
                 )
                 valid = used >= 0
                 rows = jnp.where(valid, used, 0)

@@ -37,6 +37,22 @@ _ARG_ENV_MAP = {
     ),
     "autotune": (envmod.AUTOTUNE, "autotune.enabled"),
     "autotune_log_file": (envmod.AUTOTUNE_LOG, "autotune.log-file"),
+    "autotune_warmup_samples": (
+        envmod.AUTOTUNE_WARMUP_SAMPLES,
+        "autotune.warmup-samples",
+    ),
+    "autotune_steps_per_sample": (
+        envmod.AUTOTUNE_STEPS_PER_SAMPLE,
+        "autotune.steps-per-sample",
+    ),
+    "autotune_bayes_opt_max_samples": (
+        envmod.AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
+        "autotune.bayes-opt-max-samples",
+    ),
+    "autotune_gaussian_process_noise": (
+        envmod.AUTOTUNE_GP_NOISE,
+        "autotune.gaussian-process-noise",
+    ),
     "log_level": (envmod.LOG_LEVEL, "logging.level"),
 }
 

@@ -19,17 +19,33 @@ from typing import Dict, IO, List, Optional
 GRACEFUL_TERM_SECS = 5.0
 
 
-def _stream(pipe: IO[bytes], sink, prefix: bytes) -> None:
-    """Pump a child pipe to our stdout/stderr, rank-prefixed like
-    horovodrun's `[1]<stdout>` tagging."""
+def _stream(pipe: IO[bytes], sink, prefix: bytes,
+            tee: Optional[IO[bytes]] = None) -> None:
+    """Pump a child pipe to our stdout/stderr (when ``sink`` is set),
+    rank-prefixed like horovodrun's `[1]<stdout>` tagging; optionally tee
+    the raw (unprefixed) lines to a per-rank capture file (reference
+    MultiFile, gloo_run.py:130-143,204-217).  A closed console (e.g. the
+    launcher piped into `head`) stops only the console leg — the capture
+    file keeps draining, that durability being what --output-filename is
+    for."""
+    sink_ok = sink is not None
     try:
         for line in iter(pipe.readline, b""):
-            sink.buffer.write(prefix + line)
-            sink.flush()
-    except ValueError:
-        pass  # sink closed during interpreter shutdown
+            if sink_ok:
+                try:
+                    sink.buffer.write(prefix + line)
+                    sink.flush()
+                except ValueError:
+                    sink_ok = False  # console gone (shutdown / broken pipe)
+            if tee is not None:
+                tee.write(line)
+                tee.flush()
+            elif not sink_ok:
+                break  # no destination left; stop pumping
     finally:
         pipe.close()
+        if tee is not None:
+            tee.close()
 
 
 @dataclass
@@ -81,24 +97,47 @@ class ProcessSet:
         env: Dict[str, str],
         tag_output: bool = True,
         stdin_data: Optional[bytes] = None,
+        output_dir: Optional[str] = None,
+        num_proc: int = 1,
     ) -> None:
+        """``output_dir``: when set, each stream also lands in
+        ``<output_dir>/rank.<padded>/stdout|stderr`` (reference
+        --output-filename, gloo_run.py:204-217)."""
+        capture = tag_output or output_dir is not None
         popen = subprocess.Popen(
             cmd,
             env=env,
             stdin=subprocess.PIPE if stdin_data is not None else None,
-            stdout=subprocess.PIPE if tag_output else None,
-            stderr=subprocess.PIPE if tag_output else None,
+            stdout=subprocess.PIPE if capture else None,
+            stderr=subprocess.PIPE if capture else None,
             start_new_session=True,  # own process group for tree kill
         )
         if stdin_data is not None:
             popen.stdin.write(stdin_data)
             popen.stdin.close()
         threads = []
-        if tag_output:
-            for pipe, sink in ((popen.stdout, sys.stdout), (popen.stderr, sys.stderr)):
+        if capture:
+            tees: Dict[str, Optional[IO[bytes]]] = {"stdout": None, "stderr": None}
+            if output_dir is not None:
+                pad = max(len(str(num_proc - 1)), 1)
+                rank_dir = os.path.join(output_dir, f"rank.{rank:0{pad}d}")
+                os.makedirs(rank_dir, exist_ok=True)
+                for name in tees:
+                    tees[name] = open(  # noqa: SIM115 — closed by _stream
+                        os.path.join(rank_dir, name), "wb"
+                    )
+            for pipe, sink, name in (
+                (popen.stdout, sys.stdout, "stdout"),
+                (popen.stderr, sys.stderr, "stderr"),
+            ):
                 t = threading.Thread(
                     target=_stream,
-                    args=(pipe, sink, f"[{rank}]".encode()),
+                    args=(
+                        pipe,
+                        sink if tag_output else None,
+                        f"[{rank}]".encode(),
+                        tees[name],
+                    ),
                     daemon=True,
                 )
                 t.start()

@@ -92,6 +92,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "driver_service.py:128-197).",
     )
     parser.add_argument("--verbose", action="store_true", dest="verbose")
+    parser.add_argument(
+        "--output-filename", action=_StoreOverrideAction,
+        dest="output_filename", default=None,
+        help="Also write every rank's output to "
+             "<output_filename>/rank.<rank>/<stdout|stderr> (rank "
+             "zero-padded; reference gloo_run.py:204-217).",
+    )
 
     params = parser.add_argument_group("tunable parameters")
     params.add_argument(
@@ -145,6 +152,28 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     autotune.add_argument(
         "--autotune-log-file", action=_StoreOverrideAction,
         dest="autotune_log_file", default=None,
+    )
+    autotune.add_argument(
+        "--autotune-warmup-samples", type=int, action=_StoreOverrideAction,
+        dest="autotune_warmup_samples", default=None,
+        help="score samples discarded while pipelines warm up",
+    )
+    autotune.add_argument(
+        "--autotune-steps-per-sample", type=int, action=_StoreOverrideAction,
+        dest="autotune_steps_per_sample", default=None,
+        help="negotiation cycles per score sample",
+    )
+    autotune.add_argument(
+        "--autotune-bayes-opt-max-samples", type=int,
+        action=_StoreOverrideAction,
+        dest="autotune_bayes_opt_max_samples", default=None,
+        help="Bayesian-optimization samples per categorical configuration",
+    )
+    autotune.add_argument(
+        "--autotune-gaussian-process-noise", type=float,
+        action=_StoreOverrideAction,
+        dest="autotune_gaussian_process_noise", default=None,
+        help="GP observation-noise prior for the score surface",
     )
 
     logging_group = parser.add_argument_group("logging")
@@ -337,6 +366,7 @@ def launch_job(
     job_timeout: Optional[float] = None,
     coordinator_port: Optional[int] = None,
     tag_output: bool = True,
+    output_filename: Optional[str] = None,
 ) -> Dict[int, int]:
     """Allocate slots, spawn workers, wait for completion (reference
     gloo_run.launch_gloo, gloo_run.py:237-304).
@@ -364,12 +394,16 @@ def launch_job(
     if start_timeout is not None:
         base_env["HVDTPU_START_TIMEOUT"] = str(int(start_timeout))
 
+    if output_filename:
+        os.makedirs(output_filename, exist_ok=True)
+
     procs = ProcessSet()
     procs.install_signal_handlers()
     for slot in slots:
         slot_env = build_slot_env(slot, coordinator, base_env)
         if is_local_host(slot.hostname):
-            procs.launch(slot.rank, command, slot_env, tag_output=tag_output)
+            procs.launch(slot.rank, command, slot_env, tag_output=tag_output,
+                         output_dir=output_filename, num_proc=np)
         else:
             # Remote slots go over ssh with env inlined (reference
             # gloo_run get_remote_command); only HVDTPU_/JAX_/XLA_ vars
@@ -388,6 +422,8 @@ def launch_job(
                 base_env,
                 tag_output=tag_output,
                 stdin_data=stdin_data,
+                output_dir=output_filename,
+                num_proc=np,
             )
     return procs.wait(timeout=job_timeout)
 
@@ -441,6 +477,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             ssh_port=args.ssh_port,
             start_timeout=args.start_timeout,
             coordinator_port=args.coordinator_port,
+            output_filename=args.output_filename,
         )
         return 0
     except (RuntimeError, ValueError, TimeoutError, OSError) as exc:

@@ -134,13 +134,14 @@ class BayesianOptimization:
     """Expected-improvement Bayesian optimization over [0,1]^d
     (reference bayesian_optimization.cc: NextPoint via EI maximization)."""
 
-    def __init__(self, dims: int, seed: int = 0, xi: float = 0.01):
+    def __init__(self, dims: int, seed: int = 0, xi: float = 0.01,
+                 noise: float = 1e-4):
         self.dims = dims
         self.xi = xi
         self._rng = np.random.RandomState(seed)
         self._x: List[np.ndarray] = []
         self._y: List[float] = []
-        self.gp = GaussianProcess()
+        self.gp = GaussianProcess(noise=noise)
 
     def add_sample(self, x: np.ndarray, y: float) -> None:
         self._x.append(np.asarray(x, float))
@@ -238,6 +239,10 @@ class ParameterManager:
                 envmod.AUTOTUNE_BAYES_OPT_MAX_SAMPLES,
                 DEFAULT_BAYES_SAMPLES_PER_CATEGORY,
             )
+        # GP observation-noise prior (reference common.h:70
+        # HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE): raise on noisy shared
+        # machines so the tuner discounts sample-to-sample jitter.
+        self._gp_noise = envmod.env_float(envmod.AUTOTUNE_GP_NOISE, 1e-4)
         # `categories` must list only configurations the owning engine
         # actually consumes — every category costs a full Bayesian sweep,
         # so exploring knobs with no consumer wastes 1/len(categories) of
@@ -253,7 +258,7 @@ class ParameterManager:
         self._sample_start = time.monotonic()
         self._samples_seen = 0
         self._category_i = 0
-        self._bayes = BayesianOptimization(dims=2, seed=0)
+        self._bayes = BayesianOptimization(dims=2, seed=0, noise=self._gp_noise)
         self._per_category_samples = 0
         self._done = False
         self._best: Tuple[float, TunedParams] = (-1.0, initial)
@@ -324,7 +329,9 @@ class ParameterManager:
                 self._done = True
                 self.current = self._best[1]
                 return self.current
-            self._bayes = BayesianOptimization(dims=2, seed=self._category_i)
+            self._bayes = BayesianOptimization(
+                dims=2, seed=self._category_i, noise=self._gp_noise
+            )
         fusion_bytes, cycle_s = self._denorm(self._bayes.next_point())
         cat = self.categories[min(self._category_i, len(self.categories) - 1)]
         self.current = TunedParams(

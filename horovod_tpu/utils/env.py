@@ -27,6 +27,7 @@ AUTOTUNE_LOG = "HVDTPU_AUTOTUNE_LOG"
 AUTOTUNE_WARMUP_SAMPLES = "HVDTPU_AUTOTUNE_WARMUP_SAMPLES"
 AUTOTUNE_STEPS_PER_SAMPLE = "HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE"
 AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+AUTOTUNE_GP_NOISE = "HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 LOG_LEVEL = "HVDTPU_LOG_LEVEL"
 # Device-resident eager data plane (no reference analog by name: the
 # reference's equivalent switch is compile-time HOROVOD_GPU_ALLREDUCE).

@@ -117,6 +117,55 @@ def test_parse_args_knobs_to_env():
     assert env["HVDTPU_LOG_LEVEL"] == "debug"
 
 
+def test_parse_args_autotune_knobs_to_env():
+    """The full autotune flag surface maps onto the engine env knobs
+    (reference runner.py:318-347 autotune argument group)."""
+    args = parse_args(
+        [
+            "-np", "2",
+            "--autotune",
+            "--autotune-log-file", "/tmp/a.csv",
+            "--autotune-warmup-samples", "1",
+            "--autotune-steps-per-sample", "2",
+            "--autotune-bayes-opt-max-samples", "5",
+            "--autotune-gaussian-process-noise", "0.01",
+            "python", "train.py",
+        ]
+    )
+    env = {}
+    set_env_from_args(env, args)
+    assert env["HVDTPU_AUTOTUNE"] == "1"
+    assert env["HVDTPU_AUTOTUNE_LOG"] == "/tmp/a.csv"
+    assert env["HVDTPU_AUTOTUNE_WARMUP_SAMPLES"] == "1"
+    assert env["HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE"] == "2"
+    assert env["HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "5"
+    assert env["HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.01"
+
+
+def test_output_filename_captures_per_rank_streams(tmp_path):
+    """--output-filename writes each rank's raw stdout/stderr to
+    <dir>/rank.<padded>/<stdout|stderr> while still streaming to the
+    console (reference gloo_run.py:130-143,204-217)."""
+    import sys
+
+    from horovod_tpu.run.runner import launch_job
+
+    out_dir = tmp_path / "logs"
+    rcs = launch_job(
+        [sys.executable, "-c",
+         "import os,sys; r=os.environ['HVDTPU_RANK']; "
+         "print('out-rank', r); print('err-rank', r, file=sys.stderr)"],
+        2,
+        output_filename=str(out_dir),
+        job_timeout=60,
+    )
+    assert rcs == {0: 0, 1: 0}
+    for rank in (0, 1):
+        rank_dir = out_dir / f"rank.{rank}"
+        assert (rank_dir / "stdout").read_text() == f"out-rank {rank}\n"
+        assert (rank_dir / "stderr").read_text() == f"err-rank {rank}\n"
+
+
 def test_config_file_layering(tmp_path):
     """Explicit CLI flags beat the config file; file beats defaults
     (reference runner.py:446-450, test_run.py:168-226)."""
