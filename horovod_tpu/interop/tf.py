@@ -58,6 +58,7 @@ __all__ = [
     "allreduce", "allgather", "broadcast", "alltoall",
     "join", "barrier",
     "broadcast_variables", "broadcast_global_variables",
+    "BroadcastGlobalVariablesHook",
     "broadcast_object",
     "DistributedOptimizer", "DistributedGradientTape",
     "Compression",
@@ -325,6 +326,48 @@ def broadcast_object(obj, root_rank: int = 0):
     return _bo(obj, root_rank=root_rank)
 
 
+try:
+    _SessionRunHook = tf.compat.v1.train.SessionRunHook
+except AttributeError:  # future TF without compat.v1
+    _SessionRunHook = object
+
+
+class BroadcastGlobalVariablesHook(_SessionRunHook):
+    """tf.estimator / MonitoredSession hook that broadcasts all global
+    variables from root_rank on session creation (reference
+    tensorflow/__init__.py:194-227).  The broadcast itself runs through
+    the eager engine when the session starts — the hook is the TF1-era
+    scheduling shim around ``broadcast_variables``."""
+
+    def __init__(self, root_rank: int, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        del device  # one host data plane (accepted for source compat)
+        self._variables = None
+
+    def begin(self):
+        self._variables = list(tf.compat.v1.global_variables())
+
+    def after_create_session(self, session, coord):
+        del coord
+        if not self._variables:
+            return
+        # Read current values through the session (graph mode has no
+        # .numpy()), run the cross-rank broadcast on the host values, and
+        # load the results back through placeholder-free assign ops.
+        values = session.run(self._variables)
+        from ..ops import eager  # noqa: PLC0415
+
+        for var, value in zip(self._variables, values):
+            name = (getattr(var, "name", "") or "var").replace(
+                ":", "_"
+            ).replace("/", "_")
+            out = eager.broadcast(
+                np.asarray(value), self.root_rank, f"bghook.{name}"
+            )
+            var.load(np.asarray(out).reshape(value.shape), session)
+
+
 # ---------------------------------------------------------------------------
 # optimizers and tapes
 # ---------------------------------------------------------------------------
@@ -427,6 +470,139 @@ def _wrap_keras_optimizer(optimizer, compression, sparse_as_dense, op):
     return cls.from_config(optimizer.get_config())
 
 
+def _var_key(v):
+    """Hashable identity for tf and Keras-3 variables alike (Keras's
+    backend Variable has no .ref())."""
+    ref = getattr(v, "ref", None)
+    return ref() if callable(ref) else id(v)
+
+
+def _adasum_reduce_deltas(compression, variables, starts):
+    """Adasum-allreduce ``var - start`` per variable and set
+    ``var = start + reduced`` (the delta exchange of the reference's
+    _DistributedAdasumOptimizer, tensorflow/__init__.py:345-360).
+
+    Eager mode submits every delta asynchronously before draining any —
+    the engine negotiates/fuses them in the same cycles instead of paying
+    N sequential collective latencies.  Under ``tf.function`` tracing the
+    tensors are symbolic, so the graph-safe (py_function) allreduce runs
+    per variable."""
+    if tf.executing_eagerly():
+        from ..ops import eager  # noqa: PLC0415
+
+        pending = []
+        for v, s in zip(variables, starts):
+            comp, dctx = compression.compress(v - s)
+            name = (v.name or "var").replace(":", "_").replace("/", "_")
+            fut = eager.allreduce_async(
+                comp.numpy(), Adasum, f"adasum.{name}"
+            )
+            pending.append((v, s, comp.dtype, dctx, fut))
+        for v, s, wire_dtype, dctx, fut in pending:
+            reduced = tf.reshape(
+                tf.cast(tf.convert_to_tensor(np.asarray(fut.result())),
+                        wire_dtype),
+                v.shape,
+            )
+            s.assign_add(
+                tf.cast(compression.decompress(reduced, dctx), s.dtype)
+            )
+            v.assign(s)
+    else:
+        for v, s in zip(variables, starts):
+            comp, dctx = compression.compress(v - s)
+            reduced = allreduce(comp, op=Adasum)
+            s.assign_add(
+                tf.cast(compression.decompress(reduced, dctx), s.dtype)
+            )
+            v.assign(s)
+
+
+class _DistributedAdasumOptimizer:
+    """Delta-reducing Adasum wrapper for LEGACY optimizers (reference
+    tensorflow/__init__.py:313-407).  The reference builds this from TF1
+    slot machinery + ``tf.cond``; the TF2-idiomatic shape is imperative:
+    snapshot each variable before the wrapped optimizer's update,
+    Adasum-allreduce the update *delta*, and set
+    ``var = start + reduced_delta``.  Keras optimizers get a real Keras
+    subclass instead (``_make_adasum_keras_class``) so ``model.compile``
+    keeps working."""
+
+    _hvd_wrapped = True
+
+    def __init__(self, optimizer, compression=Compression.none):
+        self._opt = optimizer
+        self._compression = compression
+        self._starts = {}  # var.ref() -> delta_start variable (≙ slot)
+
+    def _start_for(self, var):
+        key = _var_key(var)
+        if key not in self._starts:
+            self._starts[key] = tf.Variable(
+                tf.convert_to_tensor(var), trainable=False
+            )
+        return self._starts[key]
+
+    def compute_gradients(self, *args, **kwargs):
+        # deltas (not grads) are reduced — local grads pass through
+        return self._opt.compute_gradients(*args, **kwargs)
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        gv = [(g, v) for g, v in grads_and_vars if g is not None]
+        variables = [v for _, v in gv]
+        starts = [self._start_for(v) for v in variables]
+        for v, s in zip(variables, starts):
+            s.assign(v)
+        result = self._opt.apply_gradients(gv, *args, **kwargs)
+        if size() > 1:
+            _adasum_reduce_deltas(self._compression, variables, starts)
+        return result
+
+    def get_slot(self, *args, **kwargs):
+        return self._opt.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._opt.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._opt.variables(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def _make_adasum_keras_class(base_cls, compression=Compression.none):
+    """``Adasum<Base>``: a real Keras optimizer subclass (so
+    ``model.compile`` accepts it) whose ``apply_gradients`` performs the
+    delta-Adasum exchange around the base update."""
+
+    class _AdasumKerasOptimizer(base_cls):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = [(g, v) for g, v in grads_and_vars if g is not None]
+            variables = [v for _, v in gv]
+            if not hasattr(self, "_hvd_starts"):
+                self._hvd_starts = {}
+            starts = []
+            for v in variables:
+                key = _var_key(v)
+                if key not in self._hvd_starts:
+                    self._hvd_starts[key] = tf.Variable(
+                        tf.convert_to_tensor(v), trainable=False
+                    )
+                starts.append(self._hvd_starts[key])
+            for v, s in zip(variables, starts):
+                s.assign(v)
+            result = super().apply_gradients(gv, *args, **kwargs)
+            if size() > 1:
+                _adasum_reduce_deltas(compression, variables, starts)
+            return result
+
+    _AdasumKerasOptimizer.__name__ = f"Adasum{base_cls.__name__}"
+    return _AdasumKerasOptimizer
+
+
 def DistributedOptimizer(optimizer, name=None, use_locking=False,
                          device_dense="", device_sparse="",
                          compression=Compression.none,
@@ -440,6 +616,16 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
             "backward_passes_per_step > 1 is not supported by the TF "
             "frontend; accumulate with optax.MultiSteps on the JAX path"
         )
+    if op == Adasum:
+        # the reference factory likewise diverts Adasum to the
+        # delta-reducing optimizer (tensorflow/__init__.py:453-459); a
+        # Keras optimizer gets a Keras subclass so model.compile accepts it
+        if not (_LegacyOptimizer is not None
+                and isinstance(optimizer, _LegacyOptimizer)) and hasattr(
+                    optimizer, "get_config"):
+            cls = _make_adasum_keras_class(optimizer.__class__, compression)
+            return cls.from_config(optimizer.get_config())
+        return _DistributedAdasumOptimizer(optimizer, compression)
     if _LegacyOptimizer is not None and isinstance(optimizer,
                                                    _LegacyOptimizer):
         return _DistributedOptimizer(optimizer, name, use_locking,

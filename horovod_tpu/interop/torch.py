@@ -153,13 +153,40 @@ def barrier() -> None:
 # ---------------------------------------------------------------------------
 
 
+def _resolve_op(average, op) -> ReduceOp:
+    """Reference signature compatibility (torch/mpi_ops.py:94-129 +
+    util.get_average_backwards_compatibility_fun): the 0.19-era positional
+    ``average`` bool and the ``op`` enum are both accepted, never both."""
+    if average is not None and op is not None:
+        raise ValueError(
+            "The op parameter supersedes average. Please provide only one "
+            "of them."
+        )
+    if average is not None and not isinstance(average, bool):
+        # Loud failure beats silent averaging: code written against an
+        # op-second-positional signature (allreduce(t, Sum)) must not have
+        # its reduction silently reinterpreted as average=truthy.
+        raise TypeError(
+            f"average must be a bool, got {average!r}; pass reduction "
+            "operations via the op= keyword (op=hvd.Sum / hvd.Adasum / ...)"
+        )
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
 def allreduce_async(
     tensor: torch.Tensor,
-    op: ReduceOp = Average,
+    average=None,
     name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
 ) -> _Handle:
+    """reference torch/mpi_ops.py:132-170 (average= and op= spellings)."""
+    op = _resolve_op(average, op)
     fut = eager.allreduce_async(
         _to_np(tensor), op, name,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
@@ -169,12 +196,14 @@ def allreduce_async(
 
 def allreduce_async_(
     tensor: torch.Tensor,
-    op: ReduceOp = Average,
+    average=None,
     name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
     **kw,
 ) -> _Handle:
     """In-place async allreduce: the result lands back in ``tensor``
     (reference allreduce_async_, torch/mpi_ops.py:174-205)."""
+    op = _resolve_op(average, op)
     fut = eager.allreduce_async(_to_np(tensor), op, name, **kw)
     return _Handle(fut, tensor, tensor)
 
@@ -184,7 +213,9 @@ class _AllreduceFn(torch.autograd.Function):
     def forward(ctx, tensor, op, name, prescale, postscale):
         ctx.op, ctx.prescale, ctx.postscale = op, prescale, postscale
         return synchronize(
-            allreduce_async(tensor, op, name, prescale, postscale)
+            allreduce_async(tensor, op=op, name=name,
+                            prescale_factor=prescale,
+                            postscale_factor=postscale)
         )
 
     @staticmethod
@@ -193,7 +224,8 @@ class _AllreduceFn(torch.autograd.Function):
         # the gradient of an allreduce is the same allreduce of the grads.
         return (
             synchronize(allreduce_async(
-                grad.contiguous(), ctx.op, None, ctx.prescale, ctx.postscale
+                grad.contiguous(), op=ctx.op,
+                prescale_factor=ctx.prescale, postscale_factor=ctx.postscale,
             )),
             None, None, None, None,
         )
@@ -201,24 +233,36 @@ class _AllreduceFn(torch.autograd.Function):
 
 def allreduce(
     tensor: torch.Tensor,
-    op: ReduceOp = Average,
+    average=None,
     name: Optional[str] = None,
+    compression=None,
+    op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
 ) -> torch.Tensor:
-    """Differentiable blocking allreduce (reference torch/mpi_ops.py:131-155)."""
-    if tensor.requires_grad:
-        return _AllreduceFn.apply(
-            tensor, op, name, prescale_factor, postscale_factor
+    """Differentiable blocking allreduce (reference torch/mpi_ops.py:173-231:
+    average=/op= spellings plus wire compression)."""
+    op = _resolve_op(average, op)
+    if compression is None:
+        compression = Compression.none
+    wire, dctx = compression.compress(tensor)
+    if wire.requires_grad:
+        out = _AllreduceFn.apply(
+            wire, op, name, prescale_factor, postscale_factor
         )
-    return synchronize(allreduce_async(
-        tensor, op, name, prescale_factor, postscale_factor
-    ))
+    else:
+        out = synchronize(allreduce_async(
+            wire, op=op, name=name, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        ))
+    return compression.decompress(out, dctx)
 
 
-def allreduce_(tensor: torch.Tensor, op: ReduceOp = Average,
-               name: Optional[str] = None, **kw) -> torch.Tensor:
-    return synchronize(allreduce_async_(tensor, op, name, **kw))
+def allreduce_(tensor: torch.Tensor, average=None,
+               name: Optional[str] = None,
+               op: Optional[ReduceOp] = None, **kw) -> torch.Tensor:
+    """reference torch/mpi_ops.py:234-259."""
+    return synchronize(allreduce_async_(tensor, average, name, op, **kw))
 
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> _Handle:
@@ -238,7 +282,7 @@ class _AllgatherFn(torch.autograd.Function):
         # Rank offsets come from allgathering the per-rank dim-0 sizes.
         my_rows = torch.tensor([ctx.dim0], dtype=torch.int64)
         sizes = synchronize(allgather_async(my_rows, None))
-        reduced = synchronize(allreduce_async(grad.contiguous(), Sum, None))
+        reduced = synchronize(allreduce_async(grad.contiguous(), op=Sum))
         start = int(sizes[:rank()].sum())
         return reduced.narrow(0, start, ctx.dim0), None
 
@@ -275,7 +319,7 @@ class _BroadcastFn(torch.autograd.Function):
     def backward(ctx, grad):
         # reference _BroadcastFunction.backward (torch/mpi_ops.py:371-385):
         # sum grads to the root; non-roots contribute and receive zero.
-        reduced = synchronize(allreduce_async(grad.contiguous(), Sum, None))
+        reduced = synchronize(allreduce_async(grad.contiguous(), op=Sum))
         if rank() != ctx.root_rank:
             reduced = torch.zeros_like(reduced)
         return reduced, None, None
@@ -425,12 +469,158 @@ class _DistributedOptimizer:
         return getattr(self._opt, item)
 
 
+class _DistributedAdasumOptimizer:
+    """Delta-based Adasum optimizer (reference torch/__init__.py:225-393).
+
+    ``op=Adasum`` changes WHAT is reduced, not just HOW: each rank runs the
+    wrapped optimizer's update for a parameter locally, and the parameter
+    *delta* (``-lr * f(g)``, where f is the optimizer's own logic) is
+    Adasum-allreduced; the new state is ``start + reduced_delta``.  The
+    Adasum projection then blends update *directions* — its convergence
+    story — instead of raw gradients (math comment at the reference's
+    torch/__init__.py:293-307).
+
+    Composition over the wrapped optimizer, like ``_DistributedOptimizer``
+    above: the single-parameter local step is taken by temporarily
+    narrowing the wrapped optimizer's param_groups to that parameter.
+    """
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1):
+        self._opt = optimizer
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [
+                (f"param.{i}.{j}", p)
+                for i, group in enumerate(optimizer.param_groups)
+                for j, p in enumerate(group["params"])
+            ]
+        names = [n for n, _ in named]
+        if len(names) != len(set(names)):
+            raise ValueError("parameter names must be unique")
+        # Every optimizer parameter must be named: the hooks below fire for
+        # all of them, and an unnamed one would have no start buffer and
+        # would silently never be reduced (reference raises the same way,
+        # torch/__init__.py:255-259).
+        named_ids = {id(p) for _, p in named}
+        unnamed = [
+            p for group in optimizer.param_groups
+            for p in group["params"] if id(p) not in named_ids
+        ]
+        if unnamed:
+            raise ValueError(
+                "named_parameters was specified, but one or more model "
+                "parameters were not named. Python object ids: "
+                + ", ".join(str(id(p)) for p in unnamed)
+            )
+        self._names = {id(p): n for n, p in named}
+        # Reference keeps a per-parameter "starting model" buffer the
+        # reduced deltas accumulate into (torch/__init__.py:270-273).
+        self._start = {
+            id(p): torch.zeros_like(p, requires_grad=False)
+            for _, p in named
+        }
+        self._params = {id(p): p for _, p in named}
+        self._handles: dict = {}
+        self._passes: dict = {}
+        self._hooks = []
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._hooks.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()
+                        )
+                    )
+
+    def _delta_allreduce_async(self, p: torch.Tensor):
+        """Local one-parameter step -> delta -> async Adasum reduce."""
+        stashed = []
+        for group in self._opt.param_groups:
+            stashed.append(group["params"])
+            group["params"] = [v for v in group["params"] if v is p]
+        start = self._start[id(p)]
+        with torch.no_grad():
+            start.copy_(p)
+        self._opt.step()
+        for params, group in zip(stashed, self._opt.param_groups):
+            group["params"] = params
+        with torch.no_grad():
+            p.sub_(start)  # p now holds delta = -lr * f(g)
+        name = self._names.get(id(p), f"delta.{id(p)}")
+        wire, dctx = self._compression.compress(p.detach())
+        fut = eager.allreduce_async(_to_np(wire), Adasum, f"adasum.{name}")
+        return fut, dctx
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor):
+            self._passes[id(p)] = self._passes.get(id(p), 0) + 1
+            if self._passes[id(p)] < self.backward_passes_per_step:
+                return
+            self._passes[id(p)] = 0
+            self._handles[id(p)] = (p, *self._delta_allreduce_async(p))
+
+        return hook
+
+    def set_backward_passes_per_step(self, passes: int) -> None:
+        self.backward_passes_per_step = passes
+        self._passes.clear()
+
+    def synchronize(self) -> None:
+        # The reference's Adasum optimizer completes reductions only in
+        # step() (its synchronize is a no-op, torch/__init__.py:355-356):
+        # a delta must be applied to start, never written back to .grad.
+        pass
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+        for pid, p in self._params.items():
+            if pid not in self._handles and p.grad is not None:
+                self._handles[pid] = (p, *self._delta_allreduce_async(p))
+        for pid, (p, fut, dctx) in self._handles.items():
+            delta = _from_np(np.asarray(fut.result()), p)
+            delta = self._compression.decompress(delta, dctx)
+            start = self._start[pid]
+            with torch.no_grad():
+                start.add_(delta)
+                p.copy_(start)
+        self._handles.clear()
+        # reference resets the per-parameter accumulation countdown in
+        # step() (torch/__init__.py:382) so an early step() doesn't leave
+        # a partial count behind
+        self._passes.clear()
+        return loss
+
+    def zero_grad(self, *a, **kw):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called with Adasum reductions in flight — call "
+                "step() first (reference torch/__init__.py:217-222)"
+            )
+        return self._opt.zero_grad(*a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
-                         op: ReduceOp = Average) -> _DistributedOptimizer:
-    """reference: hvd.DistributedOptimizer (torch/__init__.py:396-449)."""
+                         op: ReduceOp = Average):
+    """reference: hvd.DistributedOptimizer (torch/__init__.py:396-449).
+    ``op=Adasum`` selects the delta-reducing Adasum optimizer, exactly as
+    the reference factory does (:443-449)."""
+    if op == Adasum:
+        return _DistributedAdasumOptimizer(
+            optimizer, named_parameters, compression,
+            backward_passes_per_step,
+        )
     return _DistributedOptimizer(
         optimizer, named_parameters, compression,
         backward_passes_per_step, op,
@@ -455,9 +645,9 @@ class _SyncBatchNormFn(torch.autograd.Function):
         )
         local_sum = x.sum(dims)
         local_sqsum = (x * x).sum(dims)
-        total = synchronize(allreduce_async(count, Sum, None))
-        gsum = synchronize(allreduce_async(local_sum, Sum, None))
-        gsqsum = synchronize(allreduce_async(local_sqsum, Sum, None))
+        total = synchronize(allreduce_async(count, op=Sum))
+        gsum = synchronize(allreduce_async(local_sum, op=Sum))
+        gsqsum = synchronize(allreduce_async(local_sqsum, op=Sum))
         n = float(total)
         mean = gsum / n
         var = gsqsum / n - mean * mean
@@ -476,10 +666,10 @@ class _SyncBatchNormFn(torch.autograd.Function):
         dims, n = ctx.dims, ctx.n
         shape = [1, -1] + [1] * (grad_out.dim() - 2)
         sum_dy = synchronize(
-            allreduce_async(grad_out.sum(dims).contiguous(), Sum, None)
+            allreduce_async(grad_out.sum(dims).contiguous(), op=Sum)
         )
         sum_dy_xhat = synchronize(
-            allreduce_async((grad_out * xhat).sum(dims).contiguous(), Sum, None)
+            allreduce_async((grad_out * xhat).sum(dims).contiguous(), op=Sum)
         )
         gx = (
             weight.reshape(shape) * invstd.reshape(shape) / n

@@ -29,23 +29,33 @@ def _stream(pipe: IO[bytes], sink, prefix: bytes,
     file keeps draining, that durability being what --output-filename is
     for."""
     sink_ok = sink is not None
+    tee_ok = tee is not None
     try:
         for line in iter(pipe.readline, b""):
             if sink_ok:
                 try:
                     sink.buffer.write(prefix + line)
                     sink.flush()
-                except ValueError:
-                    sink_ok = False  # console gone (shutdown / broken pipe)
-            if tee is not None:
-                tee.write(line)
-                tee.flush()
-            elif not sink_ok:
+                except (ValueError, OSError):
+                    # console gone (interpreter shutdown, or BrokenPipeError
+                    # when the launcher's stdout is piped into a consumer
+                    # that exited) — keep the capture leg alive
+                    sink_ok = False
+            if tee_ok:
+                try:
+                    tee.write(line)
+                    tee.flush()
+                except OSError:
+                    tee_ok = False  # e.g. disk full; keep the console leg
+            if not sink_ok and not tee_ok:
                 break  # no destination left; stop pumping
     finally:
         pipe.close()
         if tee is not None:
-            tee.close()
+            try:
+                tee.close()
+            except OSError:
+                pass
 
 
 @dataclass

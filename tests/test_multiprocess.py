@@ -1059,6 +1059,115 @@ def _python_autotune_fn(log_path):
             "cache_states": sorted({r.split(",")[4] for r in rows[1:]})}
 
 
+def _torch_adasum_opt_fn():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.interop.torch as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    w = torch.nn.Parameter(torch.tensor([1.0, 0.0]))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w], lr=0.1),
+        named_parameters=[("w", w)],
+        op=hvd.Adasum,
+    )
+    # rank-dependent, non-parallel gradients so the Adasum projection is
+    # non-trivial (parallel deltas would degenerate to an average)
+    target = torch.tensor([1.0, 0.0]) if r == 0 else torch.tensor([0.3, 0.9])
+    loss = (w * target).sum()
+    loss.backward()
+    opt.step()
+    out = w.detach().numpy().tolist()
+    hvd.shutdown()
+    return out
+
+
+def test_torch_adasum_optimizer_matches_numpy_reference(engine_env):
+    """The delta-based Adasum optimizer's result equals start +
+    numpy-VHDD(deltas) — the projection runs on update directions, not raw
+    grads (reference _DistributedAdasumOptimizer, torch/__init__.py:225-393)."""
+    from horovod_tpu.ops.adasum import _numpy_adasum_rows
+
+    results = hvdrun.run(_torch_adasum_opt_fn, np=2, use_cpu=True,
+                         timeout=240, env=engine_env)
+    deltas = [
+        -0.1 * np.array([1.0, 0.0]),
+        -0.1 * np.array([0.3, 0.9]),
+    ]
+    want = np.array([1.0, 0.0]) + _numpy_adasum_rows(deltas)
+    for res in results:
+        np.testing.assert_allclose(res, want, rtol=1e-5)
+
+
+def _tf_session_hook_fn():
+    import numpy as np
+    import tensorflow as tf
+
+    tf.compat.v1.disable_eager_execution()  # TF1-style graph/session job
+
+    import horovod_tpu.interop.tf as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    with tf.Graph().as_default():
+        v = tf.compat.v1.get_variable(
+            "v", initializer=tf.constant([float(r + 1)] * 3)
+        )
+        hook = hvd.BroadcastGlobalVariablesHook(root_rank=1)
+        with tf.compat.v1.train.MonitoredTrainingSession(
+            hooks=[hook]
+        ) as sess:
+            out = np.asarray(sess.run(v)).tolist()
+    hvd.shutdown()
+    return out
+
+
+def test_tf_broadcast_hook_in_monitored_session(engine_env):
+    """BroadcastGlobalVariablesHook broadcasts on session creation — the
+    TF1 estimator migration path (reference tensorflow/__init__.py:194-227)."""
+    results = hvdrun.run(_tf_session_hook_fn, np=2, use_cpu=True,
+                         timeout=240, env=engine_env)
+    for res in results:
+        assert res == [2.0, 2.0, 2.0]  # root 1's initial value
+
+
+def _tf_adasum_opt_fn():
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.interop.tf as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    v = tf.Variable([1.0, 0.0])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.1), op=hvd.Adasum
+    )
+    grad = tf.constant([1.0, 0.0]) if r == 0 else tf.constant([0.3, 0.9])
+    opt.apply_gradients([(grad, v)])
+    out = v.numpy().tolist()
+    hvd.shutdown()
+    return out
+
+
+def test_tf_adasum_optimizer_matches_numpy_reference(engine_env):
+    """TF frontend delta-Adasum: final var == start + numpy-VHDD(deltas)
+    (reference _DistributedAdasumOptimizer, tensorflow/__init__.py:313-407)."""
+    from horovod_tpu.ops.adasum import _numpy_adasum_rows
+
+    results = hvdrun.run(_tf_adasum_opt_fn, np=2, use_cpu=True,
+                         timeout=240, env=engine_env)
+    deltas = [
+        -0.1 * np.array([1.0, 0.0]),
+        -0.1 * np.array([0.3, 0.9]),
+    ]
+    want = np.array([1.0, 0.0]) + _numpy_adasum_rows(deltas)
+    for res in results:
+        np.testing.assert_allclose(res, want, rtol=1e-5)
+
+
 def _cache_divergence_fn():
     """Recreate the classification divergence a tuner cache toggle can
     cause: rank 1 holds a tensor cached (arms a slot vote) while rank 0

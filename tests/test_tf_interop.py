@@ -120,6 +120,41 @@ def test_distributed_legacy_optimizer_wrap():
     assert dopt.get_slot_names() == base.get_slot_names()
 
 
+def test_adasum_optimizer_single_process_delta_step():
+    """op=Adasum diverts to the delta-reducing wrapper (reference factory
+    tensorflow/__init__.py:453-459); world 1 applies the local update.
+    A Keras optimizer yields a real Keras subclass so model.compile
+    accepts it."""
+    v = tf.Variable([1.0, 2.0])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.1), op=hvd.Adasum
+    )
+    assert type(opt).__name__ == "AdasumSGD"
+    assert isinstance(opt, tf.keras.optimizers.SGD)
+    opt.apply_gradients([(tf.constant([1.0, 2.0]), v)])
+    np.testing.assert_allclose(v.numpy(), [0.9, 1.8], rtol=1e-6)
+    # slot-style start buffer exists per variable
+    assert len(opt._hvd_starts) == 1
+
+
+def test_adasum_keras_optimizer_works_in_model_compile():
+    """The Adasum wrapper must survive Keras's optimizer validation in
+    model.compile + fit (existing user flow, not just apply_gradients)."""
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+    )
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.1), op=hvd.Adasum
+        ),
+        loss="mse",
+    )
+    x = np.ones((8, 2), np.float32)
+    y = np.zeros((8, 1), np.float32)
+    hist = model.fit(x, y, epochs=1, batch_size=4, verbose=0)
+    assert np.isfinite(hist.history["loss"][0])
+
+
 def test_compression_fp16_roundtrip():
     x = tf.constant([1.0, 2.0, 3.0])
     c, ctx = hvd.Compression.fp16.compress(x)

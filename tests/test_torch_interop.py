@@ -175,6 +175,64 @@ def test_sync_batch_norm_fn_gradcheck_single():
     assert torch.allclose(b.grad, b2.grad, atol=1e-7)
 
 
+def test_adasum_optimizer_single_process_delta_step():
+    """op=Adasum selects the delta-reducing optimizer (reference factory
+    torch/__init__.py:443-449).  World 1: Adasum of one delta is the delta
+    itself, so the wrapped optimizer's step applies exactly."""
+    w = torch.nn.Parameter(torch.tensor([1.0, 2.0]))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w], lr=0.1),
+        named_parameters=[("w", w)],
+        op=hvd.Adasum,
+    )
+    assert type(opt).__name__ == "_DistributedAdasumOptimizer"
+    loss = (w * torch.tensor([1.0, 2.0])).sum()
+    loss.backward()
+    opt.step()
+    # delta = -lr * grad = [-0.1, -0.2]
+    assert torch.allclose(w.detach(), torch.tensor([0.9, 1.8]), atol=1e-6)
+    opt.zero_grad()
+
+
+def test_adasum_optimizer_zero_grad_race_guard():
+    w = torch.nn.Parameter(torch.ones(2))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w], lr=0.1), named_parameters=[("w", w)],
+        op=hvd.Adasum,
+    )
+    w.sum().backward()
+    with pytest.raises(AssertionError, match="in flight"):
+        opt.zero_grad()
+    opt.step()
+
+
+def test_allreduce_average_spelling_compat():
+    """The 0.19-era positional/keyword ``average`` bool is accepted on all
+    four allreduce spellings, and conflicts with op= are rejected
+    (reference torch/mpi_ops.py:94-129 + get_average_backwards_
+    compatibility_fun)."""
+    x = torch.ones(4)
+    out = hvd.allreduce(x, True)  # positional average
+    assert torch.allclose(out, x)
+    out = hvd.allreduce(x, average=False)  # sum at world 1
+    assert torch.allclose(out, x)
+    y = torch.ones(3)
+    hvd.synchronize(hvd.allreduce_async(y, average=False))
+    hvd.allreduce_(y, average=True)
+    hvd.synchronize(hvd.allreduce_async_(y, average=False))
+    with pytest.raises(ValueError, match="op parameter supersedes"):
+        hvd.allreduce(x, average=True, op=hvd.Sum)
+
+
+def test_allreduce_compression_kwarg():
+    """Sync allreduce accepts compression= like the reference
+    (torch/mpi_ops.py:173) and round-trips the dtype."""
+    x = torch.full((8,), 3.0)
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.fp16)
+    assert out.dtype == torch.float32
+    assert torch.allclose(out, x)
+
+
 def test_compression_fp16_roundtrip():
     t = torch.randn(8)
     wire, ctx = hvd.Compression.fp16.compress(t)
