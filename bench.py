@@ -58,7 +58,8 @@ def peak_flops_per_chip(device, dtype: str) -> float:
     return peak
 
 
-def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int):
+def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
+                   attention: str = "flash"):
     """GPT causal-LM training step (flash attention) — the long-context
     counterpart of the ResNet bench.  Returns ``(step, state, static)``
     like ``build_step``; throughput is reported in tokens/sec/chip."""
@@ -80,7 +81,8 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int):
         # bf16 under an fp8 label would corrupt the benchmark series.
         raise SystemExit("--dtype fp8 is resnet-only (e4m3 act storage)")
     compute_dtype = jnp.float32 if dtype == "fp32" else jnp.bfloat16
-    model = gpt(size, dtype=compute_dtype, max_len=seq_len)
+    model = gpt(size, dtype=compute_dtype, max_len=seq_len,
+                attention_impl=attention)
     vocab = model.cfg.vocab_size
 
     global_batch = batch_size * n_chips
@@ -231,6 +233,9 @@ def main() -> int:
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--seq-len", type=int, default=1024,
                         help="sequence length for the gpt models")
+    parser.add_argument("--attention", default="flash",
+                        choices=["flash", "reference"],
+                        help="gpt attention schedule (flash = Pallas kernel)")
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--s2d-stem", action="store_true",
@@ -254,7 +259,7 @@ def main() -> int:
     if is_gpt:
         step, state, static = build_gpt_step(
             args.model[len("gpt-"):], args.dtype, args.batch_size,
-            args.seq_len,
+            args.seq_len, attention=args.attention,
         )
         carry, const = state[:-1], state[-1:]
     else:

@@ -353,19 +353,22 @@ class BroadcastGlobalVariablesHook(_SessionRunHook):
         if not self._variables:
             return
         # Read current values through the session (graph mode has no
-        # .numpy()), run the cross-rank broadcast on the host values, and
-        # load the results back through placeholder-free assign ops.
+        # .numpy()), broadcast on the host values — all submitted async
+        # first so N variables share negotiation cycles instead of paying
+        # N sequential round-trips — and load results back via var.load.
         values = session.run(self._variables)
         from ..ops import eager  # noqa: PLC0415
 
-        for var, value in zip(self._variables, values):
-            name = (getattr(var, "name", "") or "var").replace(
-                ":", "_"
-            ).replace("/", "_")
-            out = eager.broadcast(
-                np.asarray(value), self.root_rank, f"bghook.{name}"
+        futs = [
+            eager.broadcast_async(
+                np.asarray(value), self.root_rank, f"bghook.{i}"
             )
-            var.load(np.asarray(out).reshape(value.shape), session)
+            for i, value in enumerate(values)
+        ]
+        for var, value, fut in zip(self._variables, values, futs):
+            var.load(
+                np.asarray(fut.result()).reshape(value.shape), session
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -477,6 +480,23 @@ def _var_key(v):
     return ref() if callable(ref) else id(v)
 
 
+def _snapshot_starts(store: dict, variables):
+    """Get-or-create the per-variable delta_start buffers (≙ the
+    reference's slots) and snapshot the current values into them.  Shared
+    by both Adasum wrappers so the slot protocol lives in one place."""
+    starts = []
+    for v in variables:
+        key = _var_key(v)
+        if key not in store:
+            store[key] = tf.Variable(
+                tf.convert_to_tensor(v), trainable=False
+            )
+        starts.append(store[key])
+    for v, s in zip(variables, starts):
+        s.assign(v)
+    return starts
+
+
 def _adasum_reduce_deltas(compression, variables, starts):
     """Adasum-allreduce ``var - start`` per variable and set
     ``var = start + reduced`` (the delta exchange of the reference's
@@ -491,11 +511,14 @@ def _adasum_reduce_deltas(compression, variables, starts):
         from ..ops import eager  # noqa: PLC0415
 
         pending = []
-        for v, s in zip(variables, starts):
+        for i, (v, s) in enumerate(zip(variables, starts)):
             comp, dctx = compression.compress(v - s)
-            name = (v.name or "var").replace(":", "_").replace("/", "_")
+            # Positional index, not the variable name: Keras-3 variable
+            # names are unscoped ('kernel', 'bias', 'kernel', ...) and the
+            # engine rejects duplicate in-flight names; apply order is
+            # identical on every rank, so the index is cross-rank stable.
             fut = eager.allreduce_async(
-                comp.numpy(), Adasum, f"adasum.{name}"
+                comp.numpy(), Adasum, f"adasum.delta.{i}"
             )
             pending.append((v, s, comp.dtype, dctx, fut))
         for v, s, wire_dtype, dctx, fut in pending:
@@ -535,28 +558,34 @@ class _DistributedAdasumOptimizer:
         self._compression = compression
         self._starts = {}  # var.ref() -> delta_start variable (≙ slot)
 
-    def _start_for(self, var):
-        key = _var_key(var)
-        if key not in self._starts:
-            self._starts[key] = tf.Variable(
-                tf.convert_to_tensor(var), trainable=False
-            )
-        return self._starts[key]
-
     def compute_gradients(self, *args, **kwargs):
         # deltas (not grads) are reduced — local grads pass through
         return self._opt.compute_gradients(*args, **kwargs)
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        if not tf.executing_eagerly():
+            # The imperative assign/allreduce sequence below would build
+            # dangling graph ops a session never fetches — the local update
+            # would apply and ranks would silently diverge.  Refuse loudly.
+            raise NotImplementedError(
+                "op=Adasum with a legacy optimizer requires eager "
+                "execution; under TF1 graph sessions wrap a Keras "
+                "optimizer instead (the Adasum Keras subclass), or run "
+                "the step eagerly."
+            )
         gv = [(g, v) for g, v in grads_and_vars if g is not None]
         variables = [v for _, v in gv]
-        starts = [self._start_for(v) for v in variables]
-        for v, s in zip(variables, starts):
-            s.assign(v)
+        starts = _snapshot_starts(self._starts, variables)
         result = self._opt.apply_gradients(gv, *args, **kwargs)
         if size() > 1:
             _adasum_reduce_deltas(self._compression, variables, starts)
         return result
+
+    def minimize(self, loss, *args, **kwargs):
+        # Explicit, so __getattr__ can't route to the inner optimizer's
+        # minimize and bypass the delta exchange.
+        grads_and_vars = self._opt.compute_gradients(loss, *args, **kwargs)
+        return self.apply_gradients(grads_and_vars)
 
     def get_slot(self, *args, **kwargs):
         return self._opt.get_slot(*args, **kwargs)
@@ -584,16 +613,7 @@ def _make_adasum_keras_class(base_cls, compression=Compression.none):
             variables = [v for _, v in gv]
             if not hasattr(self, "_hvd_starts"):
                 self._hvd_starts = {}
-            starts = []
-            for v in variables:
-                key = _var_key(v)
-                if key not in self._hvd_starts:
-                    self._hvd_starts[key] = tf.Variable(
-                        tf.convert_to_tensor(v), trainable=False
-                    )
-                starts.append(self._hvd_starts[key])
-            for v, s in zip(variables, starts):
-                s.assign(v)
+            starts = _snapshot_starts(self._hvd_starts, variables)
             result = super().apply_gradients(gv, *args, **kwargs)
             if size() > 1:
                 _adasum_reduce_deltas(compression, variables, starts)

@@ -16,6 +16,7 @@ torch/mpi_ops.py:158-171; allgather -> reduce + narrow by rank offsets,
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Any, Iterable, Optional, Tuple, Union
 
 import numpy as np
@@ -419,6 +420,8 @@ class _DistributedOptimizer:
         }
         self._handles: dict = {}
         self._passes: dict = {}
+        self._should_synchronize = True
+        self._synchronized = False
         self._hooks = []
         for group in optimizer.param_groups:
             for p in group["params"]:
@@ -452,9 +455,40 @@ class _DistributedOptimizer:
             with torch.no_grad():
                 p.grad.copy_(out)
         self._handles.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """Make the next ``step()`` skip synchronization — the
+        synchronize-then-clip-then-step pattern (reference
+        torch/__init__.py:184-202):
+
+            optimizer.synchronize()
+            torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+            with optimizer.skip_synchronize():
+                optimizer.step()
+        """
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
 
     def step(self, closure=None):
-        self.synchronize()
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings  # noqa: PLC0415
+
+                warnings.warn(
+                    "optimizer.step() called without "
+                    "optimizer.skip_synchronize() context after "
+                    "optimizer.synchronize(). This can cause training "
+                    "slowdown. You may want to consider using "
+                    "optimizer.skip_synchronize() context if you use "
+                    "optimizer.synchronize() in your code."
+                )
+            self.synchronize()
+        self._synchronized = False
         return self._opt.step(closure)
 
     def zero_grad(self, *a, **kw):
@@ -576,6 +610,14 @@ class _DistributedAdasumOptimizer:
         # step() (its synchronize is a no-op, torch/__init__.py:355-356):
         # a delta must be applied to start, never written back to .grad.
         pass
+
+    @contextmanager
+    def skip_synchronize(self):
+        raise AssertionError(
+            "Skipping synchronization is not supported when using Adasum "
+            "optimizer."
+        )
+        yield  # pragma: no cover — contextmanager shape (reference :359-361)
 
     def step(self, closure=None):
         loss = closure() if closure is not None else None

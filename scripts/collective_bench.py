@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Eager-engine collective microbenchmark: allreduce goodput vs world size
+and message size (the in-tree analog of the reference's allreduce scaling
+story, docs/benchmarks.rst:13-43 — the jit path's scaling rides XLA/ICI
+and is exercised by the multichip dryrun instead).
+
+Runs true multi-process worlds on localhost via the launcher (SURVEY §4
+strategy) and prints a goodput table; `--engine native` exercises the C++
+engine's poll-multiplexed coordinator, `--engine python` the symmetric
+bit-vote controller.
+
+    python scripts/collective_bench.py --engine native --np 2 4 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _worker(nbytes: int, iters: int):
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    x = np.ones(max(nbytes // 4, 1), np.float32)
+    for _ in range(3):  # warm the cache fast path
+        hvd.allreduce(x, op=hvd.Sum, name="warm")
+    t0 = time.perf_counter()
+    for i in range(iters):
+        hvd.allreduce(x, op=hvd.Sum, name="bench")
+    dt = time.perf_counter() - t0
+    hvd.shutdown()
+    # goodput: payload bytes reduced per second (one buffer per op)
+    return nbytes * iters / dt
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--engine", default="python",
+                        choices=["python", "native"])
+    parser.add_argument("--np", type=int, nargs="+", default=[2, 4],
+                        dest="worlds")
+    parser.add_argument("--sizes-kb", type=int, nargs="+",
+                        default=[4, 1024, 16384])
+    parser.add_argument("--iters", type=int, default=50)
+    args = parser.parse_args()
+
+    import horovod_tpu.run as hvdrun
+    from horovod_tpu.runtime.native import native_available
+
+    if args.engine == "native" and not native_available():
+        print("native engine not built (make -C cpp)", file=sys.stderr)
+        return 1
+
+    env = {"HVDTPU_EAGER_ENGINE": args.engine, "HVDTPU_CYCLE_TIME": "1"}
+    print(f"# engine={args.engine} iters={args.iters} "
+          "(goodput = payload bytes/sec, rank 0)")
+    header = "size_kb " + " ".join(f"np={n:<12d}" for n in args.worlds)
+    print(header)
+    for kb in args.sizes_kb:
+        row = [f"{kb:7d}"]
+        for n in args.worlds:
+            results = hvdrun.run(
+                _worker, (kb * 1024, args.iters), np=n, use_cpu=True,
+                timeout=600, env=env,
+            )
+            mbps = results[0] / 1e6
+            row.append(f"{mbps:9.1f} MB/s")
+        print(" ".join(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1148,8 +1148,24 @@ def _tf_adasum_opt_fn():
     grad = tf.constant([1.0, 0.0]) if r == 0 else tf.constant([0.3, 0.9])
     opt.apply_gradients([(grad, v)])
     out = v.numpy().tolist()
+
+    # Regression: Keras-3 variables carry unscoped duplicate names
+    # ('kernel', 'kernel'); the delta exchange must not collide on the
+    # engine's duplicate-in-flight-name guard.
+    a = tf.Variable([1.0], name="kernel")
+    b = tf.Variable([2.0], name="kernel")
+    opt2 = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.1), op=hvd.Adasum
+    )
+    opt2.apply_gradients(
+        [(tf.constant([1.0]), a), (tf.constant([1.0]), b)]
+    )
+    dup_ok = np.isfinite(float(a.numpy()[0])) and np.isfinite(
+        float(b.numpy()[0])
+    )
+
     hvd.shutdown()
-    return out
+    return {"v": out, "dup_ok": bool(dup_ok)}
 
 
 def test_tf_adasum_optimizer_matches_numpy_reference(engine_env):
@@ -1165,7 +1181,8 @@ def test_tf_adasum_optimizer_matches_numpy_reference(engine_env):
     ]
     want = np.array([1.0, 0.0]) + _numpy_adasum_rows(deltas)
     for res in results:
-        np.testing.assert_allclose(res, want, rtol=1e-5)
+        np.testing.assert_allclose(res["v"], want, rtol=1e-5)
+        assert res["dup_ok"]
 
 
 def _cache_divergence_fn():

@@ -206,6 +206,70 @@ def test_adasum_optimizer_zero_grad_race_guard():
     opt.step()
 
 
+def test_allreduce_dtype_dims_grid():
+    """Reference test_torch.py pattern: allreduce across a dtype x
+    dimensionality grid preserves dtype, shape, and values (world 1:
+    identity for Average, identity for Sum)."""
+    dtypes = [torch.float32, torch.float64, torch.float16, torch.bfloat16,
+              torch.int32, torch.int64, torch.uint8]
+    for dt in dtypes:
+        for dim in (1, 2, 3):
+            shape = (2,) * dim
+            x = (torch.arange(2 ** dim) % 3).reshape(shape).to(dt)
+            op = hvd.Sum if not dt.is_floating_point else hvd.Average
+            out = hvd.allreduce(x, op=op, name=f"grid.{dt}.{dim}")
+            assert out.dtype == dt, (dt, dim)
+            assert out.shape == shape, (dt, dim)
+            assert torch.equal(out.to(torch.float64),
+                               x.to(torch.float64)), (dt, dim)
+
+
+def test_allgather_ragged_dim0_grid():
+    """Allgather across element ranks; world 1 returns the input
+    (reference test_torch.py test_horovod_allgather*)."""
+    for dim in (1, 2, 3):
+        x = torch.ones((3,) + (2,) * (dim - 1))
+        out = hvd.allgather(x, name=f"ag.{dim}")
+        assert torch.equal(out, x)
+
+
+def test_skip_synchronize_clip_pattern():
+    """synchronize -> clip -> step-without-resync (reference
+    torch/__init__.py:184-202), plus the step-after-synchronize warning."""
+    import warnings
+
+    w = torch.nn.Parameter(torch.tensor([3.0, 4.0]))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w], lr=1.0), named_parameters=[("w", w)]
+    )
+    (w * torch.tensor([30.0, 40.0])).sum().backward()
+    opt.synchronize()
+    torch.nn.utils.clip_grad_norm_([w], max_norm=5.0)
+    with opt.skip_synchronize():
+        opt.step()
+    # clipped grad = [30,40]/50*5 = [3,4]; w = [3,4] - 1.0*[3,4] = 0
+    assert torch.allclose(w.detach(), torch.zeros(2), atol=1e-6)
+
+    # step() after synchronize() WITHOUT the context warns
+    opt.zero_grad()
+    (w.sum()).backward()
+    opt.synchronize()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        opt.step()
+    assert any("skip_synchronize" in str(x.message) for x in rec)
+
+    # Adasum optimizer refuses the context (reference :359-361)
+    w2 = torch.nn.Parameter(torch.ones(2))
+    aopt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w2], lr=0.1), named_parameters=[("w2", w2)],
+        op=hvd.Adasum,
+    )
+    with pytest.raises(AssertionError, match="not supported"):
+        with aopt.skip_synchronize():
+            pass
+
+
 def test_allreduce_average_spelling_compat():
     """The 0.19-era positional/keyword ``average`` bool is accepted on all
     four allreduce spellings, and conflicts with op= are rejected
