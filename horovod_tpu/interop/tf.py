@@ -513,12 +513,18 @@ def _adasum_reduce_deltas(compression, variables, starts):
         pending = []
         for i, (v, s) in enumerate(zip(variables, starts)):
             comp, dctx = compression.compress(v - s)
-            # Positional index, not the variable name: Keras-3 variable
-            # names are unscoped ('kernel', 'bias', 'kernel', ...) and the
-            # engine rejects duplicate in-flight names; apply order is
-            # identical on every rank, so the index is cross-rank stable.
+            # Key = index + identity.  The index disambiguates Keras-3's
+            # unscoped duplicate names ('kernel', 'bias', 'kernel', ...),
+            # which the engine would reject as duplicate in-flight names;
+            # the appended variable name keeps cross-rank mispairing
+            # DETECTABLE: if ranks filtered different None grads, their
+            # name sets differ and negotiation stalls loudly instead of
+            # Adasum-reducing unrelated same-shaped deltas silently.
+            ident = (getattr(v, "name", "") or "var").replace(
+                ":", "_"
+            ).replace("/", "_")
             fut = eager.allreduce_async(
-                comp.numpy(), Adasum, f"adasum.delta.{i}"
+                comp.numpy(), Adasum, f"adasum.delta.{i}.{ident}"
             )
             pending.append((v, s, comp.dtype, dctx, fut))
         for v, s, wire_dtype, dctx, fut in pending:
@@ -581,11 +587,25 @@ class _DistributedAdasumOptimizer:
             _adasum_reduce_deltas(self._compression, variables, starts)
         return result
 
-    def minimize(self, loss, *args, **kwargs):
-        # Explicit, so __getattr__ can't route to the inner optimizer's
-        # minimize and bypass the delta exchange.
-        grads_and_vars = self._opt.compute_gradients(loss, *args, **kwargs)
-        return self.apply_gradients(grads_and_vars)
+    def minimize(self, loss, global_step=None, var_list=None,
+                 gate_gradients=None, aggregation_method=None,
+                 colocate_gradients_with_ops=False, name=None,
+                 grad_loss=None):
+        # Explicit with the TF1 signature, so __getattr__ can't route to
+        # the inner optimizer's minimize and bypass the delta exchange —
+        # and global_step/name actually reach apply_gradients.
+        cg_kwargs = dict(
+            var_list=var_list,
+            aggregation_method=aggregation_method,
+            colocate_gradients_with_ops=colocate_gradients_with_ops,
+            grad_loss=grad_loss,
+        )
+        if gate_gradients is not None:
+            cg_kwargs["gate_gradients"] = gate_gradients
+        grads_and_vars = self._opt.compute_gradients(loss, **cg_kwargs)
+        return self.apply_gradients(
+            grads_and_vars, global_step=global_step, name=name
+        )
 
     def get_slot(self, *args, **kwargs):
         return self._opt.get_slot(*args, **kwargs)

@@ -426,16 +426,20 @@ def broadcast_(tensor, root_rank: int, **kwargs):
 # ---------------------------------------------------------------------------
 
 
-def alltoall(tensor, *, axis_name: str = DP_AXIS):
+def alltoall(tensor, *, axis_name: str = DP_AXIS,
+             name: Optional[str] = None):
     """Scatter dim-0 chunks to each shard and gather their chunks (the
     primitive behind Ulysses-style sequence parallelism).  Not present in
     the reference at 0.19.1 (SURVEY.md §2.9); provided because all-to-all is
-    first-class on the ICI torus and later Horovod grew it."""
+    first-class on the ICI torus and later Horovod grew it.  ``name`` keys
+    the eager negotiation, like allreduce's."""
     if not _is_traced(tensor):
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415
 
-        return jax.tree_util.tree_map(lambda x: eager.alltoall(x), tensor)
+        return jax.tree_util.tree_map(
+            lambda x: eager.alltoall(x, name), tensor
+        )
 
     def one(x):
         x = jnp.asarray(x)

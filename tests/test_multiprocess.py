@@ -1059,6 +1059,69 @@ def _python_autotune_fn(log_path):
             "cache_states": sorted({r.split(",")[4] for r in rows[1:]})}
 
 
+def _alltoall_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    # rank r sends block d of its buffer to rank d: block value = 10*r + d
+    x = np.repeat(np.arange(n), 2).astype(np.float32)
+    x = 10.0 * r + x
+    out = hvd.alltoall(x, name="a2a")
+    hvd.shutdown()
+    return np.asarray(out).tolist()
+
+
+def test_alltoall_across_processes(engine_env):
+    """alltoall: rank d ends with every rank's d-th block (pairwise
+    exchange over the host data plane; the jit-path analog is
+    lax.all_to_all over the mesh)."""
+    results = hvdrun.run(_alltoall_fn, np=2, use_cpu=True, timeout=240,
+                         env=engine_env)
+    for d, res in enumerate(results):
+        want = []
+        for src in (0, 1):
+            want += [10.0 * src + d] * 2
+        assert res == want, (d, res)
+
+
+def _timeline_cycles_fn(path):
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    for i in range(4):
+        hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name=f"t{i}")
+    hvd.shutdown()
+    return r
+
+
+def test_timeline_cycle_markers_across_processes(tmp_path):
+    """HVDTPU_TIMELINE_MARK_CYCLES puts CYCLE markers in rank 0's Chrome
+    trace (reference HOROVOD_TIMELINE_MARK_CYCLES, operations.cc:415;
+    asserted like the reference's test_timeline.py:40-57)."""
+    import json
+
+    path = str(tmp_path / "timeline.json")
+    hvdrun.run(_timeline_cycles_fn, (path,), np=2, use_cpu=True,
+               timeout=240,
+               env={
+                   "HVDTPU_EAGER_ENGINE": "python",
+                   "HVDTPU_TIMELINE": path,
+                   "HVDTPU_TIMELINE_MARK_CYCLES": "1",
+               })
+    events = json.loads(open(path).read())
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    assert any("CYCLE" in (n or "") for n in names), sorted(names)[:20]
+    # negotiation + op phases also present (reference asserts
+    # NEGOTIATE_ALLREDUCE / ALLREDUCE)
+    assert any("ALLREDUCE" in (n or "") for n in names)
+
+
 def _torch_adasum_opt_fn():
     import numpy as np
     import torch

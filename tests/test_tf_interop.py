@@ -155,6 +155,27 @@ def test_adasum_keras_optimizer_works_in_model_compile():
     assert np.isfinite(hist.history["loss"][0])
 
 
+def test_allreduce_dtype_dims_grid():
+    """Reference test_tensorflow.py pattern: allreduce across dtype x
+    dimensionality preserves dtype/shape/values (world 1 identities)."""
+    dtypes = [tf.float32, tf.float64, tf.float16, tf.bfloat16,
+              tf.int32, tf.int64]
+    for dt in dtypes:
+        for dim in (1, 2, 3):
+            shape = (2,) * dim
+            x = tf.cast(
+                tf.reshape(tf.range(2 ** dim) % 3, shape), dt
+            )
+            op = hvd.Sum if not dt.is_floating else hvd.Average
+            out = hvd.allreduce(x, op=op)
+            assert out.dtype == dt, (dt, dim)
+            assert tuple(out.shape) == shape, (dt, dim)
+            np.testing.assert_allclose(
+                tf.cast(out, tf.float64).numpy(),
+                tf.cast(x, tf.float64).numpy(),
+            )
+
+
 def test_compression_fp16_roundtrip():
     x = tf.constant([1.0, 2.0, 3.0])
     c, ctx = hvd.Compression.fp16.compress(x)
