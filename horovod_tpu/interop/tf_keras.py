@@ -80,6 +80,11 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
             f"Distributed{base.__name__}",
             _hvd_tf._make_distributed_keras_class(base, compression),
         )
+        # op=Adasum wraps serialize as "Adasum<Base>"
+        objs.setdefault(
+            f"Adasum{base.__name__}",
+            _hvd_tf._make_adasum_keras_class(base, compression),
+        )
     for opt_cls in custom_optimizers or []:
         objs.setdefault(opt_cls.__name__, opt_cls)
     model = tf.keras.models.load_model(filepath, custom_objects=objs)

@@ -437,9 +437,15 @@ def alltoall(tensor, *, axis_name: str = DP_AXIS,
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415
 
-        return jax.tree_util.tree_map(
-            lambda x: eager.alltoall(x, name), tensor
-        )
+        leaves, treedef = jax.tree_util.tree_flatten(tensor)
+        outs = [
+            eager.alltoall(
+                leaf,
+                f"{name}.{i}" if name and len(leaves) > 1 else name,
+            )
+            for i, leaf in enumerate(leaves)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, outs)
 
     def one(x):
         x = jnp.asarray(x)

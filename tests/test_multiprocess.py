@@ -1087,17 +1087,16 @@ def test_alltoall_across_processes(engine_env):
         assert res == want, (d, res)
 
 
-def _timeline_cycles_fn(path):
+def _timeline_cycles_fn():
+    # the timeline path flows through the HVDTPU_TIMELINE env var
     import numpy as np
 
     import horovod_tpu as hvd
 
     hvd.init()
-    r = hvd.rank()
     for i in range(4):
         hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name=f"t{i}")
     hvd.shutdown()
-    return r
 
 
 def test_timeline_cycle_markers_across_processes(tmp_path):
@@ -1107,7 +1106,7 @@ def test_timeline_cycle_markers_across_processes(tmp_path):
     import json
 
     path = str(tmp_path / "timeline.json")
-    hvdrun.run(_timeline_cycles_fn, (path,), np=2, use_cpu=True,
+    hvdrun.run(_timeline_cycles_fn, np=2, use_cpu=True,
                timeout=240,
                env={
                    "HVDTPU_EAGER_ENGINE": "python",

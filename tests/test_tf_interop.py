@@ -280,6 +280,32 @@ def test_keras_load_model_rewraps_optimizer(tmp_path):
     restored.fit(x, y, epochs=1, batch_size=8, verbose=0)  # still trains
 
 
+def test_keras_load_model_restores_adasum_wrap(tmp_path):
+    """A model compiled with op=Adasum serializes its optimizer as
+    'AdasumSGD'; load_model must deserialize it back into the delta
+    wrapper and keep training."""
+    import horovod_tpu.interop.tf_keras as hvk
+
+    x = np.random.RandomState(0).randn(16, 2).astype(np.float32)
+    y = np.zeros((16, 1), np.float32)
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+    )
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.1), op=hvd.Adasum
+        ),
+        loss="mse",
+    )
+    model.fit(x, y, epochs=1, batch_size=8, verbose=0)
+    path = str(tmp_path / "adasum.keras")
+    model.save(path)
+    restored = hvk.load_model(path)
+    assert type(restored.optimizer).__name__ == "AdasumSGD"
+    assert getattr(restored.optimizer, "_hvd_wrapped", False)
+    restored.fit(x, y, epochs=1, batch_size=8, verbose=0)
+
+
 def test_keras_warmup_momentum_correction_restores():
     import numpy as np
     import tensorflow as tf
