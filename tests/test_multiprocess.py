@@ -1331,7 +1331,8 @@ def _keras_fit_fn():
 
     tf.keras.utils.set_random_seed(1234 + r)  # divergent initial weights
     model = tf.keras.Sequential(
-        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+        [tf.keras.Input(shape=(2,)),
+         tf.keras.layers.Dense(1, use_bias=False)]
     )
     model.compile(
         optimizer=hvk.DistributedOptimizer(

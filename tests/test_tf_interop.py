@@ -141,7 +141,8 @@ def test_adasum_keras_optimizer_works_in_model_compile():
     """The Adasum wrapper must survive Keras's optimizer validation in
     model.compile + fit (existing user flow, not just apply_gradients)."""
     model = tf.keras.Sequential(
-        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+        [tf.keras.Input(shape=(2,)),
+         tf.keras.layers.Dense(1, use_bias=False)]
     )
     model.compile(
         optimizer=hvd.DistributedOptimizer(
@@ -208,7 +209,8 @@ def _tiny_model(lr=0.1):
     import horovod_tpu.interop.tf_keras as hvk
 
     model = tf.keras.Sequential(
-        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+        [tf.keras.Input(shape=(2,)),
+         tf.keras.layers.Dense(1, use_bias=False)]
     )
     model.compile(
         optimizer=hvk.DistributedOptimizer(
@@ -289,7 +291,8 @@ def test_keras_load_model_restores_adasum_wrap(tmp_path):
     x = np.random.RandomState(0).randn(16, 2).astype(np.float32)
     y = np.zeros((16, 1), np.float32)
     model = tf.keras.Sequential(
-        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+        [tf.keras.Input(shape=(2,)),
+         tf.keras.layers.Dense(1, use_bias=False)]
     )
     model.compile(
         optimizer=hvd.DistributedOptimizer(
@@ -315,7 +318,8 @@ def test_keras_warmup_momentum_correction_restores():
     x = np.zeros((16, 2), np.float32)
     y = np.zeros((16, 1), np.float32)
     model = tf.keras.Sequential(
-        [tf.keras.layers.Dense(1, use_bias=False, input_shape=(2,))]
+        [tf.keras.Input(shape=(2,)),
+         tf.keras.layers.Dense(1, use_bias=False)]
     )
     model.compile(
         optimizer=hvk.DistributedOptimizer(
