@@ -213,7 +213,15 @@ def compute_responses(
             if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM,
                          RequestType.REDUCESCATTER):
                 # Fusion identity + byte size (reference keeps dtype
-                # homogeneous per fusion, controller.cc:676-689).
+                # homogeneous per fusion, controller.cc:676-689).  The
+                # execute path also reads this meta for wire dtype/op.
+                # Note ADASUM responses still never FUSE — both engines'
+                # fuse loops gate on ResponseType.ALLREDUCE — which is
+                # deliberate: the reference's fused Adasum computes
+                # per-tensor projection coefficients (adasum.h
+                # tensor_counts, one "layer" per tensor); a whole-buffer
+                # projection over concatenated tensors would change the
+                # math, so each Adasum tensor keeps its own exchange here.
                 resp._fuse_meta = (  # type: ignore[attr-defined]
                     first.dtype,
                     first.reduce_op,

@@ -1121,6 +1121,43 @@ def test_timeline_cycle_markers_across_processes(tmp_path):
     assert any("ALLREDUCE" in (n or "") for n in names)
 
 
+def _adasum_per_tensor_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    # two Adasum tensors in flight in the SAME cycle, deliberately
+    # non-parallel across ranks so the projection outcome is sensitive to
+    # its input span
+    a = np.asarray([1.0, 0.0] if r == 0 else [0.0, 1.0], np.float32)
+    b = np.asarray([2.0, 2.0] if r == 0 else [2.0, -2.0], np.float32)
+    ha = hvd.allreduce_async(a, op=hvd.Adasum, name="ad_a")
+    hb = hvd.allreduce_async(b, op=hvd.Adasum, name="ad_b")
+    out = {
+        "a": np.asarray(hvd.synchronize(ha)).tolist(),
+        "b": np.asarray(hvd.synchronize(hb)).tolist(),
+    }
+    hvd.shutdown()
+    return out
+
+
+def test_adasum_projection_is_per_tensor(engine_env):
+    """Two Adasum tensors negotiated in one cycle reduce with PER-TENSOR
+    VHDD coefficients (reference adasum.h tensor_counts: one projection
+    per layer), not one projection over a fused concatenation."""
+    from horovod_tpu.ops.adasum import _numpy_adasum_rows
+
+    results = hvdrun.run(_adasum_per_tensor_fn, np=2, use_cpu=True,
+                         timeout=240, env=engine_env)
+    want_a = _numpy_adasum_rows([[1.0, 0.0], [0.0, 1.0]])
+    want_b = _numpy_adasum_rows([[2.0, 2.0], [2.0, -2.0]])
+    for res in results:
+        np.testing.assert_allclose(res["a"], want_a, rtol=1e-5)
+        np.testing.assert_allclose(res["b"], want_b, rtol=1e-5)
+
+
 def _torch_adasum_opt_fn():
     import numpy as np
     import torch
