@@ -1,0 +1,76 @@
+"""User-facing error paths: the guard rails a migrating script hits first.
+
+The reference's equivalents are its check_extension/initialization guards
+and per-op validation errors (common.h:161, controller.cc:378-611); here
+each misuse must fail loudly with an actionable message, not hang or
+produce garbage.
+"""
+
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+
+def test_rank_before_init_raises_cleanly():
+    # conftest initializes the in-process world, so before-init behavior
+    # needs a fresh interpreter.
+    code = (
+        "import os;"
+        "os.environ['JAX_PLATFORMS']='cpu';"
+        "import horovod_tpu as hvd;"
+        "from horovod_tpu.basics import NotInitializedError\n"
+        "try:\n"
+        "    hvd.rank()\n"
+        "    print('NO-ERROR')\n"
+        "except NotInitializedError as e:\n"
+        "    print('OK:', e)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240,
+    )
+    assert "OK:" in out.stdout, (out.stdout, out.stderr)
+    assert "init()" in out.stdout  # message tells the user what to call
+
+
+def test_double_init_is_noop():
+    topo_before = hvd.basics.global_topology()
+    hvd.init()  # second init must not rebuild or error (reference
+    #             InitializeHorovodOnce latches, operations.cc:604-650)
+    assert hvd.basics.global_topology() is topo_before
+
+
+def test_unknown_mesh_shape_raises():
+    with pytest.raises(ValueError, match="mesh"):
+        hvd.mesh("cube")
+
+
+def test_alltoall_nondivisible_dim0_raises_at_trace():
+    mesh = hvd.mesh("flat")
+    n = len(mesh.devices.flat)
+    x = jnp.ones((n * n + 1,), jnp.float32)  # dim0 % n != 0 per shard
+
+    with pytest.raises(ValueError, match="divide"):
+        shard_map(
+            lambda v: hvd.alltoall(v),
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=P(),
+        )(x)
+
+
+def test_broadcast_bad_root_raises():
+    with pytest.raises(ValueError):
+        hvd.broadcast(np.ones(2, np.float32), root_rank=99)
+
+
+def test_allreduce_unknown_op_rejected():
+    with pytest.raises((ValueError, TypeError, KeyError)):
+        hvd.allreduce(np.ones(2, np.float32), op="definitely-not-an-op")
