@@ -30,4 +30,13 @@ for engine in python native; do
             python examples/mnist.py --smoke
     done
 done
+
+# Frontend + subsystem examples at np=2 (one engine each is enough: the
+# differential fuzz test pins engine equivalence at the op level).
+for ex in torch_mnist tf2_mnist keras_mnist adasum_small_model \
+          checkpoint_resume estimator_train; do
+    echo "== example smoke: $ex =="
+    JAX_PLATFORMS=cpu \
+        python -m horovod_tpu.run -np 2 python "examples/$ex.py"
+done
 echo "matrix OK"
