@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -106,6 +107,11 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
+        # Mean over the DP axis: out_specs P() presents the return value as
+        # replicated, so the loss must actually BE global — otherwise the
+        # printed final_loss is one shard's and the finite-check could miss
+        # a NaN confined to another shard's data.
+        loss = jax.lax.pmean(loss, hvd.DP_AXIS)
         return optax.apply_updates(params, updates), opt_state, loss
 
     from jax import shard_map
@@ -219,6 +225,32 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
     return step, state, {"n_chips": n_chips, "global_batch": global_batch}
 
 
+def _is_unavailable(exc: BaseException) -> bool:
+    """True for the axon tunnel's transient failure signatures: backend
+    init UNAVAILABLE (ate BENCH_r03) or an UNAVAILABLE surfacing from the
+    first compile/execute RPC."""
+    msg = f"{type(exc).__name__}: {exc}"
+    return "UNAVAILABLE" in msg or "Unable to initialize backend" in msg
+
+
+def _retry_exec(args, exc: BaseException) -> None:
+    """Re-exec this script with a clean process (JAX caches a failed
+    backend for the life of the process, so in-process retry is useless).
+    Backoff doubles from 30s; total sleep across the default 4 retries is
+    ~7.5 min, inside the driver's window even with a slow first compile."""
+    delay = 30 * (2 ** args.retry_attempt)
+    print(
+        f"# axon UNAVAILABLE (attempt {args.retry_attempt + 1} of "
+        f"{args.attempts + 1}): {str(exc)[:200]}; retrying in {delay}s",
+        file=sys.stderr, flush=True,
+    )
+    time.sleep(delay)
+    argv = [a for a in sys.argv[1:] if not a.startswith("--retry-attempt")]
+    argv.append(f"--retry-attempt={args.retry_attempt + 1}")
+    os.execv(sys.executable,
+             [sys.executable, os.path.abspath(__file__)] + argv)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50",
@@ -242,53 +274,67 @@ def main() -> int:
                         help="space-to-depth stem (MLPerf TPU recipe)")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (dev mode; numbers not comparable)")
+    parser.add_argument("--attempts", type=int, default=4,
+                        help="retries (fresh process) on tunnel UNAVAILABLE")
+    parser.add_argument("--retry-attempt", type=int, default=0,
+                        help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args.cpu:
         # Env var too: hvd.init() re-asserts JAX_PLATFORMS from the
         # environment (to undo site-hook overrides), so config alone would
         # be flipped back.
-        import os
-
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
 
     is_gpt = args.model.startswith("gpt-")
     if args.batch_size is None:
         args.batch_size = 8 if is_gpt else 128
-    if is_gpt:
-        step, state, static = build_gpt_step(
-            args.model[len("gpt-"):], args.dtype, args.batch_size,
-            args.seq_len, attention=args.attention,
-        )
-        carry, const = state[:-1], state[-1:]
-    else:
-        step, state, static = build_step(
-            args.model, args.dtype, args.batch_size, args.image_size,
-            s2d_stem=args.s2d_stem,
-        )
-        carry, const = state[:3], state[3:]
-    n_chips = static["n_chips"]
-    global_batch = static["global_batch"]
-
     # Compiled cost analysis of the ACTUAL step: fwd+bwd+optimizer FLOPs as
     # XLA counts them post-fusion — no hand-derived 3x-forward estimates.
     # The AOT executable is also what we run (one compilation, not two);
     # cost_analysis is the post-SPMD-partitioning PER-DEVICE module, so
     # everything downstream is per-chip accounting.
-    compiled = step.lower(*carry, *const).compile()
+    # One try spans backend init + build + compile + warmup: all the places
+    # a tunnel UNAVAILABLE can surface before timing starts.
     try:
-        flops_per_step_per_chip = float(compiled.cost_analysis()["flops"])
-    except Exception:
-        flops_per_step_per_chip = float("nan")
-    step = compiled
+        if is_gpt:
+            step, state, static = build_gpt_step(
+                args.model[len("gpt-"):], args.dtype, args.batch_size,
+                args.seq_len, attention=args.attention,
+            )
+            carry, const = state[:-1], state[-1:]
+        else:
+            step, state, static = build_step(
+                args.model, args.dtype, args.batch_size, args.image_size,
+                s2d_stem=args.s2d_stem,
+            )
+            carry, const = state[:3], state[3:]
+        n_chips = static["n_chips"]
+        global_batch = static["global_batch"]
 
-    for _ in range(args.warmup):
-        *carry, loss = step(*carry, *const)
-    # device_get forces a real host round-trip: on experimental platforms
-    # block_until_ready has been observed to return before execution
-    # completes, which would make the timing fictitious.
-    float(loss)
+        compiled = step.lower(*carry, *const).compile()
+        try:
+            flops_per_step_per_chip = float(
+                compiled.cost_analysis()["flops"]
+            )
+        except Exception:
+            flops_per_step_per_chip = float("nan")
+        step = compiled
+
+        loss = None
+        for _ in range(args.warmup):
+            *carry, loss = step(*carry, *const)
+        # device_get forces a real host round-trip: on experimental
+        # platforms block_until_ready has been observed to return before
+        # execution completes, which would make the timing fictitious.
+        if loss is not None:
+            float(loss)
+    except Exception as exc:
+        if not args.cpu and _is_unavailable(exc) \
+                and args.retry_attempt < args.attempts:
+            _retry_exec(args, exc)  # never returns
+        raise
 
     t0 = time.perf_counter()
     for _ in range(args.iters):
@@ -322,7 +368,7 @@ def main() -> int:
         out["flops_per_image"] = round(
             flops_per_step_per_chip / args.batch_size / 1e9, 3
         )
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
     return 0
 
 

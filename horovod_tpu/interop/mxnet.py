@@ -91,7 +91,13 @@ def _write_back(tensor, value: np.ndarray):
 
 
 def _new_like(tensor, value: np.ndarray):
+    # Keep the source NDArray's context (reference mxnet/mpi_ops.py
+    # allocates outputs with ctx=tensor.context): without it, GPU-array
+    # collectives would silently return default-context (CPU) outputs.
     mx = _mx()
+    ctx = getattr(tensor, "context", None)
+    if ctx is not None:
+        return mx.nd.array(value, dtype=value.dtype, ctx=ctx)
     return mx.nd.array(value, dtype=value.dtype)
 
 

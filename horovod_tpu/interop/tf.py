@@ -137,6 +137,13 @@ def _run_collective(fn, tensor: tf.Tensor, out_dtype=None,
     return result
 
 
+def _sanitize_name(name: Optional[str], fallback: str = "var") -> str:
+    """TF variable name -> engine wire-name component.  One definition for
+    every call site: eager and graph Adasum branches MUST produce identical
+    keys for the same variable or cross-rank negotiation stalls."""
+    return (name or fallback).replace(":", "_").replace("/", "_")
+
+
 def _allreduce(tensor, name: Optional[str] = None, op: ReduceOp = Sum,
                prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Sum-allreduce primitive (reference tensorflow/mpi_ops.py:93-117;
@@ -297,10 +304,9 @@ def broadcast_variables(variables: Iterable[tf.Variable],
     tensorflow/__init__.py:166-191 broadcast_global_variables /
     broadcast_variables)."""
     for i, var in enumerate(variables):
-        name = getattr(var, "name", None) or f"var.{i}"
+        name = _sanitize_name(getattr(var, "name", None), f"var.{i}")
         value = broadcast(
-            tf.convert_to_tensor(var), root_rank,
-            f"broadcast.{name.replace(':', '_').replace('/', '_')}"
+            tf.convert_to_tensor(var), root_rank, f"broadcast.{name}"
         )
         var.assign(tf.cast(value, var.dtype))
 
@@ -520,9 +526,7 @@ def _adasum_reduce_deltas(compression, variables, starts):
             # DETECTABLE: if ranks filtered different None grads, their
             # name sets differ and negotiation stalls loudly instead of
             # Adasum-reducing unrelated same-shaped deltas silently.
-            ident = (getattr(v, "name", "") or "var").replace(
-                ":", "_"
-            ).replace("/", "_")
+            ident = _sanitize_name(getattr(v, "name", ""))
             fut = eager.allreduce_async(
                 comp.numpy(), Adasum, f"adasum.delta.{i}.{ident}"
             )
@@ -538,9 +542,15 @@ def _adasum_reduce_deltas(compression, variables, starts):
             )
             v.assign(s)
     else:
-        for v, s in zip(variables, starts):
+        for i, (v, s) in enumerate(zip(variables, starts)):
             comp, dctx = compression.compress(v - s)
-            reduced = allreduce(comp, op=Adasum)
+            # Same explicit index+identity key as the eager branch: without
+            # it the graph branch would fall back to per-process auto
+            # sequence names, pairing deltas across ranks only by trace
+            # order (asymmetric retracing would mispair silently).
+            ident = _sanitize_name(getattr(v, "name", ""))
+            reduced = _allreduce(comp, f"adasum.delta.{i}.{ident}",
+                                 op=Adasum)
             s.assign_add(
                 tf.cast(compression.decompress(reduced, dctx), s.dtype)
             )

@@ -593,6 +593,17 @@ class _DistributedAdasumOptimizer:
 
     def _make_hook(self):
         def hook(p: torch.Tensor):
+            # Reference torch/__init__.py _make_hook: a second reduction
+            # for the same parameter before step() would submit a duplicate
+            # in-flight tensor name AND snapshot a delta-holding parameter
+            # into the start buffer — fail loudly instead.
+            if id(p) in self._handles:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to step(). "
+                    "Increase backward_passes_per_step to accumulate "
+                    "gradients locally."
+                )
             self._passes[id(p)] = self._passes.get(id(p), 0) + 1
             if self._passes[id(p)] < self.backward_passes_per_step:
                 return

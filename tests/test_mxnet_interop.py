@@ -27,10 +27,11 @@ import horovod_tpu.run as hvdrun
 
 
 class FakeNDArray:
-    """Just enough NDArray: asnumpy(), slice-assign, shape/dtype."""
+    """Just enough NDArray: asnumpy(), slice-assign, shape/dtype, context."""
 
-    def __init__(self, value):
+    def __init__(self, value, ctx="fake_cpu(0)"):
         self._a = np.array(value)
+        self.context = ctx
 
     def asnumpy(self):
         return self._a.copy()
@@ -57,8 +58,8 @@ def install_fake_mxnet():
     mx = types.ModuleType("mxnet")
 
     nd = types.ModuleType("mxnet.nd")
-    nd.array = lambda value, dtype=None: FakeNDArray(
-        np.asarray(value, dtype=dtype)
+    nd.array = lambda value, dtype=None, ctx="fake_cpu(0)": FakeNDArray(
+        np.asarray(value, dtype=dtype), ctx=ctx
     )
     mx.nd = nd
 
@@ -121,10 +122,14 @@ def test_allreduce_identity_and_inplace():
     import horovod_tpu.interop.mxnet as hmx
 
     hmx.init()
-    x = FakeNDArray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x = FakeNDArray(np.arange(6, dtype=np.float32).reshape(2, 3),
+                    ctx="fake_gpu(1)")
     out = hmx.allreduce(x)
     assert isinstance(out, FakeNDArray)
     np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    # out-of-place results keep the source's context (reference allocates
+    # with ctx=tensor.context) instead of falling back to the default ctx
+    assert out.context == "fake_gpu(1)"
 
     y = FakeNDArray(np.ones(4, np.float32))
     ret = hmx.allreduce_(y, average=False)
