@@ -49,14 +49,26 @@ from ..utils.logging import get_logger
 LOG = get_logger("device_plane")
 
 PROC_AXIS = "hvdtpu_proc"
+LOCAL_AXIS = "hvdtpu_local"
 
 
 class DevicePlane:
-    """Compiled XLA collectives over a one-device-per-process mesh.
+    """Compiled XLA collectives over ALL addressable devices.
 
     The plane's mesh row order is process order, which ``basics.init`` pins
     to the engine's rank order (jax.distributed process_id == HVDTPU_RANK),
     so "row r" and "engine rank r" coincide by construction.
+
+    A process owning k>1 chips (the standard TPU-VM host topology: one
+    process, 4 chips) gets a 2-D ``(world, k)`` mesh: fused allreduce
+    payloads are split into k chunks fanned across the local chips, each
+    chunk psum-reduced across processes in parallel (every chip's ICI
+    links carry 1/k of the bytes), then re-gathered over the local axis —
+    the reference's LOCAL communicator tier (common.h:111-115,
+    mpi/mpi_context.cc) expressed as mesh axes instead of nested
+    communicators.  Row-shaped collectives (allgather/broadcast/alltoall/
+    reducescatter keep rank-indexed row semantics) run on the anchor-device
+    row mesh; results commit back to the caller's device either way.
     """
 
     def __init__(self) -> None:
@@ -71,14 +83,34 @@ class DevicePlane:
                 f"device/process mismatch: process indices {sorted(by_proc)} "
                 f"vs world {self.world} (is jax.distributed initialized?)"
             )
-        devs = [min(by_proc[p], key=lambda d: d.id) for p in range(self.world)]
-        self.device = devs[self.rank]
-        if self.device not in jax.local_devices():
+        for p in by_proc:
+            by_proc[p] = sorted(by_proc[p], key=lambda d: d.id)
+        self.local_devices = list(by_proc[self.rank])
+        self.device = self.local_devices[0]
+        missing = [d for d in self.local_devices
+                   if d not in jax.local_devices()]
+        if missing:
             raise RuntimeError(
-                f"plane device {self.device} for rank {self.rank} is not "
+                f"plane devices {missing} for rank {self.rank} are not "
                 "addressable from this process"
             )
+        devs = [by_proc[p][0] for p in range(self.world)]
         self.mesh = Mesh(np.asarray(devs, dtype=object), (PROC_AXIS,))
+        counts = {len(v) for v in by_proc.values()}
+        self.n_local = counts.pop() if len(counts) == 1 else 1
+        if self.n_local > 1:
+            grid = np.empty((self.world, self.n_local), dtype=object)
+            for p in range(self.world):
+                grid[p, :] = by_proc[p]
+            self.mesh2d = Mesh(grid, (PROC_AXIS, LOCAL_AXIS))
+        else:
+            self.mesh2d = None
+            if len(counts) > 0:
+                LOG.warning(
+                    "heterogeneous local device counts %s: allreduce runs "
+                    "on the one-device-per-process row mesh",
+                    sorted(len(v) for v in by_proc.values()),
+                )
 
     # ------------------------------------------------------------- staging
 
@@ -134,10 +166,85 @@ class DevicePlane:
             donate_argnums=(0,),
         )
 
+    # ------------------------------------------- sharded (multi-chip) path
+
+    def _stage_sharded(self, flat: jax.Array) -> jax.Array:
+        """Split a 1-D buffer into n_local chunks, chunk j committed to
+        local chip j; returns the (world, k, m) global array sharded over
+        the 2-D mesh.  All movement is chip-to-chip device_put — no host."""
+        k = self.n_local
+        n = int(flat.shape[0])
+        m = -(-n // k)
+        if m * k != n:
+            flat = jnp.pad(flat, (0, m * k - n))
+        resh = flat.reshape(k, m)
+        rows = [
+            jax.device_put(resh[j][None, None], self.local_devices[j])
+            for j in range(k)
+        ]
+        sharding = NamedSharding(self.mesh2d, P(PROC_AXIS, LOCAL_AXIS))
+        return jax.make_array_from_single_device_arrays(
+            (self.world, k, m), sharding, rows
+        )
+
+    @functools.lru_cache(maxsize=256)
+    def _allreduce_sharded_fn(self, reduce_op: int, pre: float, post: float,
+                              wire: str, acc: str, exact_int_avg: bool):
+        from ..ops.collectives import ReduceOp  # noqa: PLC0415
+
+        def f(x):  # x: (1, 1, m) — this chip's chunk of this rank's buffer
+            v = x[0, 0].astype(acc)
+            if pre != 1.0:
+                v = (v * pre).astype(wire).astype(acc)
+            if reduce_op == int(ReduceOp.MIN):
+                total = lax.pmin(v, PROC_AXIS)
+            elif reduce_op == int(ReduceOp.MAX):
+                total = lax.pmax(v, PROC_AXIS)
+            else:
+                total = lax.psum(v, PROC_AXIS)
+                if reduce_op == int(ReduceOp.AVERAGE):
+                    if exact_int_avg:
+                        total = total // self.world
+                    else:
+                        total = total / self.world
+            if post != 1.0:
+                total = total * post
+            # re-assemble: every local chip ends with the full reduced
+            # buffer, so the result can commit back to the caller's chip
+            full = lax.all_gather(total.astype(wire), LOCAL_AXIS)
+            return full[None]  # (1, k, m)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh2d,
+                in_specs=P(PROC_AXIS, LOCAL_AXIS), out_specs=P(PROC_AXIS),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
     def allreduce(self, flat: jax.Array, reduce_op: int, pre: float,
                   post: float, acc_dtype: str, exact_int_avg: bool) -> jax.Array:
         """Reduce a 1-D fused buffer across processes; returns the reduced
-        buffer (wire dtype) on this plane's device."""
+        buffer (wire dtype) on the caller's device (multi-chip path) or the
+        plane's anchor device."""
+        if self.mesh2d is not None:
+            n = int(flat.shape[0])
+            try:
+                caller_dev = next(iter(flat.devices()))
+            except Exception:
+                caller_dev = self.device
+            fn = self._allreduce_sharded_fn(
+                reduce_op, pre, post, str(flat.dtype), acc_dtype,
+                exact_int_avg,
+            )
+            out = fn(self._stage_sharded(flat))
+            shards = out.addressable_shards
+            pick = next(
+                (s for s in shards if s.data.devices() == {caller_dev}),
+                shards[0],
+            )
+            return pick.data[0].reshape(-1)[:n]
         fn = self._allreduce_fn(
             reduce_op, pre, post, str(flat.dtype), acc_dtype, exact_int_avg
         )

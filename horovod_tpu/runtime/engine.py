@@ -774,7 +774,18 @@ class EagerEngine:
                 else:
                     n = int(np.prod(shape)) if shape else 1
                     flats.append(jnp.zeros(n, wire_j))
-            buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            if len(flats) > 1:
+                try:
+                    buf = jnp.concatenate(flats)
+                except ValueError:
+                    # entries committed to different local chips: fuse on
+                    # the plane's anchor (chip-to-chip moves, no host)
+                    anchor = self._plane().device
+                    buf = jnp.concatenate(
+                        [jax.device_put(f, anchor) for f in flats]
+                    )
+            else:
+                buf = flats[0]
             total = self._plane_allreduce(
                 buf, dtype_name, reduce_op, pre, post, is_int
             )
