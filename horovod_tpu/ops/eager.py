@@ -55,6 +55,24 @@ def _auto_name(prefix: str) -> str:
     return f"{prefix}.noname.{_name_counter}"
 
 
+# Count of times _uncommit's zero-copy fast path failed and the host-copy
+# fallback ran.  The fast path reaches into jax._src.array.ArrayImpl; if a
+# jax upgrade moves that internal, results silently degrade to a host
+# round-trip — the exact quiet regression the device-plane tests exist to
+# catch.  So the degradation is LOUD: counted here (asserted zero by
+# tests/test_eager.py and the multiprocess no-host-copy test) and warned
+# once per process.
+_uncommit_fallbacks = 0
+_uncommit_warned = False
+
+
+def _array_impl_cls():
+    """The pinned jax internal, isolated so tests can simulate it moving."""
+    from jax._src.array import ArrayImpl  # noqa: PLC0415
+
+    return ArrayImpl
+
+
 def _uncommit(x):
     """Rebuild a single-device jax.Array WITHOUT device commitment.
 
@@ -63,13 +81,14 @@ def _uncommit(x):
     model.init output) must get an uncommitted array back, or feeding the
     result into a jit over a multi-device mesh fails with "incompatible
     devices" — the exact broadcast_parameters -> jit train-step flow.
-    Uses the ArrayImpl constructor (stable across the pinned jax version);
-    falls back to one host round-trip if the internals move."""
+    Uses the ArrayImpl constructor (pinned by tests/test_eager.py on this
+    jax version); falls back to one host round-trip — loudly — if the
+    internals move."""
+    global _uncommit_fallbacks, _uncommit_warned
     if not isinstance(x, jax.Array) or not getattr(x, "_committed", False):
         return x
     try:
-        from jax._src.array import ArrayImpl  # noqa: PLC0415
-
+        ArrayImpl = _array_impl_cls()
         shards = x.addressable_shards
         if len(shards) != 1:
             return x
@@ -79,7 +98,17 @@ def _uncommit(x):
             [shards[0].data],
             committed=False,
         )
-    except Exception:
+    except Exception as exc:
+        _uncommit_fallbacks += 1
+        if not _uncommit_warned:
+            _uncommit_warned = True
+            from ..utils.logging import get_logger  # noqa: PLC0415
+
+            get_logger("eager").warning(
+                "zero-copy uncommit fast path failed (%s: %s); results now "
+                "pay a host round-trip — the jax ArrayImpl internal moved",
+                type(exc).__name__, exc,
+            )
         return jax.device_put(np.asarray(x))
 
 
