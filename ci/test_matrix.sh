@@ -11,12 +11,16 @@ cd "$(dirname "$0")/.."
 echo "== build native engine =="
 make -C cpp
 
-echo "== unit + in-process multiprocess suite (builds cover both engines) =="
-python -m pytest tests/ -x -q
-
 if [ "${1:-full}" = "quick" ]; then
+    # per-commit tier: everything except the long pole (soak, differential
+    # fuzz, fp8 numerics contract, scaling gates) — see pytest.ini markers
+    echo "== quick tier: unit + multiprocess suite minus -m full =="
+    python -m pytest tests/ -x -q -m "not full"
     exit 0
 fi
+
+echo "== unit + in-process multiprocess suite (builds cover both engines) =="
+python -m pytest tests/ -x -q
 
 # Engine x world-size smoke matrix through the REAL launcher CLI (the
 # reference runs examples under both mpirun and horovodrun for every

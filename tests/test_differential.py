@@ -13,7 +13,7 @@ import pytest
 
 import horovod_tpu.run as hvdrun
 
-pytestmark = pytest.mark.multiprocess
+pytestmark = [pytest.mark.multiprocess, pytest.mark.full]
 
 
 def _schedule(seed: int, steps: int):

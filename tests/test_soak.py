@@ -9,7 +9,7 @@ import pytest
 
 import horovod_tpu.run as hvdrun
 
-pytestmark = pytest.mark.multiprocess
+pytestmark = [pytest.mark.multiprocess, pytest.mark.full]
 
 
 # engine_env fixture (python/native cross) lives in tests/conftest.py.
