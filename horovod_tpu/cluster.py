@@ -357,13 +357,13 @@ def spark_executor(spark_context=None):
     def launch(num_tasks: int, driver_addr: str, secret: str):
         try:
             import pyspark  # noqa: PLC0415
-        except ImportError as exc:  # pragma: no cover - no pyspark in CI
+        except ImportError as exc:
             raise RuntimeError(
                 "spark_executor requires pyspark; install it or use "
                 "local_executor / a custom adapter"
             ) from exc
         sc = spark_context or pyspark.SparkContext._active_spark_context
-        if sc is None:  # pragma: no cover
+        if sc is None:
             raise RuntimeError(
                 "no active SparkContext; create one before spark_executor"
             )

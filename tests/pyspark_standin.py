@@ -1,0 +1,131 @@
+"""Faithful `pyspark` stand-in at the RDD-API level (local mode).
+
+pyspark does not install on this image's python, so the Spark adapter
+(`horovod_tpu.cluster.spark_executor`) is exercised against this stand-in
+instead — the `tests/test_mxnet_interop.py` pattern, but process-faithful:
+like Spark local mode, every partition's function runs in its OWN python
+worker process (cloudpickled over a file, concurrent across partitions),
+and a task failure aborts the stage with the worker's traceback.  That is
+exactly the execution contract `spark_executor` depends on:
+``sc.parallelize(range(n), n).mapPartitionsWithIndex(f).collect()`` with
+``f`` blocking until the whole horovod_tpu job finishes
+(reference topology: spark/runner.py _make_spark_thread +
+mapPartitionsWithIndex).
+
+Install with ``install_fake_pyspark()`` BEFORE importing code that does
+``import pyspark``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import types
+from typing import Callable, List, Sequence
+
+import cloudpickle
+
+_WORKER_CODE = """
+import pickle, sys, traceback
+import cloudpickle
+with open(sys.argv[1], "rb") as fh:
+    fn, index, items = cloudpickle.load(fh)
+try:
+    out = list(fn(index, iter(items)))
+    payload = (True, out)
+except BaseException:
+    payload = (False, traceback.format_exc())
+with open(sys.argv[2], "wb") as fh:
+    pickle.dump(payload, fh)
+sys.exit(0 if payload[0] else 1)
+"""
+
+
+def _partition(data: Sequence, num_slices: int) -> List[list]:
+    """Spark's parallelize split: partition i gets
+    items [i*len//n, (i+1)*len//n)."""
+    n = len(data)
+    return [
+        list(data[(i * n) // num_slices : ((i + 1) * n) // num_slices])
+        for i in range(num_slices)
+    ]
+
+
+class _MappedRDD:
+    def __init__(self, partitions: List[list], fn: Callable):
+        self._partitions = partitions
+        self._fn = fn
+
+    def collect(self):
+        """Run every partition task in its own worker process,
+        concurrently (Spark local[n] task slots); gather yielded values in
+        partition order; abort the stage on the first task failure."""
+        workdir = tempfile.mkdtemp(prefix="fake_spark_")
+        procs = []
+        for index, items in enumerate(self._partitions):
+            in_path = os.path.join(workdir, f"task_{index}.in")
+            out_path = os.path.join(workdir, f"task_{index}.out")
+            with open(in_path, "wb") as fh:
+                cloudpickle.dump((self._fn, index, items), fh)
+            procs.append((
+                index, out_path,
+                subprocess.Popen(
+                    [sys.executable, "-c", _WORKER_CODE, in_path, out_path]
+                ),
+            ))
+        results = []
+        failure = None
+        for index, out_path, proc in procs:
+            proc.wait()
+            try:
+                with open(out_path, "rb") as fh:
+                    ok, value = pickle.load(fh)
+            except FileNotFoundError:
+                ok, value = False, f"worker {index} died without output"
+            if ok:
+                results.extend(value)
+            elif failure is None:
+                failure = (index, value)
+        if failure is not None:
+            raise Exception(
+                f"Job aborted due to stage failure: Task {failure[0]} "
+                f"in stage 0.0 failed:\n{failure[1]}"
+            )
+        return results
+
+
+class _RDD:
+    def __init__(self, data: list, num_slices: int):
+        self._partitions = _partition(data, num_slices)
+
+    def mapPartitionsWithIndex(self, fn: Callable) -> _MappedRDD:
+        return _MappedRDD(self._partitions, fn)
+
+    def getNumPartitions(self) -> int:
+        return len(self._partitions)
+
+
+class SparkContext:
+    _active_spark_context = None
+
+    def __init__(self, master: str = "local[*]", appName: str = "test"):
+        self.master = master
+        self.appName = appName
+        SparkContext._active_spark_context = self
+
+    def parallelize(self, data, numSlices: int) -> _RDD:
+        return _RDD(list(data), numSlices)
+
+    def stop(self) -> None:
+        SparkContext._active_spark_context = None
+
+
+def install_fake_pyspark() -> types.ModuleType:
+    mod = types.ModuleType("pyspark")
+    mod.SparkContext = SparkContext
+    mod.__version__ = "0.0-standin"
+    sys.modules["pyspark"] = mod
+    return mod
