@@ -26,6 +26,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import jax
@@ -60,7 +61,8 @@ def peak_flops_per_chip(device, dtype: str) -> float:
 
 
 def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
-                   attention: str = "flash"):
+                   attention: str = "flash", remat: bool = False,
+                   flash_block_q: int = 128, flash_block_k: int = 128):
     """GPT causal-LM training step (flash attention) — the long-context
     counterpart of the ResNet bench.  Returns ``(step, state, static)``
     like ``build_step``; throughput is reported in tokens/sec/chip."""
@@ -83,7 +85,8 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
         raise SystemExit("--dtype fp8 is resnet-only (e4m3 act storage)")
     compute_dtype = jnp.float32 if dtype == "fp32" else jnp.bfloat16
     model = gpt(size, dtype=compute_dtype, max_len=seq_len,
-                attention_impl=attention)
+                attention_impl=attention, remat=remat,
+                flash_block_q=flash_block_q, flash_block_k=flash_block_k)
     vocab = model.cfg.vocab_size
 
     global_batch = batch_size * n_chips
@@ -233,11 +236,31 @@ def _is_unavailable(exc: BaseException) -> bool:
     return "UNAVAILABLE" in msg or "Unable to initialize backend" in msg
 
 
+def _reexec_next_attempt(retry_attempt: int) -> None:
+    argv = [a for a in sys.argv[1:] if not a.startswith("--retry-attempt")]
+    argv.append(f"--retry-attempt={retry_attempt + 1}")
+    os.execv(sys.executable,
+             [sys.executable, os.path.abspath(__file__)] + argv)
+
+
+_watchdog_disarm = threading.Event()
+_last_progress = time.monotonic()
+
+
+def _touch_progress() -> None:
+    """Mark a phase boundary (build / compile / warmup done): the watchdog
+    only fires when NO phase completes for a whole deadline, so a long but
+    progressing run is never killed."""
+    global _last_progress
+    _last_progress = time.monotonic()
+
+
 def _retry_exec(args, exc: BaseException) -> None:
     """Re-exec this script with a clean process (JAX caches a failed
     backend for the life of the process, so in-process retry is useless).
     Backoff doubles from 30s; total sleep across the default 4 retries is
     ~7.5 min, inside the driver's window even with a slow first compile."""
+    _watchdog_disarm.set()  # the backoff sleep is not a hang
     delay = 30 * (2 ** args.retry_attempt)
     print(
         f"# axon UNAVAILABLE (attempt {args.retry_attempt + 1} of "
@@ -245,10 +268,40 @@ def _retry_exec(args, exc: BaseException) -> None:
         file=sys.stderr, flush=True,
     )
     time.sleep(delay)
-    argv = [a for a in sys.argv[1:] if not a.startswith("--retry-attempt")]
-    argv.append(f"--retry-attempt={args.retry_attempt + 1}")
-    os.execv(sys.executable,
-             [sys.executable, os.path.abspath(__file__)] + argv)
+    _reexec_next_attempt(args.retry_attempt)
+
+
+def _arm_watchdog(args) -> None:
+    """A half-down tunnel HANGS inside backend init / the first compile
+    rather than raising (observed: jax.devices() blocked >15 min), so the
+    except-based retry never fires.  A daemon thread re-execs the whole
+    process when no PHASE has completed for a whole deadline — execv
+    replaces the process even while the main thread is stuck in a C call.
+    Per-phase (not per-run) accounting keeps legitimately slow compiles
+    alive: each of init+build, compile, and warmup gets its own window."""
+    if args.cpu or args.watchdog_secs <= 0:
+        return
+
+    def _fire():
+        while True:
+            time.sleep(min(args.watchdog_secs, 30))
+            if _watchdog_disarm.is_set():
+                return
+            if time.monotonic() - _last_progress <= args.watchdog_secs:
+                continue
+            if args.retry_attempt < args.attempts:
+                print(
+                    f"# watchdog: no phase progress in {args.watchdog_secs}s"
+                    f" (attempt {args.retry_attempt + 1} of "
+                    f"{args.attempts + 1}); re-execing",
+                    file=sys.stderr, flush=True,
+                )
+                _reexec_next_attempt(args.retry_attempt)
+            print("# watchdog: no progress and no retries left; giving up",
+                  file=sys.stderr, flush=True)
+            os._exit(86)
+
+    threading.Thread(target=_fire, daemon=True).start()
 
 
 def main() -> int:
@@ -268,6 +321,11 @@ def main() -> int:
     parser.add_argument("--attention", default="flash",
                         choices=["flash", "reference"],
                         help="gpt attention schedule (flash = Pallas kernel)")
+    parser.add_argument("--remat", action="store_true",
+                        help="remat transformer blocks (dots-saveable "
+                        "policy): trades recompute for HBM -> larger batch")
+    parser.add_argument("--flash-block-q", type=int, default=128)
+    parser.add_argument("--flash-block-k", type=int, default=128)
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--s2d-stem", action="store_true",
@@ -276,6 +334,9 @@ def main() -> int:
                         help="force CPU (dev mode; numbers not comparable)")
     parser.add_argument("--attempts", type=int, default=4,
                         help="retries (fresh process) on tunnel UNAVAILABLE")
+    parser.add_argument("--watchdog-secs", type=int, default=900,
+                        help="per-attempt hang deadline (0 disables): "
+                        "re-exec if no result by then")
     parser.add_argument("--retry-attempt", type=int, default=0,
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
@@ -290,6 +351,7 @@ def main() -> int:
     is_gpt = args.model.startswith("gpt-")
     if args.batch_size is None:
         args.batch_size = 8 if is_gpt else 128
+    _arm_watchdog(args)
     # Compiled cost analysis of the ACTUAL step: fwd+bwd+optimizer FLOPs as
     # XLA counts them post-fusion — no hand-derived 3x-forward estimates.
     # The AOT executable is also what we run (one compilation, not two);
@@ -301,7 +363,9 @@ def main() -> int:
         if is_gpt:
             step, state, static = build_gpt_step(
                 args.model[len("gpt-"):], args.dtype, args.batch_size,
-                args.seq_len, attention=args.attention,
+                args.seq_len, attention=args.attention, remat=args.remat,
+                flash_block_q=args.flash_block_q,
+                flash_block_k=args.flash_block_k,
             )
             carry, const = state[:-1], state[-1:]
         else:
@@ -312,8 +376,10 @@ def main() -> int:
             carry, const = state[:3], state[3:]
         n_chips = static["n_chips"]
         global_batch = static["global_batch"]
+        _touch_progress()  # init+build done; compile gets a fresh window
 
         compiled = step.lower(*carry, *const).compile()
+        _touch_progress()  # compile done; warmup gets a fresh window
         try:
             flops_per_step_per_chip = float(
                 compiled.cost_analysis()["flops"]
@@ -325,6 +391,7 @@ def main() -> int:
         loss = None
         for _ in range(args.warmup):
             *carry, loss = step(*carry, *const)
+            _touch_progress()
         # device_get forces a real host round-trip: on experimental
         # platforms block_until_ready has been observed to return before
         # execution completes, which would make the timing fictitious.
@@ -368,6 +435,7 @@ def main() -> int:
         out["flops_per_image"] = round(
             flops_per_step_per_chip / args.batch_size / 1e9, 3
         )
+    _watchdog_disarm.set()
     print(json.dumps(out), flush=True)
     return 0
 

@@ -43,6 +43,11 @@ class TransformerConfig:
     sp_axis: Optional[str] = None  # mesh axis for ring/ulysses
     flash_block_q: int = 128
     flash_block_k: int = 128
+    # Rematerialize each block in the backward pass, keeping only matmul
+    # outputs with no batch dims (the standard TPU transformer remat
+    # policy): trades HBM for recomputed elementwise FLOPs, buying larger
+    # per-chip batches — the MFU lever when activations bound the batch.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -142,8 +147,14 @@ class GPT(nn.Module):
             )
         pos = jax.lax.dynamic_slice_in_dim(pos_table, pos_offset, s, axis=0)
         x = tok + pos.astype(cfg.dtype)[None]
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block{i}")(x, pos_offset)
+            x = block_cls(cfg, name=f"block{i}")(x, pos_offset)
         x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
         logits = nn.Dense(
             cfg.vocab_size, dtype=cfg.dtype, use_bias=False, name="head"

@@ -126,3 +126,38 @@ def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_gpt_remat_matches_no_remat():
+    """cfg.remat=True (dots-saveable block remat) is a pure memory/compute
+    trade: outputs AND gradients must match the non-remat model exactly on
+    the same params."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models.transformer import gpt
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 1024, size=(2, 32)), jnp.int32
+    )
+    base = gpt("nano", attention_impl="reference")
+    rematted = gpt("nano", attention_impl="reference", remat=True)
+    params = base.init(jax.random.PRNGKey(0), tokens)
+
+    def loss_fn(model):
+        def f(p):
+            logits = model.apply(p, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tokens
+            ).mean()
+        return f
+
+    l0, g0 = jax.value_and_grad(loss_fn(base))(params)
+    l1, g1 = jax.value_and_grad(loss_fn(rematted))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0, g1,
+    )
