@@ -162,11 +162,16 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
         "resnet50": models.ResNet50,
         "resnet101": models.ResNet101,
         "resnet18": models.ResNet18,
+        "vgg16": models.VGG16,
+        "vgg19": models.VGG19,
+        "inception3": models.InceptionV3,
     }[model_name]
-    model = model_cls(
-        num_classes=1000, compute_dtype=compute_dtype, s2d_stem=s2d_stem,
-        act_store_dtype=act_store,
-    )
+    extra = {}
+    if model_name.startswith("resnet"):
+        extra = {"s2d_stem": s2d_stem, "act_store_dtype": act_store}
+    elif dtype == "fp8":
+        raise SystemExit("--dtype fp8 is resnet-only (e4m3 act storage)")
+    model = model_cls(num_classes=1000, compute_dtype=compute_dtype, **extra)
 
     rng = jax.random.PRNGKey(0)
     global_batch = batch_size * n_chips
@@ -182,7 +187,9 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
     )
 
     variables = model.init(rng, images[:2], train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    # VGG has no BN; {} keeps the step signature uniform across models
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
     params = hvd.broadcast_parameters(params, root_rank=0)
 
     tx = DistributedOptimizer(
@@ -201,7 +208,7 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels
             ).mean()
-            return loss, mutated["batch_stats"]
+            return loss, dict(mutated).get("batch_stats", {})
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params
@@ -308,6 +315,7 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50",
                         choices=["resnet50", "resnet101", "resnet18",
+                                 "vgg16", "vgg19", "inception3",
                                  "gpt-small", "gpt-medium", "gpt-large"])
     parser.add_argument("--dtype", default="bf16",
                         choices=["bf16", "fp32", "fp8"],
@@ -391,12 +399,18 @@ def main() -> int:
         loss = None
         for _ in range(args.warmup):
             *carry, loss = step(*carry, *const)
-            _touch_progress()
+            _touch_progress()  # dispatch-time only; the sync is below
         # device_get forces a real host round-trip: on experimental
         # platforms block_until_ready has been observed to return before
         # execution completes, which would make the timing fictitious.
         if loss is not None:
             float(loss)
+        # Warmup EXECUTED on device: the backend is alive and the step
+        # runs.  Disarm the watchdog here — step calls are async
+        # dispatches, so the timed loop's real execution all happens
+        # inside the final float(loss) and a long measurement (big model,
+        # many --iters) would otherwise be indistinguishable from a hang.
+        _watchdog_disarm.set()
     except Exception as exc:
         if not args.cpu and _is_unavailable(exc) \
                 and args.retry_attempt < args.attempts:

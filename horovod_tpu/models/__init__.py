@@ -6,6 +6,8 @@ carries TPU-first flax implementations so the framework's benchmarks and
 examples are self-contained: NHWC layouts, bfloat16 compute with fp32
 params, channel sizes that tile onto the 128x128 MXU."""
 
+from .inception import InceptionV3  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
 from .simple import MLP, ConvNet  # noqa: F401
 from .transformer import GPT, GPT_CONFIGS, TransformerConfig, gpt  # noqa: F401
+from .vgg import VGG16, VGG19  # noqa: F401

@@ -1,0 +1,172 @@
+"""Inception V3, TPU-first.
+
+The reference's top headline benchmark model
+(/root/reference/docs/benchmarks.rst:13-14: Inception V3 at ~90% scaling
+on 512 GPUs; README.rst:79).  Canonical V3 geometry (stem, 3x InceptionA,
+B-reduction, 4x InceptionC, D-reduction, 2x InceptionE, global pool, FC)
+with conv+BN+relu everywhere.
+
+TPU-first choices: NHWC, bf16 compute / fp32 params and BN statistics,
+a global mean instead of the fixed 8x8 average pool so any input size
+(299 canonical, smaller in tests) compiles statically, no aux head (the
+benchmark measures the main tower, as tf_cnn_benchmarks does by default).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        # BN in fp32 (stats must not accumulate in bf16), output back in
+        # compute dtype so the next conv's operand stays MXU-native.
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x).astype(self.dtype)
+
+
+def _pool_avg(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d = self.dtype
+        b1 = ConvBN(64, (1, 1), dtype=d)(x, train)
+        b5 = ConvBN(48, (1, 1), dtype=d)(x, train)
+        b5 = ConvBN(64, (5, 5), dtype=d)(b5, train)
+        b3 = ConvBN(64, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, train)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, train)
+        bp = ConvBN(self.pool_features, (1, 1), dtype=d)(_pool_avg(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):  # 17x17 reduction
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d = self.dtype
+        b3 = ConvBN(384, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(x, train)
+        bd = ConvBN(64, (1, 1), dtype=d)(x, train)
+        bd = ConvBN(96, (3, 3), dtype=d)(bd, train)
+        bd = ConvBN(96, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d, c7 = self.dtype, self.channels_7x7
+        b1 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b7 = ConvBN(c7, (1, 1), dtype=d)(x, train)
+        b7 = ConvBN(c7, (1, 7), dtype=d)(b7, train)
+        b7 = ConvBN(192, (7, 1), dtype=d)(b7, train)
+        bb = ConvBN(c7, (1, 1), dtype=d)(x, train)
+        bb = ConvBN(c7, (7, 1), dtype=d)(bb, train)
+        bb = ConvBN(c7, (1, 7), dtype=d)(bb, train)
+        bb = ConvBN(c7, (7, 1), dtype=d)(bb, train)
+        bb = ConvBN(192, (1, 7), dtype=d)(bb, train)
+        bp = ConvBN(192, (1, 1), dtype=d)(_pool_avg(x), train)
+        return jnp.concatenate([b1, b7, bb, bp], axis=-1)
+
+
+class InceptionD(nn.Module):  # 8x8 reduction
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d = self.dtype
+        b3 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(320, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(b3, train)
+        b7 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b7 = ConvBN(192, (1, 7), dtype=d)(b7, train)
+        b7 = ConvBN(192, (7, 1), dtype=d)(b7, train)
+        b7 = ConvBN(192, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=d)(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        d = self.dtype
+        b1 = ConvBN(320, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(384, (1, 1), dtype=d)(x, train)
+        b3 = jnp.concatenate([
+            ConvBN(384, (1, 3), dtype=d)(b3, train),
+            ConvBN(384, (3, 1), dtype=d)(b3, train),
+        ], axis=-1)
+        bb = ConvBN(448, (1, 1), dtype=d)(x, train)
+        bb = ConvBN(384, (3, 3), dtype=d)(bb, train)
+        bb = jnp.concatenate([
+            ConvBN(384, (1, 3), dtype=d)(bb, train),
+            ConvBN(384, (3, 1), dtype=d)(bb, train),
+        ], axis=-1)
+        bp = ConvBN(192, (1, 1), dtype=d)(_pool_avg(x), train)
+        return jnp.concatenate([b1, b3, bb, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.compute_dtype
+        x = x.astype(d)
+        # stem (299 -> 35)
+        x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(32, (3, 3), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(64, (3, 3), dtype=d)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = ConvBN(80, (1, 1), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(192, (3, 3), padding="VALID", dtype=d)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # 35x35
+        x = InceptionA(32, dtype=d)(x, train)
+        x = InceptionA(64, dtype=d)(x, train)
+        x = InceptionA(64, dtype=d)(x, train)
+        x = InceptionB(dtype=d)(x, train)
+        # 17x17
+        x = InceptionC(128, dtype=d)(x, train)
+        x = InceptionC(160, dtype=d)(x, train)
+        x = InceptionC(160, dtype=d)(x, train)
+        x = InceptionC(192, dtype=d)(x, train)
+        x = InceptionD(dtype=d)(x, train)
+        # 8x8
+        x = InceptionE(dtype=d)(x, train)
+        x = InceptionE(dtype=d)(x, train)
+        # global mean (size-agnostic stand-in for the fixed 8x8 pool)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=d)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,61 @@
+"""VGG, TPU-first.
+
+One of the reference's three headline benchmark families
+(/root/reference/docs/benchmarks.rst:13-14: VGG-16 at ~68% scaling on 512
+GPUs — the hardest of the three to scale because its parameter volume is
+dominated by the giant FC matmuls, which stress the allreduce).
+
+TPU-first choices: NHWC layout, bf16 compute / fp32 params, channel
+counts multiples of 64 (MXU tiling), no BN (classic VGG geometry, as in
+tf_cnn_benchmarks' vgg16).  The classifier flattens (canonical geometry),
+so the first FC's parameter shape follows the input resolution: init and
+apply at the same size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Classic configuration D (VGG-16): 13 convs, 'M' = 2x2 max pool.
+_VGG16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M")
+_VGG19 = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence = _VGG16
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no BN/dropout state in the benchmark geometry
+        x = x.astype(self.compute_dtype)
+        for spec in self.cfg:
+            if spec == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(spec, (3, 3), padding="SAME",
+                            dtype=self.compute_dtype)(x)
+                x = nn.relu(x)
+        # 224 input -> 7x7x512. Flatten feeds the 25088x4096 FC, the
+        # parameter giant that makes VGG the allreduce stress test.
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.compute_dtype)(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.compute_dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def VGG16(num_classes: int = 1000, compute_dtype: Any = jnp.bfloat16,
+          **_ignored) -> VGG:
+    return VGG(_VGG16, num_classes, compute_dtype)
+
+
+def VGG19(num_classes: int = 1000, compute_dtype: Any = jnp.bfloat16,
+          **_ignored) -> VGG:
+    return VGG(_VGG19, num_classes, compute_dtype)

@@ -69,8 +69,17 @@ def summarize_xplane(logdir: str) -> None:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="/tmp/hvdtpu_trace")
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "resnet101", "resnet18",
+                                 "vgg16", "vgg19", "inception3",
+                                 "gpt-small", "gpt-medium", "gpt-large"])
     parser.add_argument("--dtype", default="bf16")
-    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=None,
+                        help="default: 128 resnet, 8 gpt")
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--flash-block-q", type=int, default=128)
+    parser.add_argument("--flash-block-k", type=int, default=128)
     parser.add_argument("--iters", type=int, default=5)
     parser.add_argument("--summarize-only", action="store_true")
     args = parser.parse_args()
@@ -81,21 +90,30 @@ def main() -> int:
 
     import jax
 
-    from bench import build_step  # the EXACT step bench.py times
+    # the EXACT steps bench.py times
+    from bench import build_gpt_step, build_step
 
-    step, state, _ = build_step("resnet50", args.dtype, args.batch_size)
-    params, batch_stats, opt_state, images, labels = state
+    is_gpt = args.model.startswith("gpt-")
+    if args.batch_size is None:
+        args.batch_size = 8 if is_gpt else 128
+    if is_gpt:
+        step, state, _ = build_gpt_step(
+            args.model[len("gpt-"):], args.dtype, args.batch_size,
+            args.seq_len, remat=args.remat,
+            flash_block_q=args.flash_block_q,
+            flash_block_k=args.flash_block_k,
+        )
+        carry, const = list(state[:-1]), list(state[-1:])
+    else:
+        step, state, _ = build_step(args.model, args.dtype, args.batch_size)
+        carry, const = list(state[:3]), list(state[3:])
     # warmup/compile
     for _ in range(3):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
-        )
+        *carry, loss = step(*carry, *const)
     float(loss)
     jax.profiler.start_trace(args.out)
     for _ in range(args.iters):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
-        )
+        *carry, loss = step(*carry, *const)
     float(loss)
     jax.profiler.stop_trace()
     print("trace written to", args.out)
