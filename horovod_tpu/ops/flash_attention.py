@@ -206,16 +206,16 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
     nq, nk = s // bq, s // bk
     f32 = jnp.float32
     LANES = 128
-    # lse/delta ride the same broadcast 128-lane layout as the forward's
-    # softmax state (and the public jax TPU kernel's l/m/di blocks):
-    # Mosaic requires the last two block dims to be (8k, 128k) or full,
-    # which a narrow (1, bq) block over [Z, S] violates on hardware.
-    delta = (do.astype(f32) * o.astype(f32)).sum(-1)  # [Z, S]
+    # lse rides the same broadcast 128-lane layout as the forward's
+    # softmax state (and the public jax TPU kernel's l/m blocks): Mosaic
+    # requires the last two block dims to be (8k, 128k) or full, which a
+    # narrow (1, bq) block over [Z, S] violates on hardware.  delta
+    # (rowsum(do*o)) needs no such array: it is recomputed per tile from
+    # the o tile, which is cheaper than streaming a (Z, S, 128) f32
+    # broadcast through HBM twice.
     lse_w = jnp.broadcast_to(lse[:, :, None], (z, s, LANES))
-    delta_w = jnp.broadcast_to(delta[:, :, None], (z, s, LANES))
 
-    def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        i, j):
+    def _recompute_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j):
         """The shared backward recurrence: rebuild this tile's softmax P
         from the saved logsumexp and form dS = P * (dP - delta).  One
         definition for both passes so the mask/scale math cannot drift."""
@@ -223,6 +223,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
         kb = k_ref[0].astype(f32)
         vb = v_ref[0].astype(f32)
         dob = do_ref[0].astype(f32)
+        delta_col = (dob * o_ref[0].astype(f32)).sum(-1)[:, None]
         st = jnp.dot(qb, kb.T, preferred_element_type=f32) * scale
         p = jnp.exp(st - lse_ref[0][:, :1])
         if causal:
@@ -230,10 +231,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             p = jnp.where(k_pos > q_pos, 0.0, p)
         dp = jnp.dot(dob, vb.T, preferred_element_type=f32)
-        ds = p * (dp - delta_ref[0][:, :1])
+        ds = p * (dp - delta_col)
         return qb, kb, dob, p, ds
 
-    def kernel_dkdv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    def kernel_dkdv(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc):
         j = pl.program_id(1)
         i = pl.program_id(2)
@@ -249,7 +250,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
         @pl.when(needed)
         def _compute():
             qb, _, dob, p, ds = _recompute_p_ds(
-                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j
+                q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j
             )
             dv_acc[...] += jnp.dot(p.T, dob, preferred_element_type=f32)
             dk_acc[...] += jnp.dot(ds.T, qb,
@@ -260,7 +261,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
             dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
-    def kernel_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    def kernel_dq(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                   dq_ref, dq_acc):
         i = pl.program_id(1)
         j = pl.program_id(2)
@@ -274,7 +275,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
         @pl.when(needed)
         def _compute():
             _, kb, _, _, ds = _recompute_p_ds(
-                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, i, j
+                q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j
             )
             dq_acc[...] += jnp.dot(ds, kb,
                                    preferred_element_type=f32) * scale
@@ -292,9 +293,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             qkv_spec(bq, lambda zi, ji, ii: (zi, ii, 0)),   # q
             qkv_spec(bk, lambda zi, ji, ii: (zi, ji, 0)),   # k
             qkv_spec(bk, lambda zi, ji, ii: (zi, ji, 0)),   # v
+            qkv_spec(bq, lambda zi, ji, ii: (zi, ii, 0)),   # o
             qkv_spec(bq, lambda zi, ji, ii: (zi, ii, 0)),   # do
             lane_spec(lambda zi, ji, ii: (zi, ii, 0)),      # lse
-            lane_spec(lambda zi, ji, ii: (zi, ii, 0)),      # delta
         ],
         out_specs=[
             qkv_spec(bk, lambda zi, ji, ii: (zi, ji, 0)),
@@ -312,7 +313,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse_w, delta_w)
+    )(q, k, v, o, do, lse_w)
     (dq,) = pl.pallas_call(
         kernel_dq,
         grid=(z, nq, nk),
@@ -321,7 +322,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             qkv_spec(bk, lambda zi, ii, ji: (zi, ji, 0)),
             qkv_spec(bk, lambda zi, ii, ji: (zi, ji, 0)),
             qkv_spec(bq, lambda zi, ii, ji: (zi, ii, 0)),
-            lane_spec(lambda zi, ii, ji: (zi, ii, 0)),
+            qkv_spec(bq, lambda zi, ii, ji: (zi, ii, 0)),
             lane_spec(lambda zi, ii, ji: (zi, ii, 0)),
         ],
         out_specs=[qkv_spec(bq, lambda zi, ii, ji: (zi, ii, 0))],
@@ -331,7 +332,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse_w, delta_w)
+    )(q, k, v, o, do, lse_w)
     return dq, dk, dv
 
 

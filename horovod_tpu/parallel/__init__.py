@@ -9,6 +9,9 @@ from .hierarchical import (  # noqa: F401
 from .ring_attention import (  # noqa: F401
     local_attention,
     ring_attention,
+    ring_attention_zigzag,
     ulysses_attention,
+    zigzag_shard,
+    zigzag_unshard,
 )
 from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401

@@ -21,7 +21,17 @@ Two schedules, both called inside ``shard_map`` over a sequence axis:
   output flips back.  Two all-to-alls total; preferable when
   num_heads >= axis size and ICI all-to-all bandwidth is plentiful.
 
-Both are reverse-mode differentiable (scan + ppermute/all_to_all have
+* :func:`ring_attention_zigzag` — the load-balanced causal ring.  A
+  contiguous causal ring is latency-bound by its last rank (it attends at
+  every step even though earlier ranks skip masked blocks); zigzag
+  placement (rank i holds sequence chunks i and 2P-1-i) balances the
+  triangle so EVERY rank computes exactly two half-size quadrant attends
+  per ring step — ~2x less critical-path attention compute than the
+  contiguous causal ring, with no masking inside the steady-state loop at
+  all (the only masked compute is the self-chunk diagonal, handled once
+  before the ring turns).
+
+All are reverse-mode differentiable (scan + ppermute/all_to_all have
 transpose rules), so they drop into a training step directly.
 """
 
@@ -33,7 +43,42 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+__all__ = [
+    "ring_attention",
+    "ring_attention_zigzag",
+    "ulysses_attention",
+    "local_attention",
+    "zigzag_positions",
+    "zigzag_shard",
+    "zigzag_unshard",
+]
+
+
+def _online_softmax_update(state, q_sub, k_sub, v_sub, scale, mask=None):
+    """One online-softmax accumulation of ``q_sub`` (fp32) against a K/V
+    block — the single definition of the m/l/o recurrence shared by the
+    contiguous ring and the zigzag ring.  ``state`` is ``(o [b,sq,h,d],
+    m [b,h,sq], l [b,h,sq])`` in fp32; ``mask`` is a bool ``[sq, sk]``
+    (True = masked) used only for diagonal/partial blocks."""
+    o, m, l = state
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q_sub, k_sub.astype(jnp.float32))
+        * scale
+    )
+    if mask is not None:
+        scores = jnp.where(mask[None, None], -jnp.inf, scores)
+    m_new = jnp.maximum(m, scores.max(-1))
+    # exp(-inf - -inf) can only arise for a q row with no unmasked key in
+    # ANY block folded so far; both ring schedules fold the (diagonal-
+    # masked) self block first, so m is finite from the first update on.
+    p = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)  # [b,h,q]
+    l = l * corr + p.sum(-1)
+    o = (
+        o * corr.transpose(0, 2, 1)[..., None]
+        + jnp.einsum("bhqk,bkhd->bqhd", p, v_sub.astype(jnp.float32))
+    )
+    return o, m_new, l
 
 
 def local_attention(
@@ -96,32 +141,41 @@ def ring_attention(
     qf = q.astype(jnp.float32)
     q_pos = me * s_local + jnp.arange(s_local)
 
+    def attend_block(operands):
+        k_blk, v_blk, o, m, l, src = operands
+        mask = None
+        if causal:
+            kv_pos = src * s_local + jnp.arange(s_local)
+            mask = kv_pos[None, :] > q_pos[:, None]
+        return _online_softmax_update(
+            (o, m, l), qf, k_blk, v_blk, scale_, mask=mask
+        )
+
     def step(carry, t):
         k_blk, v_blk, o, m, l = carry
         src = (me - t) % size  # original owner of the block in hand
-        scores = (
-            jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
-            * scale_
-        )
         if causal:
-            kv_pos = src * s_local + jnp.arange(s_local)
-            scores = jnp.where(
-                kv_pos[None, :] > q_pos[:, None], -jnp.inf, scores
+            # A block from a later rank is ENTIRELY above the diagonal:
+            # skip its einsums outright.  In this bulk-synchronous ring
+            # the saving is FLOPs/energy, not wall-clock — every step
+            # ends at the ppermute, and some rank (always the last)
+            # attends at every step, so step latency is unchanged.  The
+            # latency fix is load-balanced sequence placement:
+            # ring_attention_zigzag, which gives every rank the same
+            # per-step compute.  The diagonal-only mask refinement
+            # (src == me) is deliberately not special-cased: the where
+            # costs ~1/d of the einsum.
+            o, m, l = lax.cond(
+                src > me,
+                lambda ops: (ops[2], ops[3], ops[4]),
+                attend_block,
+                (k_blk, v_blk, o, m, l, src),
             )
-        m_new = jnp.maximum(m, scores.max(-1))
-        # exp(-inf - -inf) can only arise for a row with no unmasked key in
-        # ANY block so far; causal rings always see the self-block at t=0
-        # (the diagonal is unmasked), so m_new is finite from step 0 on.
-        p = jnp.exp(scores - m_new[..., None])
-        corr = jnp.exp(m - m_new)  # [b,h,q]
-        l = l * corr + p.sum(-1)
-        o = (
-            o * corr.transpose(0, 2, 1)[..., None]
-            + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
-        )
+        else:
+            o, m, l = attend_block((k_blk, v_blk, o, m, l, src))
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, o, m_new, l), None
+        return (k_blk, v_blk, o, m, l), None
 
     o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
     m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
@@ -131,6 +185,143 @@ def ring_attention(
     )
     del k_, v_, m
     out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _zigzag_order(size: int, seq: int):
+    """Chunk permutation of the zigzag layout: [0, 2P-1, 1, 2P-2, ...]."""
+    if seq % (2 * size):
+        raise ValueError(f"sequence {seq} not divisible by 2*size={2 * size}")
+    return [c for i in range(size) for c in (i, 2 * size - 1 - i)]
+
+
+def _apply_chunk_order(x, order, axis):
+    chunks = jnp.split(x, len(order), axis)
+    return jnp.concatenate([chunks[c] for c in order], axis)
+
+
+def zigzag_shard(x: jax.Array, size: int, axis: int = 0) -> jax.Array:
+    """Reorder a GLOBAL sequence so a contiguous equal split over ``size``
+    ranks gives each rank i the zigzag pair (chunk i, chunk 2*size-1-i).
+
+    Feed the result through your normal sequence sharding (shard_map
+    in_specs along ``axis``); pair with :func:`zigzag_unshard` on gathered
+    outputs.  Sequence length must divide by 2*size."""
+    return _apply_chunk_order(x, _zigzag_order(size, x.shape[axis]), axis)
+
+
+def zigzag_unshard(x: jax.Array, size: int, axis: int = 0) -> jax.Array:
+    """Inverse of :func:`zigzag_shard` on the same global view."""
+    order = _zigzag_order(size, x.shape[axis])
+    import numpy as _np
+
+    return _apply_chunk_order(x, list(_np.argsort(order)), axis)
+
+
+def zigzag_positions(axis_index, size: int, s_local: int) -> jax.Array:
+    """Global token positions of rank ``axis_index``'s local rows under
+    the zigzag layout (first half = chunk i, second half = chunk
+    2*size-1-i)."""
+    half = s_local // 2
+    lo = axis_index * half + jnp.arange(half)
+    hi = (2 * size - 1 - axis_index) * half + jnp.arange(half)
+    return jnp.concatenate([lo, hi])
+
+
+def ring_attention_zigzag(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Load-balanced CAUSAL ring attention over zigzag-placed sequences.
+
+    Layout contract: the global sequence was passed through
+    :func:`zigzag_shard` before sharding, so this rank's local rows are
+    ``concat(chunk_me, chunk_{2P-1-me})`` in global order (positions from
+    :func:`zigzag_positions`).  Outputs are in the same local layout;
+    gather + :func:`zigzag_unshard` recovers global order.
+
+    Why it balances: with contiguous placement the causal triangle gives
+    rank P-1 work at every ring step while rank 0 idles after step 0.
+    With the zigzag pair, quadrant (q-half x kv-half) visibility at step
+    t (kv block originally from ``src = (me-t) % P``) is STATIC:
+
+    - early-q vs late-kv: never visible (skipped by construction),
+    - late-q  vs early-kv: always fully visible,
+    - early-q vs early-kv: fully visible iff src < me,
+    - late-q  vs late-kv:  fully visible iff src > me,
+
+    so after the t=0 self-block (the only masked compute), every rank
+    runs exactly TWO unmasked half-size attends per step.  Critical-path
+    attention FLOPs are ~half the contiguous causal ring's and uniform
+    across ranks.
+    """
+    size = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    if s_local % 2:
+        raise ValueError("zigzag requires an even local sequence length")
+    half = s_local // 2
+    scale_ = scale if scale is not None else d ** -0.5
+    perm = [(j, (j + 1) % size) for j in range(size)]
+    qf = q.astype(jnp.float32)
+
+    def accum(state, q_sub, k_sub, v_sub, mask=None):
+        return _online_softmax_update(state, q_sub, k_sub, v_sub, scale_,
+                                      mask=mask)
+
+    def init_state():
+        return (
+            jnp.zeros((b, half, h, d), jnp.float32),
+            jnp.full((b, h, half), -jnp.inf, jnp.float32),
+            jnp.zeros((b, h, half), jnp.float32),
+        )
+
+    q_lo, q_hi = qf[:, :half], qf[:, half:]
+
+    # t = 0: the self block — the ONLY masked compute in the schedule.
+    tri = jnp.arange(half)[None, :] > jnp.arange(half)[:, None]  # k > q
+    st_lo = accum(init_state(), q_lo, k[:, :half], v[:, :half], mask=tri)
+    st_hi = accum(init_state(), q_hi, k[:, half:], v[:, half:], mask=tri)
+    # late-q sees ALL of its own early chunk (me < 2P-1-me always)
+    st_hi = accum(st_hi, q_hi, k[:, :half], v[:, :half])
+
+    def step(carry, t):
+        k_blk, v_blk, st_lo, st_hi = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (me - t) % size  # original owner of the block now in hand
+        kc, vc = k_blk[:, :half], v_blk[:, :half]   # src's early chunk
+        kd, vd = k_blk[:, half:], v_blk[:, half:]   # src's late chunk
+        # exactly one of the two conds fires per step (src != me here)
+        st_lo = lax.cond(
+            src < me,
+            lambda st: accum(st, q_lo, kc, vc),
+            lambda st: st,
+            st_lo,
+        )
+        st_hi = lax.cond(
+            src > me,
+            lambda st: accum(st, q_hi, kd, vd),
+            lambda st: st,
+            st_hi,
+        )
+        st_hi = accum(st_hi, q_hi, kc, vc)
+        return (k_blk, v_blk, st_lo, st_hi), None
+
+    (k_, v_, st_lo, st_hi), _ = lax.scan(
+        step, (k, v, st_lo, st_hi), jnp.arange(1, size)
+    )
+    del k_, v_
+
+    def finish(state):
+        o, _, l = state
+        return o / l.transpose(0, 2, 1)[..., None]
+
+    out = jnp.concatenate([finish(st_lo), finish(st_hi)], axis=1)
     return out.astype(q.dtype)
 
 

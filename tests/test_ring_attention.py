@@ -145,3 +145,86 @@ class TestLocalAttentionOffsets:
             np.asarray(half), np.asarray(full[:, S // 2:]), atol=2e-5,
             rtol=2e-5,
         )
+
+
+class TestZigzagRing:
+    """Load-balanced causal ring: zigzag layout round-trips and the
+    distributed result matches single-device causal attention."""
+
+    def test_shard_roundtrip(self):
+        from horovod_tpu.parallel import zigzag_shard, zigzag_unshard
+
+        x = jnp.arange(B * S * 3, dtype=jnp.float32).reshape(B, S, 3)
+        z = zigzag_shard(x, 8, axis=1)
+        back = zigzag_unshard(z, 8, axis=1)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+        # rank 0's shard is chunks (0, 15): rows 0,1 and 30,31
+        s_local = S // 8
+        np.testing.assert_array_equal(
+            np.asarray(z[:, :s_local]),
+            np.asarray(jnp.concatenate([x[:, 0:2], x[:, 30:32]], axis=1)),
+        )
+
+    def test_matches_local_attention_causal(self):
+        from horovod_tpu.parallel import (
+            ring_attention_zigzag, zigzag_shard, zigzag_unshard,
+        )
+
+        q, k, v = _qkv(3)
+        ref = local_attention(q, k, v, causal=True)
+        zz = lambda t: zigzag_shard(t, 8, axis=1)
+        out_z = _sharded(ring_attention_zigzag)(zz(q), zz(k), zz(v))
+        out = zigzag_unshard(out_z, 8, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_grads_match(self):
+        from horovod_tpu.parallel import (
+            ring_attention_zigzag, zigzag_shard, zigzag_unshard,
+        )
+
+        q, k, v = _qkv(4)
+        zz = lambda t: zigzag_shard(t, 8, axis=1)
+        uz = lambda t: zigzag_unshard(t, 8, axis=1)
+        w = jnp.asarray(
+            np.random.RandomState(5).randn(B, S, H, D), jnp.float32
+        )
+
+        def loss_ref(q, k, v):
+            return (local_attention(q, k, v, causal=True) * w).sum()
+
+        def loss_zig(q, k, v):
+            out = _sharded(ring_attention_zigzag)(zz(q), zz(k), zz(v))
+            return (uz(out) * w).sum()
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_zig = jax.grad(loss_zig, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_zig, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5
+            )
+
+    def test_odd_local_length_rejected(self):
+        from horovod_tpu.parallel import ring_attention_zigzag
+
+        q = jnp.zeros((1, 8, 2, 4))  # 8 over 8 devices -> s_local 1 (odd)
+        with pytest.raises(Exception, match="even local sequence"):
+            _sharded(ring_attention_zigzag)(q, q, q)
+
+
+def test_zigzag_positions_match_layout():
+    """zigzag_positions(i) must be exactly the global positions of rank
+    i's rows after zigzag_shard + contiguous split."""
+    from horovod_tpu.parallel import zigzag_shard
+    from horovod_tpu.parallel.ring_attention import zigzag_positions
+
+    size, s = 4, 24
+    x = jnp.arange(s)  # value == global position
+    z = zigzag_shard(x, size)
+    s_local = s // size
+    for i in range(size):
+        shard = np.asarray(z[i * s_local:(i + 1) * s_local])
+        np.testing.assert_array_equal(
+            shard, np.asarray(zigzag_positions(i, size, s_local))
+        )
