@@ -35,6 +35,10 @@ class TransformerConfig:
     vocab_size: int = 32000
     num_layers: int = 12
     num_heads: int = 12
+    # GQA/MQA: fewer K/V heads than query heads (None = MHA).  The flash
+    # kernel routes q heads to kv groups natively (no broadcast); other
+    # attention impls repeat k/v to full heads before attending.
+    num_kv_heads: Optional[int] = None
     emb_dim: int = 768
     mlp_ratio: int = 4
     max_len: int = 1024
@@ -49,9 +53,22 @@ class TransformerConfig:
     # per-chip batches — the MFU lever when activations bound the batch.
     remat: bool = False
 
+    def __post_init__(self):
+        if self.num_kv_heads is not None:
+            if self.num_kv_heads <= 0 or self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"num_heads={self.num_heads} must be a positive "
+                    f"multiple of num_kv_heads={self.num_kv_heads}"
+                )
+
     @property
     def head_dim(self) -> int:
         return self.emb_dim // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
 
 
 def _attend(cfg: TransformerConfig, q, k, v, pos_offset):
@@ -63,6 +80,11 @@ def _attend(cfg: TransformerConfig, q, k, v, pos_offset):
             q, k, v, causal=True,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
+    if cfg.kv_heads != cfg.num_heads:
+        # non-flash schedules attend at full heads
+        rep = cfg.num_heads // cfg.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if cfg.attention_impl == "ring":
         from ..parallel.ring_attention import ring_attention  # noqa: PLC0415
 
@@ -97,11 +119,17 @@ class Block(nn.Module):
         cfg = self.cfg
         b, s, _ = x.shape
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        qkv = nn.Dense(3 * cfg.emb_dim, dtype=cfg.dtype, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, s, cfg.num_heads, cfg.head_dim)
+        kv_dim = cfg.kv_heads * cfg.head_dim
+        qkv = nn.Dense(cfg.emb_dim + 2 * kv_dim, dtype=cfg.dtype,
+                       name="qkv")(h)
+        q = qkv[..., :cfg.emb_dim]
+        k = qkv[..., cfg.emb_dim:cfg.emb_dim + kv_dim]
+        v = qkv[..., cfg.emb_dim + kv_dim:]
         att = _attend(
-            cfg, q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            cfg,
+            q.reshape(b, s, cfg.num_heads, cfg.head_dim),
+            k.reshape(b, s, cfg.kv_heads, cfg.head_dim),
+            v.reshape(b, s, cfg.kv_heads, cfg.head_dim),
             pos_offset,
         )
         att = att.reshape(b, s, cfg.emb_dim)

@@ -63,51 +63,70 @@ def flash_attention(
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU.
     """
     b, s, h, d = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
+    if k.shape != v.shape:
         raise ValueError(
-            f"flash_attention requires matching q/k/v shapes, got "
-            f"{q.shape}/{k.shape}/{v.shape} (MQA/GQA: broadcast k/v first)"
+            f"flash_attention requires matching k/v shapes, got "
+            f"{k.shape}/{v.shape}"
+        )
+    hkv = k.shape[2]
+    if k.shape[0] != b or k.shape[1] != s or k.shape[3] != d or h % hkv:
+        raise ValueError(
+            f"flash_attention q {q.shape} incompatible with k/v {k.shape}: "
+            "batch/seq/head_dim must match and num_heads must be a "
+            "multiple of num_kv_heads (MQA/GQA)"
         )
     scale_ = scale if scale is not None else d ** -0.5
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # [B,S,H,D] -> [B*H, S, D]: one grid row per (batch, head)
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # [B,S,H,D] -> [B*H, S, D]: one grid row per (batch, head).  GQA/MQA:
+    # k/v fold to [B*HKV, S, D] and the kernels' index maps route each q
+    # head to its kv group — no broadcast materialization.
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * x.shape[2], s, d
+    )
     out = _flash(fold(q), fold(k), fold(v), causal, scale_, bq, bk,
-                 bool(interpret))
+                 h, hkv, bool(interpret))
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, bq, bk, interpret):
-    o, _ = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
+    o, _ = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv,
+                             interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
-    o, lse = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, interpret)
+def _flash_fwd(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
+    o, lse = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv,
+                               interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, bq, bk, interpret, res, do):
+def _flash_bwd(causal, scale, bq, bk, h, hkv, interpret, res, do):
     q, k, v, o, lse = res
     return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
-                             interpret)
+                             h, hkv, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, interpret):
+def _kv_row(zi, h: int, hkv: int):
+    """Grid row (b*h + head) -> folded kv row (b*hkv + head//group)."""
+    return (zi // h) * hkv + (zi % h) // (h // hkv)
+
+
+def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
     """Returns (o [Z,S,D], lse [Z,S]) with Z = batch*heads.
 
     K tiles live on the innermost grid dimension, so only (1, bk, d) of K
     and V are resident per step — VMEM peak is O(bq*d + bk*d), independent
     of S (the long-context requirement).  The online-softmax state (acc,
     m, l) persists across the sequential K dimension in VMEM scratch and
-    is flushed to the output block at the last K tile.
+    is flushed to the output block at the last K tile.  GQA/MQA: k/v have
+    Z_kv = batch*hkv rows; the index map routes each q head to its group.
     """
     z, s, d = q.shape
     nq, nk = s // bq, s // bk
@@ -165,8 +184,10 @@ def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, interpret):
         grid=(z, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda zi, qi, ki: (zi, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda zi, qi, ki: (zi, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda zi, qi, ki: (zi, ki, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda zi, qi, ki: (_kv_row(zi, h, hkv), ki, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda zi, qi, ki: (_kv_row(zi, h, hkv), ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda zi, qi, ki: (zi, qi, 0)),
@@ -190,19 +211,23 @@ def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, interpret):
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
-                      interpret):
+                      h, hkv, interpret):
     """Fused Pallas flash backward: two passes, both tiled, both skipping
     fully-masked causal blocks (the scan fallback below computes the whole
     upper triangle and streams O(S*bk) score tiles through HBM — on a
     causal LM that is ~2x wasted FLOPs and the dominant HBM stream).
 
-    Pass A (grid z, nk, nq): K tile fixed, Q tiles stream sequentially;
-    dk/dv accumulate in VMEM scratch, flushed at the last Q tile.
+    Pass A (grid z_kv, nk, nq*group): K tile fixed, (q-head-in-group, Q
+    tile) pairs stream sequentially; dk/dv accumulate in VMEM scratch —
+    under GQA the whole group's contribution folds into one kv row — and
+    flush at the last pair.
     Pass B (grid z, nq, nk): Q tile fixed, K tiles stream; dq accumulates.
     Both recompute P from the forward's saved logsumexp; ``delta`` =
     rowsum(do*o) is the standard softmax-backward correction.
     """
     z, s, d = q.shape
+    z_kv = k.shape[0]
+    group = h // hkv
     nq, nk = s // bq, s // bk
     f32 = jnp.float32
     LANES = 128
@@ -237,9 +262,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
     def kernel_dkdv(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc):
         j = pl.program_id(1)
-        i = pl.program_id(2)
+        t = pl.program_id(2)          # (q head in group) * nq + (q tile)
+        i = t % nq
 
-        @pl.when(i == 0)
+        @pl.when(t == 0)
         def _init():
             dk_acc[...] = jnp.zeros_like(dk_acc)
             dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -256,7 +282,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             dk_acc[...] += jnp.dot(ds.T, qb,
                                    preferred_element_type=f32) * scale
 
-        @pl.when(i == nq - 1)
+        @pl.when(t == nq * group - 1)
         def _flush():
             dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
             dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -286,24 +312,29 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
 
     qkv_spec = lambda tile, which: pl.BlockSpec((1, tile, d), which)
     lane_spec = lambda which: pl.BlockSpec((1, bq, LANES), which)
+
+    def _qrow(zi, ti):
+        """Pass-A q row for kv row ``zi`` and inner step ``ti``."""
+        return (zi // hkv) * h + (zi % hkv) * group + ti // nq
+
     dk, dv = pl.pallas_call(
         kernel_dkdv,
-        grid=(z, nk, nq),
+        grid=(z_kv, nk, nq * group),
         in_specs=[
-            qkv_spec(bq, lambda zi, ji, ii: (zi, ii, 0)),   # q
-            qkv_spec(bk, lambda zi, ji, ii: (zi, ji, 0)),   # k
-            qkv_spec(bk, lambda zi, ji, ii: (zi, ji, 0)),   # v
-            qkv_spec(bq, lambda zi, ji, ii: (zi, ii, 0)),   # o
-            qkv_spec(bq, lambda zi, ji, ii: (zi, ii, 0)),   # do
-            lane_spec(lambda zi, ji, ii: (zi, ii, 0)),      # lse
+            qkv_spec(bq, lambda zi, ji, ti: (_qrow(zi, ti), ti % nq, 0)),
+            qkv_spec(bk, lambda zi, ji, ti: (zi, ji, 0)),   # k
+            qkv_spec(bk, lambda zi, ji, ti: (zi, ji, 0)),   # v
+            qkv_spec(bq, lambda zi, ji, ti: (_qrow(zi, ti), ti % nq, 0)),
+            qkv_spec(bq, lambda zi, ji, ti: (_qrow(zi, ti), ti % nq, 0)),
+            lane_spec(lambda zi, ji, ti: (_qrow(zi, ti), ti % nq, 0)),
         ],
         out_specs=[
-            qkv_spec(bk, lambda zi, ji, ii: (zi, ji, 0)),
-            qkv_spec(bk, lambda zi, ji, ii: (zi, ji, 0)),
+            qkv_spec(bk, lambda zi, ji, ti: (zi, ji, 0)),
+            qkv_spec(bk, lambda zi, ji, ti: (zi, ji, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((z, s, d), k.dtype),
-            jax.ShapeDtypeStruct((z, s, d), v.dtype),
+            jax.ShapeDtypeStruct((z_kv, s, d), k.dtype),
+            jax.ShapeDtypeStruct((z_kv, s, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), f32),
@@ -319,8 +350,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
         grid=(z, nq, nk),
         in_specs=[
             qkv_spec(bq, lambda zi, ii, ji: (zi, ii, 0)),
-            qkv_spec(bk, lambda zi, ii, ji: (zi, ji, 0)),
-            qkv_spec(bk, lambda zi, ii, ji: (zi, ji, 0)),
+            qkv_spec(bk, lambda zi, ii, ji: (_kv_row(zi, h, hkv), ji, 0)),
+            qkv_spec(bk, lambda zi, ii, ji: (_kv_row(zi, h, hkv), ji, 0)),
             qkv_spec(bq, lambda zi, ii, ji: (zi, ii, 0)),
             qkv_spec(bq, lambda zi, ii, ji: (zi, ii, 0)),
             lane_spec(lambda zi, ii, ji: (zi, ii, 0)),

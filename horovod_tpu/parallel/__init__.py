@@ -11,6 +11,7 @@ from .ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_zigzag,
     ulysses_attention,
+    zigzag_positions,
     zigzag_shard,
     zigzag_unshard,
 )

@@ -202,10 +202,10 @@ class TestPallasBackward:
             jnp.asarray(rng.randn(z, s, d), jnp.float32) for _ in range(4)
         )
         scale = d ** -0.5
-        o, lse = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, True)
+        o, lse = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, 1, 1, True)
         ref = _flash_bwd_blockwise(q, k, v, o, lse, do, causal, scale, bk)
         got = _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
-                                True)
+                                1, 1, True)
         for name, a, b in zip(("dq", "dk", "dv"), got, ref):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5,
@@ -223,10 +223,57 @@ class TestPallasBackward:
             jnp.asarray(rng.randn(z, s, d), jnp.float32) for _ in range(4)
         )
         scale = d ** -0.5
-        o, lse = _flash_fwd_kernel(q, k, v, True, scale, bq, bk, True)
+        o, lse = _flash_fwd_kernel(q, k, v, True, scale, bq, bk, 1, 1, True)
         ref = _flash_bwd_blockwise(q, k, v, o, lse, do, True, scale, bk)
         got = _flash_bwd_pallas(q, k, v, o, lse, do, True, scale, bq, bk,
-                                True)
+                                1, 1, True)
         for a, b in zip(got, ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, rtol=1e-5)
+
+
+class TestGQA:
+    """Native grouped-query attention: k/v with fewer heads route through
+    the kernels' index maps (no broadcast materialization); outputs and
+    ALL gradients must match the broadcast-k/v reference."""
+
+    @pytest.mark.parametrize("hkv", [1, 2])  # MQA and GQA
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_broadcast_reference(self, hkv, causal):
+        from horovod_tpu.ops.flash_attention import flash_attention
+        from horovod_tpu.parallel import local_attention
+
+        rng = np.random.RandomState(7)
+        b, s, h, d = 2, 32, 4, 16
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.3
+        k = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32) * 0.3
+        v = jnp.asarray(rng.randn(b, s, hkv, d), jnp.float32) * 0.3
+        w = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        rep = lambda t: jnp.repeat(t, h // hkv, axis=2)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=causal,
+                                  block_q=16, block_k=16)
+            return (out * w).sum()
+
+        def loss_ref(q, k, v):
+            out = local_attention(q, rep(k), rep(v), causal=causal)
+            return (out * w).sum()
+
+        (lf, gf) = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        (lr, gr) = jax.value_and_grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(lf), float(lr), rtol=2e-5)
+        for name, a, b_ in zip(("dq", "dk", "dv"), gf, gr):
+            assert a.shape == b_.shape  # dk/dv stay at hkv heads
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=3e-5, rtol=3e-5,
+                err_msg=f"{name} (hkv={hkv}, causal={causal})",
+            )
+
+    def test_bad_kv_heads_rejected(self):
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        q = jnp.zeros((1, 16, 4, 8))
+        kv = jnp.zeros((1, 16, 3, 8))  # 4 % 3 != 0
+        with pytest.raises(ValueError, match="multiple of num_kv_heads"):
+            flash_attention(q, kv, kv)

@@ -223,3 +223,42 @@ def test_inception_v3_forward_backward():
     # eval mode runs with frozen stats
     out = model.apply(variables, x, train=False)
     assert out.shape == (2, 10)
+
+
+def test_gpt_gqa_trains():
+    """num_kv_heads < num_heads (GQA): model builds, the qkv projection
+    shrinks accordingly, flash and reference impls agree."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.transformer import gpt
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 1024, size=(2, 32)), jnp.int32
+    )
+    import pytest
+    with pytest.raises(ValueError, match="multiple of num_kv_heads"):
+        gpt("nano", num_kv_heads=3)  # 4 % 3 != 0 -> fail at config time
+    with pytest.raises(ValueError, match="multiple of num_kv_heads"):
+        gpt("nano", num_kv_heads=0)
+    flash = gpt("nano", num_kv_heads=2, dtype=jnp.float32)  # 4 q, 2 kv heads
+    ref = gpt("nano", num_kv_heads=2, dtype=jnp.float32,
+              attention_impl="reference")
+    params = flash.init(jax.random.PRNGKey(0), tokens)
+    # qkv projection: emb + 2 * kv_dim = 128 + 2*64 = 256 (not 3*128)
+    assert params["params"]["block0"]["qkv"]["kernel"].shape == (128, 256)
+
+    def loss(model, p):
+        logits = model.apply(p, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens
+        ).mean()
+
+    lf, gf = jax.value_and_grad(lambda p: loss(flash, p))(params)
+    lr, gr = jax.value_and_grad(lambda p: loss(ref, p))(params)
+    np.testing.assert_allclose(float(lf), float(lr), rtol=5e-5, atol=5e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4),
+        gf, gr,
+    )

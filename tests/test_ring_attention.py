@@ -216,8 +216,7 @@ class TestZigzagRing:
 def test_zigzag_positions_match_layout():
     """zigzag_positions(i) must be exactly the global positions of rank
     i's rows after zigzag_shard + contiguous split."""
-    from horovod_tpu.parallel import zigzag_shard
-    from horovod_tpu.parallel.ring_attention import zigzag_positions
+    from horovod_tpu.parallel import zigzag_positions, zigzag_shard
 
     size, s = 4, 24
     x = jnp.arange(s)  # value == global position
