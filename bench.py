@@ -62,7 +62,8 @@ def peak_flops_per_chip(device, dtype: str) -> float:
 
 def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                    attention: str = "flash", remat: bool = False,
-                   flash_block_q: int = 128, flash_block_k: int = 128):
+                   flash_block_q: int = 128, flash_block_k: int = 128,
+                   kv_heads: int = 0, pos_embedding: str = "learned"):
     """GPT causal-LM training step (flash attention) — the long-context
     counterpart of the ResNet bench.  Returns ``(step, state, static)``
     like ``build_step``; throughput is reported in tokens/sec/chip."""
@@ -86,7 +87,9 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
     compute_dtype = jnp.float32 if dtype == "fp32" else jnp.bfloat16
     model = gpt(size, dtype=compute_dtype, max_len=seq_len,
                 attention_impl=attention, remat=remat,
-                flash_block_q=flash_block_q, flash_block_k=flash_block_k)
+                flash_block_q=flash_block_q, flash_block_k=flash_block_k,
+                num_kv_heads=kv_heads or None,
+                pos_embedding=pos_embedding)
     vocab = model.cfg.vocab_size
 
     global_batch = batch_size * n_chips
@@ -334,6 +337,11 @@ def main() -> int:
                         "policy): trades recompute for HBM -> larger batch")
     parser.add_argument("--flash-block-q", type=int, default=128)
     parser.add_argument("--flash-block-k", type=int, default=128)
+    parser.add_argument("--kv-heads", type=int, default=0,
+                        help="GQA/MQA kv heads for the gpt models "
+                        "(0 = MHA)")
+    parser.add_argument("--pos-embedding", default="learned",
+                        choices=["learned", "rope"])
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--s2d-stem", action="store_true",
@@ -374,6 +382,7 @@ def main() -> int:
                 args.seq_len, attention=args.attention, remat=args.remat,
                 flash_block_q=args.flash_block_q,
                 flash_block_k=args.flash_block_k,
+                kv_heads=args.kv_heads, pos_embedding=args.pos_embedding,
             )
             carry, const = state[:-1], state[-1:]
         else:

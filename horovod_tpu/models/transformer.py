@@ -43,8 +43,13 @@ class TransformerConfig:
     mlp_ratio: int = 4
     max_len: int = 1024
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "flash"  # flash | reference | ring | ulysses
-    sp_axis: Optional[str] = None  # mesh axis for ring/ulysses
+    attention_impl: str = "flash"  # flash | reference | ring | ulysses | zigzag
+    sp_axis: Optional[str] = None  # mesh axis for ring/ulysses/zigzag
+    # "learned" = wpe table (GPT-2 style); "rope" = rotary, driven by the
+    # explicit per-token position vector, so it composes with ANY sequence
+    # layout (contiguous or zigzag shards).
+    pos_embedding: str = "learned"
+    rope_theta: float = 10000.0
     flash_block_q: int = 128
     flash_block_k: int = 128
     # Rematerialize each block in the backward pass, keeping only matmul
@@ -60,6 +65,11 @@ class TransformerConfig:
                     f"num_heads={self.num_heads} must be a positive "
                     f"multiple of num_kv_heads={self.num_kv_heads}"
                 )
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding must be 'learned' or 'rope', got "
+                f"{self.pos_embedding!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -71,8 +81,10 @@ class TransformerConfig:
                 else self.num_heads)
 
 
-def _attend(cfg: TransformerConfig, q, k, v, pos_offset):
-    """Dispatch to the configured attention schedule (always causal)."""
+def _attend(cfg: TransformerConfig, q, k, v, positions):
+    """Dispatch to the configured attention schedule (always causal).
+    ``positions``: int [s_local] global positions of the local rows —
+    used by schedules that mask in global coordinates."""
     if cfg.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention  # noqa: PLC0415
 
@@ -91,6 +103,14 @@ def _attend(cfg: TransformerConfig, q, k, v, pos_offset):
         if cfg.sp_axis is None:
             raise ValueError("attention_impl='ring' requires sp_axis")
         return ring_attention(q, k, v, cfg.sp_axis, causal=True)
+    if cfg.attention_impl == "zigzag":
+        from ..parallel.ring_attention import (  # noqa: PLC0415
+            ring_attention_zigzag,
+        )
+
+        if cfg.sp_axis is None:
+            raise ValueError("attention_impl='zigzag' requires sp_axis")
+        return ring_attention_zigzag(q, k, v, cfg.sp_axis)
     if cfg.attention_impl == "ulysses":
         from ..parallel.ring_attention import ulysses_attention  # noqa: PLC0415
 
@@ -100,12 +120,14 @@ def _attend(cfg: TransformerConfig, q, k, v, pos_offset):
     if cfg.attention_impl != "reference":
         raise ValueError(
             f"unknown attention_impl {cfg.attention_impl!r}; expected "
-            f"'flash', 'reference', 'ring', or 'ulysses'"
+            f"'flash', 'reference', 'ring', 'zigzag', or 'ulysses'"
         )
     from ..parallel.ring_attention import local_attention  # noqa: PLC0415
 
+    # local_attention masks from scalar offsets: valid because every
+    # non-zigzag layout is contiguous per shard (zigzag never routes here)
     return local_attention(
-        q, k, v, causal=True, q_offset=pos_offset, kv_offset=pos_offset
+        q, k, v, causal=True, q_offset=positions[0], kv_offset=positions[0]
     )
 
 
@@ -115,23 +137,26 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, pos_offset):
+    def __call__(self, x, positions, rope_tabs=None):
         cfg = self.cfg
         b, s, _ = x.shape
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         kv_dim = cfg.kv_heads * cfg.head_dim
         qkv = nn.Dense(cfg.emb_dim + 2 * kv_dim, dtype=cfg.dtype,
                        name="qkv")(h)
-        q = qkv[..., :cfg.emb_dim]
-        k = qkv[..., cfg.emb_dim:cfg.emb_dim + kv_dim]
-        v = qkv[..., cfg.emb_dim + kv_dim:]
-        att = _attend(
-            cfg,
-            q.reshape(b, s, cfg.num_heads, cfg.head_dim),
-            k.reshape(b, s, cfg.kv_heads, cfg.head_dim),
-            v.reshape(b, s, cfg.kv_heads, cfg.head_dim),
-            pos_offset,
+        q = qkv[..., :cfg.emb_dim].reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = qkv[..., cfg.emb_dim:cfg.emb_dim + kv_dim].reshape(
+            b, s, cfg.kv_heads, cfg.head_dim
         )
+        v = qkv[..., cfg.emb_dim + kv_dim:].reshape(
+            b, s, cfg.kv_heads, cfg.head_dim
+        )
+        if rope_tabs is not None:
+            from ..ops.rope import apply_rope_tables  # noqa: PLC0415
+
+            q = apply_rope_tables(q, *rope_tabs)
+            k = apply_rope_tables(k, *rope_tabs)
+        att = _attend(cfg, q, k, v, positions)
         att = att.reshape(b, s, cfg.emb_dim)
         x = x + nn.Dense(cfg.emb_dim, dtype=cfg.dtype, name="proj")(att)
 
@@ -147,34 +172,66 @@ class GPT(nn.Module):
     """Decoder-only causal LM.
 
     ``tokens``: int32 ``[batch, seq]`` (local shard under sequence
-    parallelism); ``pos_offset``: global position of ``tokens[:, 0]`` —
-    pass ``axis_index(sp_axis) * local_seq`` inside shard_map.
+    parallelism).  Positions, either/or:
+
+    * ``pos_offset``: global position of ``tokens[:, 0]`` for CONTIGUOUS
+      shards — pass ``axis_index(sp_axis) * local_seq`` inside shard_map;
+    * ``positions``: explicit int ``[seq]`` global positions — REQUIRED
+      (and only supported) non-contiguous layout is the zigzag schedule:
+      ``attention_impl="zigzag"`` with positions from
+      ``zigzag_positions(axis_index, P, s_local)``.  The position
+      *embeddings* (learned gather, RoPE rotation) are layout-agnostic,
+      but the flash/reference/ring attention impls mask assuming
+      contiguous per-shard rows.
+
     Returns logits ``[batch, seq, vocab]`` in fp32.
     """
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0):
+    def __call__(self, tokens, pos_offset=0, positions=None):
         cfg = self.cfg
         tok = nn.Embed(
             cfg.vocab_size, cfg.emb_dim, dtype=cfg.dtype, name="wte"
         )(tokens)
-        pos_table = self.param(
-            "wpe",
-            nn.initializers.normal(0.02),
-            (cfg.max_len, cfg.emb_dim),
-            jnp.float32,
-        )
         s = tokens.shape[1]
         if s > cfg.max_len:
-            # dynamic_slice clamps out-of-range starts silently, which would
-            # reuse trailing position rows; fail at trace time instead.
             raise ValueError(
                 f"sequence length {s} exceeds max_len={cfg.max_len}"
             )
-        pos = jax.lax.dynamic_slice_in_dim(pos_table, pos_offset, s, axis=0)
-        x = tok + pos.astype(cfg.dtype)[None]
+        if positions is None:
+            if cfg.attention_impl == "zigzag":
+                # contiguous default positions can NEVER match the zigzag
+                # layout: silently wrong on every rank — fail at trace time
+                raise ValueError(
+                    "attention_impl='zigzag' requires explicit positions "
+                    "(zigzag_positions(axis_index, P, s_local))"
+                )
+            positions = pos_offset + jnp.arange(s)
+        x = tok
+        if cfg.pos_embedding == "learned":
+            pos_table = self.param(
+                "wpe",
+                nn.initializers.normal(0.02),
+                (cfg.max_len, cfg.emb_dim),
+                jnp.float32,
+            )
+            # Gather (not dynamic_slice): position layouts need not be
+            # contiguous (zigzag shards).  mode="fill" + NaN makes an
+            # out-of-range position (e.g. global S > max_len under SP,
+            # which the local s<=max_len check can't see) poison the loss
+            # LOUDLY instead of silently reusing the clamped last row.
+            pos = jnp.take(pos_table, positions, axis=0,
+                           mode="fill", fill_value=jnp.nan)
+            x = x + pos.astype(cfg.dtype)[None]
+        rope_tabs = None
+        if cfg.pos_embedding == "rope":
+            from ..ops.rope import rope_tables  # noqa: PLC0415
+
+            # once for ALL blocks: under remat a per-block recompute would
+            # re-run the transcendentals in the backward pass too
+            rope_tabs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(
@@ -182,7 +239,7 @@ class GPT(nn.Module):
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"block{i}")(x, pos_offset)
+            x = block_cls(cfg, name=f"block{i}")(x, positions, rope_tabs)
         x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
         logits = nn.Dense(
             cfg.vocab_size, dtype=cfg.dtype, use_bias=False, name="head"

@@ -1,0 +1,57 @@
+"""Rotary position embedding (RoPE), TPU-shaped.
+
+Positions are an explicit int vector (one global position per local row),
+NOT an offset + arange — that is what makes RoPE compose with arbitrary
+sequence layouts: contiguous shards pass ``offset + arange``, zigzag
+shards pass :func:`horovod_tpu.parallel.zigzag_positions`, and the
+rotation is correct either way because it only ever looks at the
+per-token position value.
+
+Angles are computed in fp32 regardless of activation dtype (bf16 angles
+destroy long-range phase accuracy), rotation output casts back.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_tables(positions: jax.Array, head_dim: int,
+                theta: float = 10000.0):
+    """Precompute ``(cos, sin)`` ``[seq, head_dim//2]`` for
+    :func:`apply_rope_tables`.  Angles depend only on positions and theta,
+    so a model computes them ONCE and threads them to every block —
+    under remat the per-block recompute would otherwise re-run the
+    transcendentals in the backward pass too."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE requires an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    freqs = 1.0 / (
+        theta ** (jnp.arange(half, dtype=jnp.float32) / half)
+    )
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope_tables(x: jax.Array, cos: jax.Array,
+                      sin: jax.Array) -> jax.Array:
+    """Rotate ``x`` ``[batch, seq, heads, head_dim]`` by precomputed
+    tables from :func:`rope_tables`."""
+    half = x.shape[-1] // 2
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """One-shot spelling: rotate ``x`` by per-token angles from
+    ``positions`` (int ``[seq]`` global token positions)."""
+    cos, sin = rope_tables(positions, x.shape[-1], theta)
+    return apply_rope_tables(x, cos, sin)

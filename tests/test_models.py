@@ -262,3 +262,26 @@ def test_gpt_gqa_trains():
         lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4),
         gf, gr,
     )
+
+
+def test_transformer_position_guards():
+    """Layout misuse fails loudly: zigzag without explicit positions
+    raises at trace time; an out-of-range learned position poisons the
+    output with NaN instead of silently reusing the clamped last row."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from horovod_tpu.models.transformer import gpt
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    zz = gpt("nano", attention_impl="zigzag", sp_axis="sp")
+    with pytest.raises(ValueError, match="requires explicit positions"):
+        zz.init(jax.random.PRNGKey(0), tokens)
+
+    m = gpt("nano", attention_impl="reference", dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), tokens)
+    bad_positions = jnp.arange(8) + 255  # nano max_len=256 -> 255..262
+    out = m.apply(params, tokens, positions=bad_positions)
+    assert not np.isfinite(np.asarray(out)).all(), \
+        "out-of-range position did not poison the output"
