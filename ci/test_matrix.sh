@@ -38,7 +38,7 @@ done
 # Frontend + subsystem examples at np=2 (one engine each is enough: the
 # differential fuzz test pins engine equivalence at the op level).
 for ex in torch_mnist tf2_mnist keras_mnist adasum_small_model \
-          checkpoint_resume estimator_train; do
+          checkpoint_resume estimator_train long_context_zigzag; do
     echo "== example smoke: $ex =="
     JAX_PLATFORMS=cpu \
         python -m horovod_tpu.run -np 2 python "examples/$ex.py"

@@ -12,12 +12,20 @@ TPU-first choices:
       ``local_attention``), for tests and tiny shapes;
     - ``"ring"``      — ring attention over a sequence-parallel mesh axis
       (call the model inside shard_map with tokens sharded along seq);
+    - ``"zigzag"``    — the load-balanced causal ring (zigzag layout;
+      requires an explicit ``positions`` vector from
+      ``zigzag_positions``);
     - ``"ulysses"``   — all-to-all head-parallel attention over that axis.
+* Positions: ``pos_offset`` (scalar, contiguous shards) or an explicit
+  per-token ``positions`` vector (required for zigzag); both the learned
+  table (gather) and RoPE rotate/index by position VALUE, so the
+  embeddings are layout-agnostic.
+* GQA/MQA via ``num_kv_heads``: native in the flash kernel; ring/zigzag
+  carry narrow k/v through the ppermute and broadcast after.
 * Head dim and MLP width default to multiples of 128 (MXU lane width) at
   the named sizes.
 * No data-dependent Python control flow — the whole forward is one traced
-  graph; sequence-parallel variants take a ``pos_offset`` so learned
-  positions index globally under sharding.
+  graph.
 """
 
 from __future__ import annotations
@@ -92,8 +100,12 @@ def _attend(cfg: TransformerConfig, q, k, v, positions):
             q, k, v, causal=True,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
         )
-    if cfg.kv_heads != cfg.num_heads:
-        # non-flash schedules attend at full heads
+    if cfg.kv_heads != cfg.num_heads and cfg.attention_impl in (
+        "reference", "ulysses"
+    ):
+        # these schedules attend at full heads; ring/zigzag carry narrow
+        # k/v through the ppermute and broadcast after (so GQA's
+        # interconnect saving survives sequence parallelism)
         rep = cfg.num_heads // cfg.kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

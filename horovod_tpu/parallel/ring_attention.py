@@ -61,6 +61,12 @@ def _online_softmax_update(state, q_sub, k_sub, v_sub, scale, mask=None):
     m [b,h,sq], l [b,h,sq])`` in fp32; ``mask`` is a bool ``[sq, sk]``
     (True = masked) used only for diagonal/partial blocks."""
     o, m, l = state
+    if q_sub.shape[2] != k_sub.shape[2]:
+        # GQA/MQA: k/v arrive at kv_heads and broadcast HERE — after any
+        # ppermute — so ring interconnect traffic stays at kv width
+        rep = q_sub.shape[2] // k_sub.shape[2]
+        k_sub = jnp.repeat(k_sub, rep, axis=2)
+        v_sub = jnp.repeat(v_sub, rep, axis=2)
     scores = (
         jnp.einsum("bqhd,bkhd->bhqk", q_sub, k_sub.astype(jnp.float32))
         * scale

@@ -285,7 +285,8 @@ class TestZigzagModel:
     positions from zigzag_positions) must reproduce the single-device
     model's logits."""
 
-    def test_zigzag_model_matches_single_device(self):
+    @pytest.mark.parametrize("kv_heads", [None, 2])
+    def test_zigzag_model_matches_single_device(self, kv_heads):
         from horovod_tpu.parallel import zigzag_positions, zigzag_shard, \
             zigzag_unshard
 
@@ -293,7 +294,7 @@ class TestZigzagModel:
         s_local = S // P_SIZE
         common = dict(num_layers=2, num_heads=4, emb_dim=64, max_len=S,
                       vocab_size=512, dtype=jnp.float32,
-                      pos_embedding="rope")
+                      pos_embedding="rope", num_kv_heads=kv_heads)
         model_1d = gpt("nano", attention_impl="reference", **common)
         model_zz = gpt("nano", attention_impl="zigzag", sp_axis="sp",
                        **common)

@@ -268,10 +268,6 @@ def test_transformer_position_guards():
     """Layout misuse fails loudly: zigzag without explicit positions
     raises at trace time; an out-of-range learned position poisons the
     output with NaN instead of silently reusing the clamped last row."""
-    import jax
-    import jax.numpy as jnp
-    import pytest
-
     from horovod_tpu.models.transformer import gpt
 
     tokens = jnp.zeros((1, 8), jnp.int32)

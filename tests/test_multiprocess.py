@@ -863,6 +863,19 @@ def _multi_local_device_fn():
     mn = hvd.allreduce(jnp.asarray([float(r)], jnp.float32), op=hvd.Min)
     out["min"] = np.asarray(mn).tolist()
 
+    # row-mesh collectives (allgather/broadcast) under the multi-chip
+    # topology: payloads on non-anchor chips stage to the anchor row
+    # chip-to-chip and still never touch the host plane
+    g = jax.device_put(
+        jnp.full((2,), float(r), jnp.float32), jax.local_devices()[1]
+    )
+    ag = hvd.allgather(g)
+    out["ag"] = np.asarray(ag).tolist()
+    bc = hvd.broadcast(
+        jnp.asarray([10.0 * (r + 1)], jnp.float32), root_rank=1
+    )
+    out["bcast"] = np.asarray(bc).tolist()
+
     eng = peek_engine()
     plane = eng._device_plane
     out["plane_n_local"] = plane.n_local
@@ -898,7 +911,9 @@ def test_multi_local_device_plane():
         assert r["y"] == [1.5] * 8
         assert r["bf16"] == [0.5] * 5
         assert r["min"] == [0.0]
-        assert r["device_data_ops"] >= 4
+        assert r["ag"] == [0.0, 0.0, 1.0, 1.0]
+        assert r["bcast"] == [20.0]
+        assert r["device_data_ops"] >= 6
         assert r["host_data_ops"] == 0, "payload took a host round-trip"
 
 
