@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Hardware measurement plan for the first available tunnel window
+# (docs/performance.md "Round-4 transformer levers").  Sequential, each
+# config tolerant of failure, everything appended as labeled JSON lines —
+# a later hang can't erase earlier results.
+#
+#   scripts/hw_sweep.sh [results_file]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/hw_sweep_results.jsonl}"
+
+run() {
+    local label="$1"; shift
+    echo "== $label: bench.py $* ==" >&2
+    local line
+    line=$(timeout 2400 python bench.py "$@" 2>/dev/null | tail -1)
+    if [ -n "$line" ]; then
+        echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT"
+        echo "$line" >&2
+    else
+        echo "{\"config\": \"$label\", \"result\": null}" >> "$OUT"
+        echo "(no result)" >&2
+    fi
+}
+
+# 1. the headline record (VERDICT r3 item 1): expect ~2660 img/s bf16
+run resnet50_bf16_b256 --batch-size 256
+# 2. first real-chip GPT number (VERDICT r3 item 2)
+run gpt_small_base --model gpt-small
+# 3. the round-4 levers, one at a time
+run gpt_small_remat --model gpt-small --remat
+run gpt_small_remat_b16 --model gpt-small --remat --batch-size 16
+run gpt_small_blocks256 --model gpt-small --flash-block-q 256 --flash-block-k 256
+run gpt_small_blocks512q --model gpt-small --flash-block-q 512 --flash-block-k 256
+run gpt_small_gqa4 --model gpt-small --kv-heads 4
+run gpt_small_rope --model gpt-small --pos-embedding rope
+run gpt_small_rope_gqa_remat --model gpt-small --pos-embedding rope --kv-heads 4 --remat --batch-size 16
+# 4. the other headline families (docs/benchmarks.md)
+run inception3_bf16 --model inception3 --batch-size 128
+run vgg16_bf16 --model vgg16 --batch-size 64
+echo "sweep complete -> $OUT" >&2
