@@ -16,3 +16,4 @@ from .ring_attention import (  # noqa: F401
     zigzag_unshard,
 )
 from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
+from .tensor_parallel import stack_tp_params, tp_gpt_apply  # noqa: F401

@@ -1,0 +1,216 @@
+"""Megatron-style tensor parallelism for the transformer family.
+
+Beyond reference parity (Horovod 0.19.1 is data-parallel only,
+SURVEY.md §2.9 — TP listed as optional stretch): the GPT block's weights
+shard across a mesh axis the Megatron way —
+
+* qkv projection **column-parallel** (whole attention heads per rank:
+  attention is embarrassingly parallel over heads, zero comms),
+* output projection **row-parallel** (one ``psum`` rejoins the residual),
+* MLP fc1 column-parallel, fc2 row-parallel (one ``psum``),
+
+so a block costs exactly TWO psums over the tp axis, and every matmul
+stays MXU-large.  LayerNorms, embeddings, and the LM head stay
+replicated (their cost is marginal at these widths).
+
+The implementation operates on the EXISTING `GPT` parameter pytree:
+:func:`stack_tp_params` reshapes a trained/initialized checkpoint into
+per-rank shards with a leading ``tp`` dim (shard it over the axis with
+``in_specs=P("tp")``), and :func:`tp_gpt_apply` reproduces
+``GPT.apply`` bit-for-bit (up to fp associativity) inside ``shard_map``.
+Equivalence is pinned by tests/test_tensor_parallel.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["stack_tp_params", "tp_gpt_apply"]
+
+
+def _split_qkv_columns(kernel, bias, cfg, tp: int):
+    """Split the fused qkv projection so rank r holds whole head groups:
+    q columns [r*h/tp head blocks], k and v columns likewise at kv_heads.
+    Returns per-rank (kernel, bias) lists."""
+    emb = cfg.emb_dim
+    hd = cfg.head_dim
+    kv_dim = cfg.kv_heads * hd
+    q_w, k_w, v_w = (
+        kernel[:, :emb], kernel[:, emb:emb + kv_dim],
+        kernel[:, emb + kv_dim:],
+    )
+    q_b, k_b, v_b = bias[:emb], bias[emb:emb + kv_dim], bias[emb + kv_dim:]
+    qs = np.split(np.asarray(q_w), tp, axis=1)
+    ks = np.split(np.asarray(k_w), tp, axis=1)
+    vs = np.split(np.asarray(v_w), tp, axis=1)
+    qbs = np.split(np.asarray(q_b), tp)
+    kbs = np.split(np.asarray(k_b), tp)
+    vbs = np.split(np.asarray(v_b), tp)
+    kernels = [
+        np.concatenate([qs[r], ks[r], vs[r]], axis=1) for r in range(tp)
+    ]
+    biases = [
+        np.concatenate([qbs[r], kbs[r], vbs[r]]) for r in range(tp)
+    ]
+    return kernels, biases
+
+
+def stack_tp_params(params, cfg, tp: int):
+    """Split a GPT parameter pytree into ``(sharded, replicated)`` trees.
+
+    ``sharded`` carries the block matmul weights with a leading ``tp``
+    dimension (rank r's shard at index r) — pass it through ``shard_map``
+    with ``in_specs=P(tp_axis)``.  ``replicated`` carries embeddings,
+    layer norms, post-psum biases, and the LM head — pass it with
+    ``in_specs=P()``.  The separation is LOAD-BEARING for training, not
+    just memory hygiene: stacking replicated weights per rank and
+    sharding them makes every downstream value device-varying, and the
+    psum transpose then sums the per-rank cotangents — sharded-weight
+    gradients come out scaled by tp (pinned by
+    tests/test_tensor_parallel.py).
+
+    Requires ``num_heads % tp == 0`` and ``kv_heads % tp == 0`` (whole
+    heads per rank) and ``mlp_ratio * emb_dim % tp == 0``.
+    """
+    if cfg.num_heads % tp or cfg.kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={cfg.num_heads} and "
+            f"kv_heads={cfg.kv_heads}"
+        )
+    if (cfg.mlp_ratio * cfg.emb_dim) % tp:
+        raise ValueError(f"tp={tp} must divide the MLP width")
+    if set(params.keys()) == {"params"}:  # accept the flax variables dict
+        params = params["params"]
+    p = jax.tree_util.tree_map(np.asarray, params)
+    sharded, replicated = {}, {}
+    for name, sub in p.items():
+        if not name.startswith("block"):
+            replicated[name] = sub  # embeddings / final LN / head
+            continue
+        blk = dict(sub)
+        qk, qb = _split_qkv_columns(
+            blk["qkv"]["kernel"], blk["qkv"]["bias"], cfg, tp
+        )
+        sharded[name] = {
+            "qkv": {"kernel": np.stack(qk), "bias": np.stack(qb)},
+            # proj/fc2 row-parallel; their biases apply once after the
+            # psum, so they live on the replicated tree
+            "proj": {
+                "kernel": np.stack(
+                    np.split(blk["proj"]["kernel"], tp, axis=0)
+                ),
+            },
+            "fc1": {
+                "kernel": np.stack(np.split(blk["fc1"]["kernel"], tp,
+                                            axis=1)),
+                "bias": np.stack(np.split(blk["fc1"]["bias"], tp)),
+            },
+            "fc2": {
+                "kernel": np.stack(np.split(blk["fc2"]["kernel"], tp,
+                                            axis=0)),
+            },
+        }
+        replicated[name] = {
+            "ln1": blk["ln1"],
+            "ln2": blk["ln2"],
+            "proj_bias": blk["proj"]["bias"],
+            "fc2_bias": blk["fc2"]["bias"],
+        }
+    to_jnp = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+    return to_jnp(sharded), to_jnp(replicated)
+
+
+def _layer_norm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-6)
+    return y * scale + bias
+
+
+def _tp_block(cfg, p, rep, x, positions, rope_tabs, tp_axis, tp):
+    """One transformer block on this rank's head/width shard; two psums."""
+    from ..models.transformer import _attend  # noqa: PLC0415
+
+    b, s, _ = x.shape
+    h_local = cfg.num_heads // tp
+    hkv_local = cfg.kv_heads // tp
+    hd = cfg.head_dim
+    dt = cfg.dtype
+
+    hn = _layer_norm(x, rep["ln1"]["scale"], rep["ln1"]["bias"])
+    qkv = hn.astype(dt) @ p["qkv"]["kernel"].astype(dt) \
+        + p["qkv"]["bias"].astype(dt)
+    q_dim = h_local * hd
+    kv_dim = hkv_local * hd
+    q = qkv[..., :q_dim].reshape(b, s, h_local, hd)
+    k = qkv[..., q_dim:q_dim + kv_dim].reshape(b, s, hkv_local, hd)
+    v = qkv[..., q_dim + kv_dim:].reshape(b, s, hkv_local, hd)
+    if rope_tabs is not None:
+        from ..ops.rope import apply_rope_tables  # noqa: PLC0415
+
+        q = apply_rope_tables(q, *rope_tabs)
+        k = apply_rope_tables(k, *rope_tabs)
+    from dataclasses import replace  # noqa: PLC0415
+
+    # emb_dim only feeds head_dim below this point; keep it consistent
+    local_cfg = replace(cfg, num_heads=h_local, num_kv_heads=hkv_local,
+                        emb_dim=h_local * hd)
+    att = _attend(local_cfg, q, k, v, positions).reshape(b, s, q_dim)
+    y = att.astype(dt) @ p["proj"]["kernel"].astype(dt)
+    y = lax.psum(y, tp_axis) + rep["proj_bias"].astype(dt)
+    x = x + y
+
+    hn = _layer_norm(x, rep["ln2"]["scale"], rep["ln2"]["bias"])
+    m = hn.astype(dt) @ p["fc1"]["kernel"].astype(dt) \
+        + p["fc1"]["bias"].astype(dt)
+    m = jax.nn.gelu(m)
+    m = m @ p["fc2"]["kernel"].astype(dt)
+    m = lax.psum(m, tp_axis) + rep["fc2_bias"].astype(dt)
+    return x + m
+
+
+def tp_gpt_apply(sharded_params, replicated_params, cfg, tokens,
+                 tp_axis: str, pos_offset=0, positions=None):
+    """``GPT.apply`` with block weights tensor-sharded over ``tp_axis``.
+
+    Call inside ``shard_map`` with the two trees from
+    :func:`stack_tp_params`: ``sharded_params`` with ``in_specs=
+    P(tp_axis)``, ``replicated_params`` with ``in_specs=P()``, tokens
+    replicated.  Returns fp32 logits, identical (up to fp associativity)
+    to the unsharded model's.  Use ``check_vma=True`` (replication
+    tracking) when differentiating — see ``stack_tp_params``.
+    """
+    tp = lax.axis_size(tp_axis)
+    p = jax.tree_util.tree_map(lambda a: a[0], sharded_params)
+    rep = replicated_params
+    s = tokens.shape[1]
+    # same trace-time guards as GPT.apply (whose contract this reproduces)
+    if s > cfg.max_len:
+        raise ValueError(f"sequence length {s} exceeds max_len={cfg.max_len}")
+    if positions is None:
+        if cfg.attention_impl == "zigzag":
+            raise ValueError(
+                "attention_impl='zigzag' requires explicit positions "
+                "(zigzag_positions(axis_index, P, s_local))"
+            )
+        positions = pos_offset + jnp.arange(s)
+    x = jnp.take(rep["wte"]["embedding"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.pos_embedding == "learned":
+        pos = jnp.take(rep["wpe"], positions, axis=0,
+                       mode="fill", fill_value=jnp.nan)
+        x = x + pos.astype(cfg.dtype)[None]
+    rope_tabs = None
+    if cfg.pos_embedding == "rope":
+        from ..ops.rope import rope_tables  # noqa: PLC0415
+
+        rope_tabs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    for i in range(cfg.num_layers):
+        x = _tp_block(cfg, p[f"block{i}"], rep[f"block{i}"], x, positions,
+                      rope_tabs, tp_axis, tp)
+    x = _layer_norm(x, rep["lnf"]["scale"], rep["lnf"]["bias"])
+    logits = x.astype(cfg.dtype) @ rep["head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
