@@ -65,6 +65,14 @@ class TransformerConfig:
     # policy): trades HBM for recomputed elementwise FLOPs, buying larger
     # per-chip batches — the MFU lever when activations bound the batch.
     remat: bool = False
+    # Mixture-of-experts MLP (parallel/moe.py): >0 replaces every block's
+    # dense MLP with moe_experts experts (GShard one-hot dispatch, static
+    # capacity).  The auxiliary load-balancing loss is sowed into the
+    # "losses" collection: apply with mutable=["losses"] and add
+    # sum(losses) * your coefficient to the training loss.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
 
     def __post_init__(self):
         if self.num_kv_heads is not None:
@@ -173,6 +181,19 @@ class Block(nn.Module):
         x = x + nn.Dense(cfg.emb_dim, dtype=cfg.dtype, name="proj")(att)
 
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        if cfg.moe_experts > 0:
+            from ..parallel.moe import moe_flax_params, moe_mlp  # noqa: PLC0415
+
+            moe_p = moe_flax_params(
+                self, cfg.emb_dim, cfg.mlp_ratio * cfg.emb_dim,
+                cfg.moe_experts,
+            )
+            y, aux = moe_mlp(
+                h, moe_p, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+            )
+            self.sow("losses", "moe_aux", aux)
+            return x + y
         h = nn.Dense(cfg.mlp_ratio * cfg.emb_dim, dtype=cfg.dtype,
                      name="fc1")(h)
         h = nn.gelu(h)

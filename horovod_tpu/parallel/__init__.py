@@ -15,5 +15,11 @@ from .ring_attention import (  # noqa: F401
     zigzag_shard,
     zigzag_unshard,
 )
+from .moe import (  # noqa: F401
+    MoEParams,
+    init_moe_params,
+    moe_mlp,
+    moe_mlp_ep,
+)
 from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
 from .tensor_parallel import stack_tp_params, tp_gpt_apply  # noqa: F401

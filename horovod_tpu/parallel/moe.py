@@ -1,0 +1,230 @@
+"""Mixture-of-experts with expert parallelism (EP).
+
+Beyond reference parity (Horovod 0.19.1 is data-parallel only,
+SURVEY.md §2.9): a GShard-style MoE MLP for the transformer family,
+TPU-first —
+
+* **static shapes everywhere**: top-k routing becomes one-hot
+  dispatch/combine tensors with a fixed per-expert capacity, so the
+  whole layer is einsums the MXU eats (no gather/scatter, no dynamic
+  sizes);
+* capacity overflow DROPS tokens (they ride the residual), the standard
+  Switch/GShard behavior;
+* an auxiliary load-balancing loss (Switch formulation: E * sum over
+  experts of fraction-of-tokens x mean-gate) keeps routing spread;
+* **expert parallelism**: experts shard over a mesh axis; tokens reach
+  their expert's owner through one ``lax.all_to_all`` each way — the
+  EP result is EXACTLY the dense formulation's (same math, different
+  layout), pinned by tests/test_moe.py.
+
+Layout contract for :func:`moe_mlp_ep` — call inside ``shard_map`` with
+tokens sharded over the axis and the expert weights sharded on their
+leading (expert) dim; every rank must carry the same token count.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["init_moe_params", "moe_mlp", "moe_mlp_ep", "MoEParams"]
+
+# Initialization scheme, shared by the raw-NamedTuple and flax paths so
+# the two can never drift: small-normal router, fan-in-scaled FFN.
+ROUTER_STD = 0.02
+
+
+def _ffn_scales(d: int, ff: int):
+    return (2.0 / d) ** 0.5, (2.0 / ff) ** 0.5
+
+
+class MoEParams(NamedTuple):
+    """Weights of one MoE MLP: router + E experts' FFNs."""
+
+    router: jax.Array  # [d, E]
+    w1: jax.Array      # [E, d, ff]
+    b1: jax.Array      # [E, ff]
+    w2: jax.Array      # [E, ff, d]
+    b2: jax.Array      # [E, d]
+
+
+def init_moe_params(key, d: int, ff: int, num_experts: int,
+                    dtype=jnp.float32) -> MoEParams:
+    kr, k1, k2 = jax.random.split(key, 3)
+    s1, s2 = _ffn_scales(d, ff)
+    return MoEParams(
+        router=(jax.random.normal(kr, (d, num_experts)) * ROUTER_STD
+                ).astype(dtype),
+        w1=(jax.random.normal(k1, (num_experts, d, ff)) * s1).astype(dtype),
+        b1=jnp.zeros((num_experts, ff), dtype),
+        w2=(jax.random.normal(k2, (num_experts, ff, d)) * s2).astype(dtype),
+        b2=jnp.zeros((num_experts, d), dtype),
+    )
+
+
+def _routing(x2, router, num_experts: int, top_k: int, capacity: int):
+    """Shared routing math on flat tokens ``x2 [n, d]``.
+
+    Returns ``(dispatch [n, E, C], combine [n, E, C], aux_loss)`` —
+    the GShard one-hot formulation: ``dispatch`` says which (expert,
+    capacity-slot) each token occupies; ``combine`` carries the gate
+    weight on the same slot.
+    """
+    n = x2.shape[0]
+    logits = (x2.astype(jnp.float32) @ router.astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)  # [n, E]
+
+    # Switch/GShard aux loss on the FULL distribution (before top-k):
+    # E * sum_e mean_tokens_to_e * mean_gate_e ; == 1 when uniform.
+    # importance = fraction of tokens whose top-1 is e
+    top1 = jnp.argmax(gates, axis=-1)
+    me = jnp.mean(jax.nn.one_hot(top1, num_experts), axis=0)
+    ce = jnp.mean(gates, axis=0)
+    aux_loss = num_experts * jnp.sum(me * ce)
+
+    dispatch = jnp.zeros((n, num_experts, capacity), jnp.float32)
+    combine = jnp.zeros((n, num_experts, capacity), jnp.float32)
+    remaining = gates
+    # fill[e] = next free capacity slot of expert e, advanced per k-round
+    fill = jnp.zeros((num_experts,), jnp.int32)
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)            # [n]
+        gate_k = jnp.take_along_axis(
+            remaining, idx[:, None], axis=-1
+        )[:, 0]
+        onehot = jax.nn.one_hot(idx, num_experts)       # [n, E]
+        # position of each token within its expert's queue this round
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - 1.0)   # [n, E]
+        slot = (pos_in_e * onehot).sum(-1).astype(jnp.int32) \
+            + jnp.take(fill, idx)                       # [n]
+        keep = slot < capacity                          # overflow drops
+        slot_oh = jax.nn.one_hot(
+            jnp.where(keep, slot, capacity), capacity + 1
+        )[:, :capacity]                                 # [n, C]
+        d_k = onehot[:, :, None] * slot_oh[:, None, :]  # [n, E, C]
+        dispatch = dispatch + d_k
+        combine = combine + d_k * gate_k[:, None, None]
+        fill = fill + jnp.sum(
+            onehot * keep[:, None], axis=0
+        ).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)          # mask chosen expert
+    # normalize combine weights over the selected experts per token
+    denom = combine.sum(axis=(1, 2), keepdims=True)
+    combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), 0.0)
+    return dispatch, combine, aux_loss
+
+
+def _expert_ffn(buf, w1, b1, w2, b2, dtype):
+    """Batched expert FFN on ``buf [E_local, C, d]``."""
+    h = jnp.einsum("ecd,edf->ecf", buf.astype(dtype), w1.astype(dtype))
+    h = jax.nn.gelu(h + b1[:, None, :].astype(dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype))
+    return out + b2[:, None, :].astype(dtype)
+
+
+def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
+            capacity_factor: float = 2.0,
+            dtype=jnp.float32):
+    """Dense (single-device / data-parallel) MoE MLP.
+
+    ``x [b, s, d]`` -> ``(y [b, s, d], aux_loss)``.  Capacity =
+    ``ceil(capacity_factor * n * top_k / E)`` slots per expert; overflow
+    tokens pass through with zero MLP contribution (residual-only).
+    """
+    b, s, d = x.shape
+    num_experts = params.router.shape[1]
+    n = b * s
+    x2 = x.reshape(n, d)
+    capacity = max(1, int(-(-capacity_factor * n * top_k // num_experts)))
+    dispatch, combine, aux = _routing(
+        x2, params.router, num_experts, top_k, capacity
+    )
+    buf = jnp.einsum("nec,nd->ecd", dispatch, x2.astype(jnp.float32))
+    out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
+                      dtype)
+    y = jnp.einsum("nec,ecd->nd", combine, out.astype(jnp.float32))
+    return y.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe_mlp_ep(x, params: MoEParams, ep_axis: str, *, top_k: int = 2,
+               capacity_factor: float = 2.0, dtype=jnp.float32):
+    """Expert-parallel MoE MLP: call inside ``shard_map``.
+
+    Sharding: ``x [b_local, s, d]`` tokens sharded over ``ep_axis``;
+    ``params.w1/b1/w2/b2`` sharded on the leading expert dim
+    (``E_local = E / P`` per rank); ``params.router`` replicated.
+    Per-expert capacity counts LOCAL tokens, so global capacity per
+    expert is identical to the dense formulation run per shard.
+
+    Two ``lax.all_to_all`` (tokens to expert owners and back); result is
+    numerically identical to :func:`moe_mlp` applied shard-wise with the
+    full expert set.
+    """
+    p = lax.axis_size(ep_axis)
+    b, s, d = x.shape
+    e_local = params.w1.shape[0]
+    num_experts = e_local * p
+    if params.router.shape[1] != num_experts:
+        # without this, out-of-range expert indices one-hot to zero and
+        # tokens silently ride the residual
+        raise ValueError(
+            f"router has {params.router.shape[1]} experts but the sharded "
+            f"weights imply {e_local} x {p} ranks = {num_experts}"
+        )
+    n = b * s
+    x2 = x.reshape(n, d)
+    capacity = max(1, int(-(-capacity_factor * n * top_k // num_experts)))
+    dispatch, combine, aux = _routing(
+        x2, params.router, num_experts, top_k, capacity
+    )
+    # local per-expert buffers for ALL experts, then ship each expert
+    # group to its owner: [E, C, d] -> a2a over the expert dim ->
+    # [P * E_local tiles] == this rank's experts' tokens from every rank
+    buf = jnp.einsum("nec,nd->ecd", dispatch, x2.astype(jnp.float32))
+    buf = buf.reshape(p, e_local, capacity, d)
+    buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=0,
+                         tiled=False)          # [P, e_local, C, d]
+    buf = buf.transpose(1, 0, 2, 3).reshape(e_local, p * capacity, d)
+    out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
+                      dtype)
+    out = out.reshape(e_local, p, capacity, d).transpose(1, 0, 2, 3)
+    out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0,
+                         tiled=False)          # [P, e_local, C, d] home
+    out = out.reshape(num_experts, capacity, d)
+    y = jnp.einsum("nec,ecd->nd", combine, out.astype(jnp.float32))
+    # aux is a per-shard statistic; average it so every rank agrees
+    aux = lax.pmean(aux, ep_axis)
+    return y.reshape(b, s, d).astype(x.dtype), aux
+
+
+# --------------------------------------------------------------------- flax
+
+def moe_flax_params(module, d: int, ff: int, num_experts: int) -> MoEParams:
+    """Declare the MoE weights on a flax module (fp32 params, like the
+    rest of the model family; compute casts per call)."""
+    import flax.linen as nn  # noqa: PLC0415
+
+    s1, s2 = _ffn_scales(d, ff)
+    return MoEParams(
+        router=module.param(
+            "router", nn.initializers.normal(ROUTER_STD), (d, num_experts),
+            jnp.float32,
+        ),
+        w1=module.param(
+            "w1", nn.initializers.normal(s1), (num_experts, d, ff),
+            jnp.float32,
+        ),
+        b1=module.param(
+            "b1", nn.initializers.zeros, (num_experts, ff), jnp.float32
+        ),
+        w2=module.param(
+            "w2", nn.initializers.normal(s2), (num_experts, ff, d),
+            jnp.float32,
+        ),
+        b2=module.param(
+            "b2", nn.initializers.zeros, (num_experts, d), jnp.float32
+        ),
+    )

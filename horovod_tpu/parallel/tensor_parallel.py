@@ -91,6 +91,12 @@ def stack_tp_params(params, cfg, tp: int):
             replicated[name] = sub  # embeddings / final LN / head
             continue
         blk = dict(sub)
+        if "fc1" not in blk:
+            raise ValueError(
+                "stack_tp_params supports dense blocks only; MoE blocks "
+                "(cfg.moe_experts > 0) shard over the ep axis instead "
+                "(parallel/moe.py moe_mlp_ep)"
+            )
         qk, qb = _split_qkv_columns(
             blk["qkv"]["kernel"], blk["qkv"]["bias"], cfg, tp
         )
