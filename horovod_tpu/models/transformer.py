@@ -73,6 +73,7 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    moe_group_size: int = 4096  # routing group (keeps dispatch O(n*group))
 
     def __post_init__(self):
         if self.num_kv_heads is not None:
@@ -190,10 +191,13 @@ class Block(nn.Module):
             )
             y, aux = moe_mlp(
                 h, moe_p, top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                capacity_factor=cfg.moe_capacity_factor,
+                group_size=cfg.moe_group_size, dtype=cfg.dtype,
             )
             self.sow("losses", "moe_aux", aux)
-            return x + y
+            # y inherits ln2's fp32; keep the residual stream in the
+            # compute dtype like the dense-MLP path does
+            return x + y.astype(cfg.dtype)
         h = nn.Dense(cfg.mlp_ratio * cfg.emb_dim, dtype=cfg.dtype,
                      name="fc1")(h)
         h = nn.gelu(h)

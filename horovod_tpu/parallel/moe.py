@@ -41,6 +41,21 @@ def _ffn_scales(d: int, ff: int):
     return (2.0 / d) ** 0.5, (2.0 / ff) ** 0.5
 
 
+# Routing group size: tokens route within fixed-size groups (GShard
+# grouping), so dispatch/combine stay O(n * group) instead of O(n^2) —
+# at group 4096 and cf=2, a layer's routing tensors are bounded at
+# ~n * 16k floats regardless of sequence length.
+DEFAULT_GROUP_SIZE = 4096
+
+
+def _pick_group(n: int, group_size: int) -> int:
+    """Largest divisor of n that is <= group_size."""
+    g = min(group_size, n)
+    while n % g:
+        g -= 1
+    return g
+
+
 class MoEParams(NamedTuple):
     """Weights of one MoE MLP: router + E experts' FFNs."""
 
@@ -125,32 +140,53 @@ def _expert_ffn(buf, w1, b1, w2, b2, dtype):
     return out + b2[:, None, :].astype(dtype)
 
 
+def _grouped_routing(x2, router, num_experts, top_k, capacity_factor,
+                     group_size):
+    """Route within fixed-size token groups (vmapped _routing): returns
+    ``(xg [G,g,d], dispatch [G,g,E,C], combine [G,g,E,C], capacity,
+    aux)`` with per-group capacity, keeping routing memory linear in n."""
+    n, d = x2.shape
+    g = _pick_group(n, group_size)
+    xg = x2.reshape(n // g, g, d)
+    capacity = max(1, int(-(-capacity_factor * g * top_k // num_experts)))
+    dispatch, combine, aux = jax.vmap(
+        lambda xx: _routing(xx, router, num_experts, top_k, capacity)
+    )(xg)
+    return xg, dispatch, combine, capacity, aux.mean()
+
+
 def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
             capacity_factor: float = 2.0,
+            group_size: int = DEFAULT_GROUP_SIZE,
             dtype=jnp.float32):
     """Dense (single-device / data-parallel) MoE MLP.
 
-    ``x [b, s, d]`` -> ``(y [b, s, d], aux_loss)``.  Capacity =
-    ``ceil(capacity_factor * n * top_k / E)`` slots per expert; overflow
-    tokens pass through with zero MLP contribution (residual-only).
+    ``x [b, s, d]`` -> ``(y [b, s, d], aux_loss)``.  Tokens route within
+    groups of <= ``group_size``; capacity =
+    ``ceil(capacity_factor * group * top_k / E)`` slots per expert per
+    group; overflow tokens pass through with zero MLP contribution
+    (residual-only).
     """
     b, s, d = x.shape
     num_experts = params.router.shape[1]
     n = b * s
     x2 = x.reshape(n, d)
-    capacity = max(1, int(-(-capacity_factor * n * top_k // num_experts)))
-    dispatch, combine, aux = _routing(
-        x2, params.router, num_experts, top_k, capacity
+    xg, dispatch, combine, capacity, aux = _grouped_routing(
+        x2, params.router, num_experts, top_k, capacity_factor, group_size
     )
-    buf = jnp.einsum("nec,nd->ecd", dispatch, x2.astype(jnp.float32))
+    G = xg.shape[0]
+    buf = jnp.einsum("gnec,gnd->gecd", dispatch, xg.astype(jnp.float32))
+    buf = buf.transpose(1, 0, 2, 3).reshape(num_experts, G * capacity, d)
     out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
                       dtype)
-    y = jnp.einsum("nec,ecd->nd", combine, out.astype(jnp.float32))
+    out = out.reshape(num_experts, G, capacity, d).transpose(1, 0, 2, 3)
+    y = jnp.einsum("gnec,gecd->gnd", combine, out.astype(jnp.float32))
     return y.reshape(b, s, d).astype(x.dtype), aux
 
 
 def moe_mlp_ep(x, params: MoEParams, ep_axis: str, *, top_k: int = 2,
-               capacity_factor: float = 2.0, dtype=jnp.float32):
+               capacity_factor: float = 2.0,
+               group_size: int = DEFAULT_GROUP_SIZE, dtype=jnp.float32):
     """Expert-parallel MoE MLP: call inside ``shard_map``.
 
     Sharding: ``x [b_local, s, d]`` tokens sharded over ``ep_axis``;
@@ -176,25 +212,27 @@ def moe_mlp_ep(x, params: MoEParams, ep_axis: str, *, top_k: int = 2,
         )
     n = b * s
     x2 = x.reshape(n, d)
-    capacity = max(1, int(-(-capacity_factor * n * top_k // num_experts)))
-    dispatch, combine, aux = _routing(
-        x2, params.router, num_experts, top_k, capacity
+    xg, dispatch, combine, capacity, aux = _grouped_routing(
+        x2, params.router, num_experts, top_k, capacity_factor, group_size
     )
+    G = xg.shape[0]
+    cap_total = G * capacity
     # local per-expert buffers for ALL experts, then ship each expert
-    # group to its owner: [E, C, d] -> a2a over the expert dim ->
+    # group to its owner: [E, G*C, d] -> a2a over the expert dim ->
     # [P * E_local tiles] == this rank's experts' tokens from every rank
-    buf = jnp.einsum("nec,nd->ecd", dispatch, x2.astype(jnp.float32))
-    buf = buf.reshape(p, e_local, capacity, d)
+    buf = jnp.einsum("gnec,gnd->gecd", dispatch, xg.astype(jnp.float32))
+    buf = buf.transpose(1, 0, 2, 3).reshape(num_experts, cap_total, d)
+    buf = buf.reshape(p, e_local, cap_total, d)
     buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=0,
-                         tiled=False)          # [P, e_local, C, d]
-    buf = buf.transpose(1, 0, 2, 3).reshape(e_local, p * capacity, d)
+                         tiled=False)          # [P, e_local, G*C, d]
+    buf = buf.transpose(1, 0, 2, 3).reshape(e_local, p * cap_total, d)
     out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
                       dtype)
-    out = out.reshape(e_local, p, capacity, d).transpose(1, 0, 2, 3)
+    out = out.reshape(e_local, p, cap_total, d).transpose(1, 0, 2, 3)
     out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0,
-                         tiled=False)          # [P, e_local, C, d] home
-    out = out.reshape(num_experts, capacity, d)
-    y = jnp.einsum("nec,ecd->nd", combine, out.astype(jnp.float32))
+                         tiled=False)          # [P, e_local, G*C, d] home
+    out = out.reshape(num_experts, G, capacity, d).transpose(1, 0, 2, 3)
+    y = jnp.einsum("gnec,gecd->gnd", combine, out.astype(jnp.float32))
     # aux is a per-shard statistic; average it so every rank agrees
     aux = lax.pmean(aux, ep_axis)
     return y.reshape(b, s, d).astype(x.dtype), aux

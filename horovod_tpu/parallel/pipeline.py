@@ -1,0 +1,192 @@
+"""GPipe-style pipeline parallelism for the transformer family.
+
+Beyond reference parity (Horovod 0.19.1 is data-parallel only,
+SURVEY.md §2.9): the GPT block stack splits into P contiguous stages
+over a ``pp`` mesh axis; microbatches stream through the pipeline with
+activations handed to the next stage by ``lax.ppermute`` each tick —
+the TPU-idiomatic SPMD pipeline (every rank runs the SAME program; stage
+identity comes from ``axis_index``), with a ``lax.scan`` over
+``M + P - 1`` ticks so the schedule is one compiled loop, no
+data-dependent control flow.
+
+Embeddings and the LM head stay replicated and run outside the
+pipelined region (they are marginal at these widths); each stage holds
+only its ``num_layers / P`` blocks' weights.  Equivalence with the
+unsharded model — forward and gradients — is pinned by
+tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["stack_pp_params", "pp_gpt_apply"]
+
+
+def stack_pp_params(params, cfg, pp: int):
+    """Split a GPT parameter pytree into ``(staged, replicated)``.
+
+    ``staged``: the block weights restacked as a pytree whose leaves have
+    leading dims ``[pp, layers_per_stage, ...]`` — shard over the mesh
+    with ``in_specs=P(pp_axis)``.  ``replicated``: embeddings, final LN,
+    head — ``in_specs=P()`` (truly replicated; see
+    tensor_parallel.stack_tp_params for why that distinction is
+    load-bearing under autodiff).
+    """
+    if cfg.num_layers % pp:
+        raise ValueError(
+            f"pp={pp} must divide num_layers={cfg.num_layers}"
+        )
+    if set(params.keys()) == {"params"}:
+        params = params["params"]
+    p = jax.tree_util.tree_map(np.asarray, params)
+    per = cfg.num_layers // pp
+    blocks = [p[f"block{i}"] for i in range(cfg.num_layers)]
+    if any("fc1" not in b for b in blocks):
+        raise ValueError(
+            "stack_pp_params supports dense blocks only (MoE blocks "
+            "shard over the ep axis; see docs/moe.md)"
+        )
+    # stack homogenous block trees: leaf -> [pp, per, ...]
+    staged = jax.tree_util.tree_map(
+        lambda *leaves: jnp.asarray(np.stack(leaves).reshape(
+            (pp, per) + np.asarray(leaves[0]).shape
+        )),
+        *blocks,
+    )
+    replicated = {
+        k: jax.tree_util.tree_map(jnp.asarray, v)
+        for k, v in p.items() if not k.startswith("block")
+    }
+    return staged, replicated
+
+
+def _dense_block(cfg, p, x, positions, rope_tabs):
+    """One transformer block from raw weights (mirrors models.Block)."""
+    from .tensor_parallel import _layer_norm  # noqa: PLC0415
+    from ..models.transformer import _attend  # noqa: PLC0415
+
+    b, s, _ = x.shape
+    dt = cfg.dtype
+    hn = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = hn.astype(dt) @ p["qkv"]["kernel"].astype(dt) \
+        + p["qkv"]["bias"].astype(dt)
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    q = qkv[..., :cfg.emb_dim].reshape(
+        b, s, cfg.num_heads, cfg.head_dim
+    )
+    k = qkv[..., cfg.emb_dim:cfg.emb_dim + kv_dim].reshape(
+        b, s, cfg.kv_heads, cfg.head_dim
+    )
+    v = qkv[..., cfg.emb_dim + kv_dim:].reshape(
+        b, s, cfg.kv_heads, cfg.head_dim
+    )
+    if rope_tabs is not None:
+        from ..ops.rope import apply_rope_tables  # noqa: PLC0415
+
+        q = apply_rope_tables(q, *rope_tabs)
+        k = apply_rope_tables(k, *rope_tabs)
+    att = _attend(cfg, q, k, v, positions).reshape(b, s, cfg.emb_dim)
+    x = x + att.astype(dt) @ p["proj"]["kernel"].astype(dt) \
+        + p["proj"]["bias"].astype(dt)
+    hn = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    m = hn.astype(dt) @ p["fc1"]["kernel"].astype(dt) \
+        + p["fc1"]["bias"].astype(dt)
+    m = jax.nn.gelu(m)
+    return x + m @ p["fc2"]["kernel"].astype(dt) \
+        + p["fc2"]["bias"].astype(dt)
+
+
+def pp_gpt_apply(staged_params, replicated_params, cfg, tokens,
+                 pp_axis: str, *, microbatches: int,
+                 pos_offset=0, positions=None):
+    """``GPT.apply`` with the block stack pipelined over ``pp_axis``.
+
+    ``tokens [batch, seq]`` must be replicated over the axis and have
+    ``batch % microbatches == 0``.  The schedule is GPipe forward:
+    ``M + P - 1`` ticks, one microbatch entering stage 0 per tick,
+    activations ppermuted stage-to-stage.  Returns fp32 logits.
+    """
+    from .tensor_parallel import _gpt_embed, _gpt_head  # noqa: PLC0415
+
+    pp = lax.axis_size(pp_axis)
+    stage = lax.axis_index(pp_axis)
+    rep = replicated_params
+    b, s = tokens.shape
+    if b % microbatches:
+        raise ValueError(
+            f"batch {b} must divide into microbatches={microbatches}"
+        )
+    # embed (replicated, outside the pipeline) — shared GPT scaffold
+    x, positions, rope_tabs = _gpt_embed(rep, cfg, tokens, pos_offset,
+                                         positions)
+
+    mb = b // microbatches
+    mbs = x.reshape(microbatches, mb, s, cfg.emb_dim)
+    local = jax.tree_util.tree_map(lambda a: a[0], staged_params)
+    layers_per_stage = jax.tree_util.tree_leaves(local)[0].shape[0]
+
+    def run_stage(x):
+        for j in range(layers_per_stage):
+            p_j = jax.tree_util.tree_map(lambda a: a[j], local)
+            x = _dense_block(cfg, p_j, x, positions, rope_tabs)
+        return x
+
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    n_ticks = microbatches + pp - 1
+
+    def _varying(v):
+        """Mark a replicated value device-varying so the scan carry's
+        type matches the ppermute outputs under replication tracking
+        (check_vma=True) — a no-op without it."""
+        try:
+            return lax.pcast(v, pp_axis, to="varying")
+        except (AttributeError, TypeError):  # older jax: pvary spelling
+            try:
+                return lax.pvary(v, pp_axis)
+            except Exception:
+                return v
+
+    zero = _varying(jnp.zeros((mb, s, cfg.emb_dim), cfg.dtype))
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # stage 0 ingests microbatch t (while t < M); others take the
+        # activation handed over by the previous stage
+        feed_idx = jnp.clip(t, 0, microbatches - 1)
+        fresh = lax.dynamic_index_in_dim(mbs, feed_idx, axis=0,
+                                         keepdims=False)
+        x_in = jnp.where(stage == 0, fresh, incoming)
+        y = run_stage(x_in)
+        # last stage finished microbatch t - (pp - 1) this tick
+        out_idx = jnp.clip(t - (pp - 1), 0, microbatches - 1)
+        take = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(take,
+                      y,
+                      lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                               keepdims=False)),
+            out_idx, axis=0,
+        )
+        incoming = lax.ppermute(y, pp_axis, fwd_perm)
+        return (incoming, outputs), None
+
+    outputs0 = _varying(jnp.zeros(
+        (microbatches, mb, s, cfg.emb_dim), cfg.dtype
+    ))
+    (_, outputs), _ = lax.scan(
+        tick, (zero, outputs0), jnp.arange(n_ticks)
+    )
+    # only the last stage holds real outputs; broadcast them to all
+    # ranks so the (replicated) head runs everywhere and the caller gets
+    # replicated logits — one psum of a masked contribution
+    outputs = lax.psum(
+        jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)),
+        pp_axis,
+    )
+    x = outputs.reshape(b, s, cfg.emb_dim)
+    return _gpt_head(rep, cfg, x)

@@ -137,6 +137,42 @@ def _layer_norm(x, scale, bias):
     return y * scale + bias
 
 
+def _gpt_embed(rep, cfg, tokens, pos_offset, positions):
+    """Shared replicated preamble of the TP/PP reimplementations of
+    GPT.apply — ONE copy of its trace-time guards and embedding contract
+    (max_len check, zigzag-positions requirement, learned-table gather
+    with loud NaN fill, rope tables).  Returns (x, positions, rope_tabs).
+    """
+    s = tokens.shape[1]
+    if s > cfg.max_len:
+        raise ValueError(f"sequence length {s} exceeds max_len={cfg.max_len}")
+    if positions is None:
+        if cfg.attention_impl == "zigzag":
+            raise ValueError(
+                "attention_impl='zigzag' requires explicit positions "
+                "(zigzag_positions(axis_index, P, s_local))"
+            )
+        positions = pos_offset + jnp.arange(s)
+    x = jnp.take(rep["wte"]["embedding"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.pos_embedding == "learned":
+        pos = jnp.take(rep["wpe"], positions, axis=0,
+                       mode="fill", fill_value=jnp.nan)
+        x = x + pos.astype(cfg.dtype)[None]
+    rope_tabs = None
+    if cfg.pos_embedding == "rope":
+        from ..ops.rope import rope_tables  # noqa: PLC0415
+
+        rope_tabs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    return x, positions, rope_tabs
+
+
+def _gpt_head(rep, cfg, x):
+    """Shared replicated epilogue: final LN + LM head, fp32 logits."""
+    x = _layer_norm(x, rep["lnf"]["scale"], rep["lnf"]["bias"])
+    logits = x.astype(cfg.dtype) @ rep["head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
 def _tp_block(cfg, p, rep, x, positions, rope_tabs, tp_axis, tp):
     """One transformer block on this rank's head/width shard; two psums."""
     from ..models.transformer import _attend  # noqa: PLC0415
@@ -193,30 +229,9 @@ def tp_gpt_apply(sharded_params, replicated_params, cfg, tokens,
     tp = lax.axis_size(tp_axis)
     p = jax.tree_util.tree_map(lambda a: a[0], sharded_params)
     rep = replicated_params
-    s = tokens.shape[1]
-    # same trace-time guards as GPT.apply (whose contract this reproduces)
-    if s > cfg.max_len:
-        raise ValueError(f"sequence length {s} exceeds max_len={cfg.max_len}")
-    if positions is None:
-        if cfg.attention_impl == "zigzag":
-            raise ValueError(
-                "attention_impl='zigzag' requires explicit positions "
-                "(zigzag_positions(axis_index, P, s_local))"
-            )
-        positions = pos_offset + jnp.arange(s)
-    x = jnp.take(rep["wte"]["embedding"], tokens, axis=0).astype(cfg.dtype)
-    if cfg.pos_embedding == "learned":
-        pos = jnp.take(rep["wpe"], positions, axis=0,
-                       mode="fill", fill_value=jnp.nan)
-        x = x + pos.astype(cfg.dtype)[None]
-    rope_tabs = None
-    if cfg.pos_embedding == "rope":
-        from ..ops.rope import rope_tables  # noqa: PLC0415
-
-        rope_tabs = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    x, positions, rope_tabs = _gpt_embed(rep, cfg, tokens, pos_offset,
+                                         positions)
     for i in range(cfg.num_layers):
         x = _tp_block(cfg, p[f"block{i}"], rep[f"block{i}"], x, positions,
                       rope_tabs, tp_axis, tp)
-    x = _layer_norm(x, rep["lnf"]["scale"], rep["lnf"]["bias"])
-    logits = x.astype(cfg.dtype) @ rep["head"]["kernel"].astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    return _gpt_head(rep, cfg, x)

@@ -1,0 +1,130 @@
+"""GPipe pipeline parallelism (parallel/pipeline.py) — the pp axis of
+the optional-stretch parallelism set (reference is DP-only,
+SURVEY.md §2.9).
+
+Contract: pp_gpt_apply over a pp-axis mesh reproduces the unsharded
+GPT.apply (fp32, up to associativity), forward and gradients, with each
+stage holding only its layers' weights and activations streaming via
+ppermute.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.models.transformer import gpt
+from horovod_tpu.parallel.pipeline import pp_gpt_apply, stack_pp_params
+
+PP = 4
+AXIS = "pp"
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:PP]), (AXIS,))
+
+
+def _model(**overrides):
+    common = dict(num_layers=4, num_heads=4, emb_dim=64, max_len=64,
+                  vocab_size=512, dtype=jnp.float32,
+                  attention_impl="reference")
+    common.update(overrides)
+    return gpt("nano", **common)
+
+
+def _tokens(seed=0, b=4, s=16):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(0, 512, (b, s)), jnp.int32
+    )
+
+
+def _pp_fwd(model, params, tokens, microbatches):
+    staged, replicated = stack_pp_params(params, model.cfg, PP)
+
+    def local(staged, replicated, tok):
+        return pp_gpt_apply(staged, replicated, model.cfg, tok, AXIS,
+                            microbatches=microbatches)
+
+    fwd = jax.jit(
+        shard_map(
+            local, mesh=_mesh(),
+            in_specs=(P(AXIS), P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    return fwd(staged, replicated, tokens)
+
+
+@pytest.mark.parametrize("microbatches", [1, 2, 4])
+@pytest.mark.parametrize("pos_embedding", ["learned", "rope"])
+def test_pp_matches_single_device(microbatches, pos_embedding):
+    model = _model(pos_embedding=pos_embedding)
+    tokens = _tokens()
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    ref = model.apply(params, tokens)
+    out = _pp_fwd(model, params, tokens, microbatches)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_pp_gradients_match():
+    """Stage grads equal the matching layers' grads of the unsharded
+    model (check_vma=True for the collective transposes, as with TP)."""
+    model = _model()
+    tokens = _tokens(1)
+    params = model.init(jax.random.PRNGKey(1), tokens)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def loss_ref(p):
+        logits = model.apply(p, tokens)
+        return -jnp.take_along_axis(
+            jax.nn.log_softmax(logits), targets[..., None], -1
+        ).mean()
+
+    g_ref = jax.grad(loss_ref)(params)["params"]
+    staged, replicated = stack_pp_params(params, model.cfg, PP)
+
+    def local_loss(staged, replicated, tok, tgt):
+        logits = pp_gpt_apply(staged, replicated, model.cfg, tok, AXIS,
+                              microbatches=2)
+        return -jnp.take_along_axis(
+            jax.nn.log_softmax(logits), tgt[..., None], -1
+        ).mean()
+
+    grad_fn = jax.jit(
+        shard_map(
+            jax.grad(local_loss), mesh=_mesh(),
+            in_specs=(P(AXIS), P(), P(), P()), out_specs=P(AXIS),
+            check_vma=True,
+        )
+    )
+    g_pp = grad_fn(staged, replicated, tokens, targets)
+    # stage 0 holds block0 (1 layer/stage with 4 layers, pp=4)
+    np.testing.assert_allclose(
+        np.asarray(g_pp["qkv"]["kernel"][0, 0]),
+        np.asarray(g_ref["block0"]["qkv"]["kernel"]),
+        atol=2e-4, rtol=2e-4,
+    )
+    # stage 3 holds block3
+    np.testing.assert_allclose(
+        np.asarray(g_pp["fc2"]["kernel"][3, 0]),
+        np.asarray(g_ref["block3"]["fc2"]["kernel"]),
+        atol=2e-4, rtol=2e-4,
+    )
+
+
+def test_pp_validation_errors():
+    model = _model(num_layers=3)  # 3 % 4 != 0
+    params = model.init(jax.random.PRNGKey(0), _tokens())
+    with pytest.raises(ValueError, match="must divide num_layers"):
+        stack_pp_params(params, model.cfg, PP)
+
+    model = _model()
+    params = model.init(jax.random.PRNGKey(0), _tokens())
+    with pytest.raises(Exception, match="microbatches"):
+        _pp_fwd(model, params, _tokens(b=3), microbatches=2)
