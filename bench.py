@@ -63,7 +63,8 @@ def peak_flops_per_chip(device, dtype: str) -> float:
 def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                    attention: str = "flash", remat: bool = False,
                    flash_block_q: int = 128, flash_block_k: int = 128,
-                   kv_heads: int = 0, pos_embedding: str = "learned"):
+                   kv_heads: int = 0, pos_embedding: str = "learned",
+                   moe_experts: int = 0):
     """GPT causal-LM training step (flash attention) — the long-context
     counterpart of the ResNet bench.  Returns ``(step, state, static)``
     like ``build_step``; throughput is reported in tokens/sec/chip."""
@@ -89,7 +90,7 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                 attention_impl=attention, remat=remat,
                 flash_block_q=flash_block_q, flash_block_k=flash_block_k,
                 num_kv_heads=kv_heads or None,
-                pos_embedding=pos_embedding)
+                pos_embedding=pos_embedding, moe_experts=moe_experts)
     vocab = model.cfg.vocab_size
 
     global_batch = batch_size * n_chips
@@ -106,10 +107,17 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
 
     def local_step(params, opt_state, toks):
         def loss_fn(p):
-            logits = model.apply(p, toks[:, :-1])
+            if moe_experts:
+                logits, state = model.apply(
+                    p, toks[:, :-1], mutable=["losses"]
+                )
+                aux = 0.01 * sum(jax.tree_util.tree_leaves(state["losses"]))
+            else:
+                logits = model.apply(p, toks[:, :-1])
+                aux = 0.0
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, toks[:, 1:]
-            ).mean()
+            ).mean() + aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -342,6 +350,9 @@ def main() -> int:
                         "(0 = MHA)")
     parser.add_argument("--pos-embedding", default="learned",
                         choices=["learned", "rope"])
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="replace gpt MLPs with this many experts "
+                        "(0 = dense); aux loss folded into the objective")
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--s2d-stem", action="store_true",
@@ -383,6 +394,7 @@ def main() -> int:
                 flash_block_q=args.flash_block_q,
                 flash_block_k=args.flash_block_k,
                 kv_heads=args.kv_heads, pos_embedding=args.pos_embedding,
+                moe_experts=args.moe_experts,
             )
             carry, const = state[:-1], state[-1:]
         else:

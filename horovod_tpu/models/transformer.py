@@ -37,6 +37,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..parallel.moe import DEFAULT_GROUP_SIZE as MOE_DEFAULT_GROUP_SIZE
+
 
 @dataclass(frozen=True)
 class TransformerConfig:
@@ -73,7 +75,9 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
-    moe_group_size: int = 4096  # routing group (keeps dispatch O(n*group))
+    # routing group (keeps dispatch O(n*group)); default tracks the one
+    # source of truth in parallel/moe.py
+    moe_group_size: int = MOE_DEFAULT_GROUP_SIZE
 
     def __post_init__(self):
         if self.num_kv_heads is not None:

@@ -48,14 +48,6 @@ def _ffn_scales(d: int, ff: int):
 DEFAULT_GROUP_SIZE = 4096
 
 
-def _pick_group(n: int, group_size: int) -> int:
-    """Largest divisor of n that is <= group_size."""
-    g = min(group_size, n)
-    while n % g:
-        g -= 1
-    return g
-
-
 class MoEParams(NamedTuple):
     """Weights of one MoE MLP: router + E experts' FFNs."""
 
@@ -80,24 +72,33 @@ def init_moe_params(key, d: int, ff: int, num_experts: int,
     )
 
 
-def _routing(x2, router, num_experts: int, top_k: int, capacity: int):
+def _routing(x2, router, num_experts: int, top_k: int, capacity: int,
+             valid=None):
     """Shared routing math on flat tokens ``x2 [n, d]``.
 
     Returns ``(dispatch [n, E, C], combine [n, E, C], aux_loss)`` —
     the GShard one-hot formulation: ``dispatch`` says which (expert,
     capacity-slot) each token occupies; ``combine`` carries the gate
-    weight on the same slot.
+    weight on the same slot.  ``valid [n]`` (optional bool) marks real
+    tokens: padding rows claim no capacity slots and are excluded from
+    the aux statistics.
     """
     n = x2.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), jnp.float32)
+    else:
+        valid = valid.astype(jnp.float32)
+    n_valid = jnp.maximum(valid.sum(), 1.0)
     logits = (x2.astype(jnp.float32) @ router.astype(jnp.float32))
     gates = jax.nn.softmax(logits, axis=-1)  # [n, E]
 
     # Switch/GShard aux loss on the FULL distribution (before top-k):
     # E * sum_e mean_tokens_to_e * mean_gate_e ; == 1 when uniform.
-    # importance = fraction of tokens whose top-1 is e
+    # importance = fraction of (valid) tokens whose top-1 is e
     top1 = jnp.argmax(gates, axis=-1)
-    me = jnp.mean(jax.nn.one_hot(top1, num_experts), axis=0)
-    ce = jnp.mean(gates, axis=0)
+    me = (jax.nn.one_hot(top1, num_experts) * valid[:, None]
+          ).sum(0) / n_valid
+    ce = (gates * valid[:, None]).sum(0) / n_valid
     aux_loss = num_experts * jnp.sum(me * ce)
 
     dispatch = jnp.zeros((n, num_experts, capacity), jnp.float32)
@@ -110,7 +111,7 @@ def _routing(x2, router, num_experts: int, top_k: int, capacity: int):
         gate_k = jnp.take_along_axis(
             remaining, idx[:, None], axis=-1
         )[:, 0]
-        onehot = jax.nn.one_hot(idx, num_experts)       # [n, E]
+        onehot = jax.nn.one_hot(idx, num_experts) * valid[:, None]
         # position of each token within its expert's queue this round
         pos_in_e = (jnp.cumsum(onehot, axis=0) - 1.0)   # [n, E]
         slot = (pos_in_e * onehot).sum(-1).astype(jnp.int32) \
@@ -144,15 +145,25 @@ def _grouped_routing(x2, router, num_experts, top_k, capacity_factor,
                      group_size):
     """Route within fixed-size token groups (vmapped _routing): returns
     ``(xg [G,g,d], dispatch [G,g,E,C], combine [G,g,E,C], capacity,
-    aux)`` with per-group capacity, keeping routing memory linear in n."""
+    aux, n)`` with per-group capacity, keeping routing memory linear in
+    n.  Token counts that don't divide by the group PAD with invalid
+    rows (they claim no capacity and skew no statistics) rather than
+    shrinking the group — a tiny divisor would make per-group capacity
+    ~1 and silently drop most tokens."""
     n, d = x2.shape
-    g = _pick_group(n, group_size)
-    xg = x2.reshape(n // g, g, d)
+    g = min(group_size, n)
+    pad = (-n) % g
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    valid = (jnp.arange(n + pad) < n)
+    xg = x2.reshape((n + pad) // g, g, d)
+    vg = valid.reshape((n + pad) // g, g)
     capacity = max(1, int(-(-capacity_factor * g * top_k // num_experts)))
     dispatch, combine, aux = jax.vmap(
-        lambda xx: _routing(xx, router, num_experts, top_k, capacity)
-    )(xg)
-    return xg, dispatch, combine, capacity, aux.mean()
+        lambda xx, vv: _routing(xx, router, num_experts, top_k, capacity,
+                                valid=vv)
+    )(xg, vg)
+    return xg, dispatch, combine, capacity, aux.mean(), n
 
 
 def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
@@ -171,7 +182,7 @@ def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
     num_experts = params.router.shape[1]
     n = b * s
     x2 = x.reshape(n, d)
-    xg, dispatch, combine, capacity, aux = _grouped_routing(
+    xg, dispatch, combine, capacity, aux, n = _grouped_routing(
         x2, params.router, num_experts, top_k, capacity_factor, group_size
     )
     G = xg.shape[0]
@@ -181,6 +192,7 @@ def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
                       dtype)
     out = out.reshape(num_experts, G, capacity, d).transpose(1, 0, 2, 3)
     y = jnp.einsum("gnec,gecd->gnd", combine, out.astype(jnp.float32))
+    y = y.reshape(-1, d)[:n]  # drop padding rows
     return y.reshape(b, s, d).astype(x.dtype), aux
 
 
@@ -212,7 +224,7 @@ def moe_mlp_ep(x, params: MoEParams, ep_axis: str, *, top_k: int = 2,
         )
     n = b * s
     x2 = x.reshape(n, d)
-    xg, dispatch, combine, capacity, aux = _grouped_routing(
+    xg, dispatch, combine, capacity, aux, n = _grouped_routing(
         x2, params.router, num_experts, top_k, capacity_factor, group_size
     )
     G = xg.shape[0]
@@ -233,6 +245,7 @@ def moe_mlp_ep(x, params: MoEParams, ep_axis: str, *, top_k: int = 2,
                          tiled=False)          # [P, e_local, G*C, d] home
     out = out.reshape(num_experts, G, capacity, d).transpose(1, 0, 2, 3)
     y = jnp.einsum("gnec,gecd->gnd", combine, out.astype(jnp.float32))
+    y = y.reshape(-1, d)[:n]  # drop padding rows
     # aux is a per-shard statistic; average it so every rank agrees
     aux = lax.pmean(aux, ep_axis)
     return y.reshape(b, s, d).astype(x.dtype), aux

@@ -147,8 +147,8 @@ def pp_gpt_apply(staged_params, replicated_params, cfg, tokens,
         except (AttributeError, TypeError):  # older jax: pvary spelling
             try:
                 return lax.pvary(v, pp_axis)
-            except Exception:
-                return v
+            except (AttributeError, TypeError):
+                return v  # very old jax: no vma tracking to satisfy
 
     zero = _varying(jnp.zeros((mb, s, cfg.emb_dim), cfg.dtype))
 

@@ -273,3 +273,18 @@ def test_ep_grouped_matches_dense_grouped():
     np.testing.assert_allclose(
         np.asarray(y_ep), np.concatenate(ys), atol=2e-5, rtol=2e-5
     )
+
+
+def test_padded_group_routing_matches_reference_loop():
+    """Token counts that don't divide the group pad with invalid rows
+    (never shrink to a tiny-divisor group): n=22, group_size=8 -> groups
+    of 8 with 2 padding rows, which claim no capacity; output still
+    matches the per-token loop and padding contributes nothing."""
+    x = jnp.asarray(
+        np.random.RandomState(8).randn(2, 11, D), jnp.float32
+    ) * 0.5  # n = 22
+    p = _params(8)
+    y, aux = moe_mlp(x, p, top_k=2, capacity_factor=100.0, group_size=8)
+    ref = _reference_loop(x, p, 2)
+    np.testing.assert_allclose(np.asarray(y), ref, atol=1e-4, rtol=1e-4)
+    assert np.isfinite(float(aux))
