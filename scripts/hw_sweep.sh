@@ -24,7 +24,8 @@ run() {
 }
 
 # 1. the headline record (VERDICT r3 item 1): expect ~2660 img/s bf16
-run resnet50_bf16_b256 --batch-size 256
+#    (batch 128 is the measured sweet spot — performance.md "Knobs tried")
+run resnet50_bf16_b128
 # 2. first real-chip GPT number (VERDICT r3 item 2)
 run gpt_small_base --model gpt-small
 # 3. the round-4 levers, one at a time
