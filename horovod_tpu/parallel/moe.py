@@ -24,7 +24,7 @@ leading (expert) dim; every rank must carry the same token count.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
