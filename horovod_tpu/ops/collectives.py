@@ -131,6 +131,19 @@ def _allreduce_bwd(axis_name, average, _, g):
 _allreduce_sum.defvjp(_allreduce_fwd, _allreduce_bwd)
 
 
+def _eager_tree(tensor, name, call):
+    """Flatten a pytree, derive per-leaf negotiation names (suffix ``.i``
+    only for multi-leaf pytrees), call, unflatten — the ONE definition of
+    the eager naming convention shared by every collective, so the keys
+    that pair tensors across ranks can never drift between ops."""
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    outs = [
+        call(leaf, f"{name}.{i}" if name and len(leaves) > 1 else name)
+        for i, leaf in enumerate(leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
 def allreduce(
     tensor,
     op: ReduceOp = Average,
@@ -190,18 +203,14 @@ def allreduce(
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415
 
-        leaves, treedef = jax.tree_util.tree_flatten(tensor)
-        outs = [
-            eager.allreduce(
-                leaf,
-                op,
-                name=(f"{name}.{i}" if name and len(leaves) > 1 else name),
+        return _eager_tree(
+            tensor, name,
+            lambda leaf, nm: eager.allreduce(
+                leaf, op, name=nm,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
-            )
-            for i, leaf in enumerate(leaves)
-        ]
-        return jax.tree_util.tree_unflatten(treedef, outs)
+            ),
+        )
     del name
     if op == Adasum:
         from .adasum import adasum_allreduce  # noqa: PLC0415
@@ -359,8 +368,8 @@ def allgather(tensor, *, axis_name: str = DP_AXIS, name: Optional[str] = None):
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415
 
-        return jax.tree_util.tree_map(
-            lambda x: eager.allgather(x, name=name), tensor
+        return _eager_tree(
+            tensor, name, lambda leaf, nm: eager.allgather(leaf, name=nm)
         )
     del name
     return jax.tree_util.tree_map(
@@ -407,8 +416,9 @@ def broadcast(
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415
 
-        return jax.tree_util.tree_map(
-            lambda x: eager.broadcast(x, root_rank, name=name), tensor
+        return _eager_tree(
+            tensor, name,
+            lambda leaf, nm: eager.broadcast(leaf, root_rank, name=nm),
         )
     del name
     return jax.tree_util.tree_map(
@@ -437,15 +447,9 @@ def alltoall(tensor, *, axis_name: str = DP_AXIS,
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415
 
-        leaves, treedef = jax.tree_util.tree_flatten(tensor)
-        outs = [
-            eager.alltoall(
-                leaf,
-                f"{name}.{i}" if name and len(leaves) > 1 else name,
-            )
-            for i, leaf in enumerate(leaves)
-        ]
-        return jax.tree_util.tree_unflatten(treedef, outs)
+        return _eager_tree(
+            tensor, name, lambda leaf, nm: eager.alltoall(leaf, nm)
+        )
 
     def one(x):
         x = jnp.asarray(x)
@@ -465,19 +469,22 @@ def alltoall(tensor, *, axis_name: str = DP_AXIS,
     return jax.tree_util.tree_map(one, tensor)
 
 
-def reducescatter(tensor, op: ReduceOp = Average, *, axis_name: str = DP_AXIS):
+def reducescatter(tensor, op: ReduceOp = Average, *,
+                  axis_name: str = DP_AXIS, name: Optional[str] = None):
     """Sum across shards, keep only this shard's dim-0 slice — the first leg
     of the reference's hierarchical allreduce (nccl_operations.cc:218-229)
     exposed as a user op.  Under tracing this is ``lax.psum_scatter``
     (dim0 must divide the axis size — XLA static shapes); on concrete
     arrays the eager engine serves it with the uneven-dim0 convention
-    (first ``dim0 % world`` ranks get one extra row)."""
+    (first ``dim0 % world`` ranks get one extra row).  ``name`` keys the
+    eager negotiation, like allreduce's."""
     if not _is_traced(tensor):
         _check_eager_axis(axis_name)
         from . import eager  # noqa: PLC0415
 
-        return jax.tree_util.tree_map(
-            lambda x: eager.reducescatter(x, op), tensor
+        return _eager_tree(
+            tensor, name,
+            lambda leaf, nm: eager.reducescatter(leaf, op, name=nm),
         )
 
     def one(x):

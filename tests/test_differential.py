@@ -22,7 +22,8 @@ def _schedule(seed: int, steps: int):
     rng = np.random.RandomState(seed)
     ops = []
     for i in range(steps):
-        kind = rng.choice(["allreduce", "allgather", "broadcast", "alltoall"])
+        kind = rng.choice(["allreduce", "allgather", "broadcast", "alltoall",
+                           "reducescatter"])
         dtype = rng.choice(["float32", "float64", "int32", "bfloat16"])
         dim = int(rng.randint(1, 4))
         shape = tuple(int(rng.randint(1, 4)) for _ in range(dim))
@@ -63,6 +64,11 @@ def _fuzz_fn(seed, steps):
             out = hvd.allgather(ragged, name=name)
         elif kind == "broadcast":
             out = hvd.broadcast(data, root_rank=root, name=name)
+        elif kind == "reducescatter":
+            # Sum/Average only (the op's contract); ints stay exact on Sum
+            rs_red = "Average" if (red == "Average"
+                                   and not dtype.startswith("int")) else "Sum"
+            out = hvd.reducescatter(data, op=getattr(hvd, rs_red), name=name)
         else:  # alltoall: dim0 must divide world
             flat = np.concatenate([data.reshape(-1)] * n)
             out = hvd.alltoall(flat, name=name)
