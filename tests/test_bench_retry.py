@@ -1,0 +1,77 @@
+"""bench.py tunnel-resilience machinery (unit level).
+
+The driver's official benchmark capture depends on this logic working
+the first time a real outage hits (BENCH_r03 was lost to one), so the
+string matching and the re-exec argv rebuild are pinned here; the
+end-to-end timing path is exercised by the CPU smoke in CI.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import bench
+
+
+def test_is_unavailable_matches_tunnel_signatures():
+    assert bench._is_unavailable(
+        RuntimeError("Unable to initialize backend 'axon': UNAVAILABLE: "
+                     "TPU backend setup/compile error (Unavailable).")
+    )
+    assert bench._is_unavailable(Exception("UNAVAILABLE: socket closed"))
+    assert not bench._is_unavailable(ValueError("shape mismatch"))
+    assert not bench._is_unavailable(KeyboardInterrupt())
+
+
+def test_reexec_rebuilds_argv_with_incremented_attempt(monkeypatch):
+    calls = {}
+
+    def fake_execv(exe, argv):
+        calls["exe"], calls["argv"] = exe, argv
+        raise SystemExit(0)  # execv never returns; simulate the cut
+
+    monkeypatch.setattr(bench.os, "execv", fake_execv)
+    monkeypatch.setattr(
+        bench.sys, "argv",
+        ["bench.py", "--model", "resnet50", "--batch-size", "128",
+         "--retry-attempt=1"],
+    )
+    with pytest.raises(SystemExit):
+        bench._reexec_next_attempt(1)
+    argv = calls["argv"]
+    # old attempt flag stripped, new one appended exactly once
+    assert argv.count("--retry-attempt=2") == 1
+    assert "--retry-attempt=1" not in argv
+    # the measurement flags survive verbatim
+    assert ["--model", "resnet50", "--batch-size", "128"] == [
+        a for a in argv if a in ("--model", "resnet50",
+                                 "--batch-size", "128")
+    ]
+
+
+def test_watchdog_disarmed_on_cpu(monkeypatch):
+    """--cpu runs must never arm the watchdog (dev machines may
+    legitimately take arbitrarily long)."""
+    import threading
+
+    started = []
+    monkeypatch.setattr(
+        threading, "Thread",
+        lambda *a, **k: started.append(1) or _FakeThread(),
+    )
+
+    class _Args:
+        cpu = True
+        watchdog_secs = 900
+        retry_attempt = 0
+        attempts = 4
+
+    bench._arm_watchdog(_Args())
+    assert not started
+
+
+class _FakeThread:
+    daemon = True
+
+    def start(self):
+        pass
