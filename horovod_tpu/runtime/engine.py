@@ -777,9 +777,14 @@ class EagerEngine:
             if len(flats) > 1:
                 try:
                     buf = jnp.concatenate(flats)
-                except ValueError:
-                    # entries committed to different local chips: fuse on
-                    # the plane's anchor (chip-to-chip moves, no host)
+                except Exception:
+                    # Entries committed to different local chips cannot
+                    # be concatenated in place; the failure surfaces as
+                    # ValueError or XlaRuntimeError depending on JAX
+                    # version, so any concat failure falls back to fusing
+                    # on the plane's anchor (chip-to-chip moves, no host
+                    # round-trip).  A non-device failure fails the
+                    # re-stage too and propagates from there.
                     anchor = self._plane().device
                     buf = jnp.concatenate(
                         [jax.device_put(f, anchor) for f in flats]

@@ -13,8 +13,25 @@ run() {
     local label="$1"; shift
     echo "== $label: bench.py $* ==" >&2
     local line
-    line=$(timeout 2400 python bench.py "$@" 2>/dev/null | tail -1)
-    if [ -n "$line" ]; then
+    # bench.py bounds its own wall-clock (--total-budget-secs, default
+    # 1440s across all retries); the outer timeout is a strictly larger
+    # backstop so the sweep never kills bench mid-retry and records null
+    # for a config that would have recovered.
+    line=$(timeout 1800 python bench.py --total-budget-secs 1440 "$@" \
+           2>/dev/null | tail -1)
+    # Validate before embedding: a non-JSON last stdout line (a traceback
+    # tail, a stray print) must not corrupt the results file.
+    if [ -n "$line" ] && python - "$line" <<'EOF' 2>/dev/null
+import json, sys
+# A real bench result is a JSON OBJECT; reject bare scalars (a stray
+# numeric line) and NaN/Infinity (json.loads accepts them but they
+# corrupt the strict-JSON results file).
+def _no_const(c):
+    raise ValueError(c)
+v = json.loads(sys.argv[1], parse_constant=_no_const)
+assert isinstance(v, dict)
+EOF
+    then
         echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT"
         echo "$line" >&2
     else

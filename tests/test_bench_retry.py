@@ -23,6 +23,16 @@ def test_is_unavailable_matches_tunnel_signatures():
     assert not bench._is_unavailable(KeyboardInterrupt())
 
 
+class _Args:
+    """Minimal stand-in for the parsed-argparse namespace."""
+
+    cpu = False
+    watchdog_secs = 780
+    retry_attempt = 1
+    attempts = 4
+    deadline_epoch = 0.0
+
+
 def test_reexec_rebuilds_argv_with_incremented_attempt(monkeypatch):
     calls = {}
 
@@ -34,19 +44,75 @@ def test_reexec_rebuilds_argv_with_incremented_attempt(monkeypatch):
     monkeypatch.setattr(
         bench.sys, "argv",
         ["bench.py", "--model", "resnet50", "--batch-size", "128",
-         "--retry-attempt=1"],
+         "--retry-attempt=1", "--deadline-epoch=123.0"],
     )
+    args = _Args()
+    args.deadline_epoch = 456.0
     with pytest.raises(SystemExit):
-        bench._reexec_next_attempt(1)
+        bench._reexec_next_attempt(args)
     argv = calls["argv"]
     # old attempt flag stripped, new one appended exactly once
     assert argv.count("--retry-attempt=2") == 1
     assert "--retry-attempt=1" not in argv
+    # the deadline is carried forward (re-minted ones would reset the
+    # total budget every re-exec — the exact bug that cost BENCH_r04)
+    assert argv.count("--deadline-epoch=456.0") == 1
+    assert "--deadline-epoch=123.0" not in argv
     # the measurement flags survive verbatim
     assert ["--model", "resnet50", "--batch-size", "128"] == [
         a for a in argv if a in ("--model", "resnet50",
                                  "--batch-size", "128")
     ]
+
+
+def test_give_up_when_budget_exhausted(monkeypatch):
+    """With retries left but <180s of total budget, the machinery must
+    exit 86 promptly instead of re-execing into a doomed cold compile
+    (the driver then records a clean rc, not an outer-timeout rc=124)."""
+    import time as _time
+
+    rc = {}
+    monkeypatch.setattr(bench.os, "_exit", lambda c: rc.setdefault("rc", c))
+    monkeypatch.setattr(
+        bench.os, "execv",
+        lambda *a: pytest.fail("must not re-exec with no budget"),
+    )
+    args = _Args()
+    args.deadline_epoch = _time.time() + 60  # < 180s left
+    bench._give_up_or_retry(args, "watchdog: test")
+    assert rc["rc"] == 86
+
+
+def test_retry_when_budget_remains(monkeypatch):
+    calls = {}
+
+    def fake_execv(exe, argv):
+        calls["argv"] = argv
+        raise SystemExit(0)
+
+    import time as _time
+
+    monkeypatch.setattr(bench.os, "execv", fake_execv)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    args = _Args()
+    args.deadline_epoch = _time.time() + 1000
+    with pytest.raises(SystemExit):
+        bench._give_up_or_retry(args, "axon UNAVAILABLE")
+    assert any(a == "--retry-attempt=2" for a in calls["argv"])
+
+
+def test_compile_cache_configured():
+    """The persistent compilation cache must point inside the repo so
+    driver re-runs and future rounds reuse warmed executables."""
+    import jax
+
+    if not hasattr(jax.config, "jax_compilation_cache_dir"):
+        pytest.skip("this JAX has no persistent compilation cache "
+                    "(bench degrades gracefully by design)")
+    assert jax.config.jax_compilation_cache_dir == bench._CACHE_DIR
+    assert bench._CACHE_DIR.startswith(
+        bench.os.path.dirname(bench.os.path.abspath(bench.__file__))
+    )
 
 
 def test_watchdog_disarmed_on_cpu(monkeypatch):
@@ -60,13 +126,9 @@ def test_watchdog_disarmed_on_cpu(monkeypatch):
         lambda *a, **k: started.append(1) or _FakeThread(),
     )
 
-    class _Args:
-        cpu = True
-        watchdog_secs = 900
-        retry_attempt = 0
-        attempts = 4
-
-    bench._arm_watchdog(_Args())
+    args = _Args()
+    args.cpu = True
+    bench._arm_watchdog(args)
     assert not started
 
 
