@@ -367,11 +367,16 @@ def _arm_watchdog(args) -> None:
             time.sleep(15)
             if _watchdog_disarm.is_set():
                 return
-            window = min(_phase_window, max(_budget_left(args), 30))
-            if time.monotonic() - _last_progress <= window:
+            if _budget_left(args) <= 0:
+                _give_up_or_retry(args, "watchdog: total budget exhausted")
+            # Phase-elapsed vs the phase's OWN window only — clamping the
+            # window to remaining budget would kill a still-progressing
+            # compile that fits both its window and the budget.
+            if time.monotonic() - _last_progress <= _phase_window:
                 continue
             _give_up_or_retry(
-                args, f"watchdog: no phase progress in {window:.0f}s")
+                args,
+                f"watchdog: no phase progress in {_phase_window:.0f}s")
 
     threading.Thread(target=_fire, daemon=True).start()
 

@@ -60,15 +60,19 @@ class DevicePlane:
     so "row r" and "engine rank r" coincide by construction.
 
     A process owning k>1 chips (the standard TPU-VM host topology: one
-    process, 4 chips) gets a 2-D ``(world, k)`` mesh: fused allreduce
-    payloads are split into k chunks fanned across the local chips, each
-    chunk psum-reduced across processes in parallel (every chip's ICI
-    links carry 1/k of the bytes), then re-gathered over the local axis —
-    the reference's LOCAL communicator tier (common.h:111-115,
-    mpi/mpi_context.cc) expressed as mesh axes instead of nested
-    communicators.  Row-shaped collectives (allgather/broadcast/alltoall/
-    reducescatter keep rank-indexed row semantics) run on the anchor-device
-    row mesh; results commit back to the caller's device either way.
+    process, 4 chips) gets a 2-D ``(world, k)`` mesh: every fused/eager
+    payload is split into k chunks fanned across the local chips so each
+    chip's ICI links carry 1/k of the cross-host bytes — the reference's
+    LOCAL communicator tier (common.h:111-115, mpi/mpi_context.cc,
+    MPIHierarchicalAllgather in mpi_operations.cc) expressed as mesh axes
+    instead of nested communicators.  Allreduce: per-chunk cross psum +
+    local all_gather.  Allgather: per-chunk cross all_gather + local
+    reassembly.  Broadcast: per-chunk masked psum + local reassembly.
+    Reducescatter: per-rank-block sub-chunks psum_scattered cross-host +
+    local reassembly.  Alltoall: per-sub-chunk cross all_to_all + local
+    reassembly.  The local reassembly all_gathers ride intra-host ICI,
+    which is the cheap direction.  Results commit back to the caller's
+    device either way.
     """
 
     def __init__(self) -> None:
@@ -168,24 +172,27 @@ class DevicePlane:
 
     # ------------------------------------------- sharded (multi-chip) path
 
+    def _commit_chunks(self, per_chip, shape: Tuple[int, ...]) -> jax.Array:
+        """Commit chunk j to local chip j and assemble the global array on
+        the 2-D mesh.  All movement is chip-to-chip device_put — no host."""
+        rows = [
+            jax.device_put(per_chip[j][None, None], self.local_devices[j])
+            for j in range(self.n_local)
+        ]
+        sharding = NamedSharding(self.mesh2d, P(PROC_AXIS, LOCAL_AXIS))
+        return jax.make_array_from_single_device_arrays(shape, sharding, rows)
+
     def _stage_sharded(self, flat: jax.Array) -> jax.Array:
         """Split a 1-D buffer into n_local chunks, chunk j committed to
         local chip j; returns the (world, k, m) global array sharded over
-        the 2-D mesh.  All movement is chip-to-chip device_put — no host."""
+        the 2-D mesh."""
         k = self.n_local
         n = int(flat.shape[0])
         m = -(-n // k)
         if m * k != n:
             flat = jnp.pad(flat, (0, m * k - n))
         resh = flat.reshape(k, m)
-        rows = [
-            jax.device_put(resh[j][None, None], self.local_devices[j])
-            for j in range(k)
-        ]
-        sharding = NamedSharding(self.mesh2d, P(PROC_AXIS, LOCAL_AXIS))
-        return jax.make_array_from_single_device_arrays(
-            (self.world, k, m), sharding, rows
-        )
+        return self._commit_chunks(resh, (self.world, k, m))
 
     @functools.lru_cache(maxsize=256)
     def _allreduce_sharded_fn(self, reduce_op: int, pre: float, post: float,
@@ -222,6 +229,20 @@ class DevicePlane:
             ),
             donate_argnums=(0,),
         )
+
+    def _stage_sharded_blocks(self, flat: jax.Array, blocks: int) -> jax.Array:
+        """Like ``_stage_sharded`` but the 1-D buffer is ``blocks`` equal
+        rank-blocks whose boundaries must be preserved: each block is split
+        into n_local sub-chunks, sub-chunk j of every block committed to
+        local chip j.  Returns the (world, k, blocks, mb) global array."""
+        k = self.n_local
+        b = int(flat.shape[0]) // blocks
+        mb = -(-b // k)
+        resh = flat.reshape(blocks, b)
+        if mb * k != b:
+            resh = jnp.pad(resh, ((0, 0), (0, mb * k - b)))
+        resh = jnp.transpose(resh.reshape(blocks, k, mb), (1, 0, 2))
+        return self._commit_chunks(resh, (self.world, k, blocks, mb))
 
     def allreduce(self, flat: jax.Array, reduce_op: int, pre: float,
                   post: float, acc_dtype: str, exact_int_avg: bool) -> jax.Array:
@@ -263,8 +284,37 @@ class DevicePlane:
             donate_argnums=(0,),
         )
 
+    @functools.lru_cache(maxsize=64)
+    def _allgather_sharded_fn(self):
+        """Hierarchical allgather (ref MPIHierarchicalAllgather,
+        mpi_operations.cc): each chip cross-gathers its 1/k element-chunk
+        of every rank's buffer, then the k chunks reassemble over the
+        local axis — every chip's cross-host ICI carries world*n/k bytes
+        instead of one chip carrying world*n."""
+        def f(x):  # x: (1, 1, m) — this chip's element-chunk of this rank
+            rows = lax.all_gather(x[0, 0], PROC_AXIS)        # (world, m)
+            full = lax.all_gather(rows, LOCAL_AXIS, axis=1)  # (world, k, m)
+            return full.reshape(full.shape[0], -1)           # (world, k*m)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh2d,
+                in_specs=P(PROC_AXIS, LOCAL_AXIS), out_specs=P(),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
     def allgather(self, local: jax.Array) -> jax.Array:
         """(world, *local.shape) on this plane's device (rows = ranks)."""
+        n = int(local.size)
+        if self.mesh2d is not None and n > 0:
+            out = self._local(
+                self._allgather_sharded_fn()(
+                    self._stage_sharded(jnp.ravel(local))
+                )
+            )
+            return out[:, :n].reshape((self.world,) + tuple(local.shape))
         return self._local(self._allgather_fn()(self.stage(local)))
 
     @functools.lru_cache(maxsize=64)
@@ -285,11 +335,39 @@ class DevicePlane:
             donate_argnums=(0,),
         )
 
+    @functools.lru_cache(maxsize=64)
+    def _broadcast_sharded_fn(self, root: int, wire: str):
+        """Hierarchical broadcast: each chip psums its masked 1/k chunk
+        cross-host, then the chunks reassemble over the local axis."""
+        def f(x):  # x: (1, 1, m)
+            v = x[0, 0]
+            mask = (lax.axis_index(PROC_AXIS) == root)
+            contrib = jnp.where(mask, v, jnp.zeros_like(v))
+            chunk = lax.psum(contrib, PROC_AXIS).astype(wire)    # (m,)
+            return lax.all_gather(chunk, LOCAL_AXIS).reshape(-1)  # (k*m,)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh2d,
+                in_specs=P(PROC_AXIS, LOCAL_AXIS), out_specs=P(),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
     def broadcast(self, local: jax.Array, root: int) -> jax.Array:
         if local.dtype == jnp.bool_:
             # psum over bool is invalid; ride uint8
             out = self.broadcast(local.astype(jnp.uint8), root)
             return self._cast(out, jnp.bool_)
+        n = int(local.size)
+        if self.mesh2d is not None and n > 0:
+            out = self._local(
+                self._broadcast_sharded_fn(int(root), str(local.dtype))(
+                    self._stage_sharded(jnp.ravel(local))
+                )
+            )
+            return out[:n].reshape(tuple(local.shape))
         return self._local(
             self._broadcast_fn(root, str(local.dtype))(self.stage(local))
         )
@@ -321,9 +399,48 @@ class DevicePlane:
             donate_argnums=(0,),
         )
 
+    @functools.lru_cache(maxsize=64)
+    def _reducescatter_sharded_fn(self, average: bool, pre: float,
+                                  post: float, wire: str, acc: str):
+        """Hierarchical reduce-scatter: each chip psum_scatters its 1/k
+        sub-chunk of every rank-block cross-host (so each chip ends with
+        sub-chunk j of THIS rank's reduced block), then the k sub-chunks
+        reassemble over the local axis."""
+        def f(x):  # x: (1, 1, world, mb) — sub-chunk j of every rank-block
+            v = x[0, 0].astype(acc)
+            if pre != 1.0:
+                v = (v * pre).astype(wire).astype(acc)
+            chunk = lax.psum_scatter(v, PROC_AXIS, scatter_dimension=0)
+            if average:
+                chunk = chunk / self.world
+            if post != 1.0:
+                chunk = chunk * post
+            full = lax.all_gather(chunk.astype(wire), LOCAL_AXIS)  # (k, mb)
+            return full.reshape(-1)[None]  # (1, k*mb)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh2d,
+                in_specs=P(PROC_AXIS, LOCAL_AXIS),
+                out_specs=P(PROC_AXIS), check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
     def reducescatter(self, local: jax.Array, average: bool, pre: float,
                       post: float, acc_dtype: str) -> jax.Array:
         """Even-dim0 reduce-scatter; returns this rank's chunk."""
+        if self.mesh2d is not None and int(local.size) > 0:
+            b = int(local.size) // self.world
+            out = self._local(
+                self._reducescatter_sharded_fn(
+                    average, pre, post, str(local.dtype), acc_dtype
+                )(self._stage_sharded_blocks(jnp.ravel(local), self.world))
+            )[0]
+            return out[:b].reshape(
+                (int(local.shape[0]) // self.world,)
+                + tuple(local.shape[1:])
+            )
         fn = self._reducescatter_fn(
             average, pre, post, str(local.dtype), acc_dtype
         )
@@ -348,7 +465,36 @@ class DevicePlane:
             donate_argnums=(0,),
         )
 
+    @functools.lru_cache(maxsize=64)
+    def _alltoall_sharded_fn(self):
+        """Hierarchical alltoall: each chip all_to_alls its 1/k sub-chunk
+        of every destination block cross-host, then the k sub-chunks
+        reassemble over the local axis."""
+        def f(x):  # x: (1, 1, world, mb)
+            v = x[0, 0]
+            out = lax.all_to_all(v, PROC_AXIS, split_axis=0,
+                                 concat_axis=0, tiled=False)  # (world, mb)
+            full = lax.all_gather(out, LOCAL_AXIS, axis=1)  # (world, k, mb)
+            return full.reshape(full.shape[0], -1)[None]  # (1, world, k*mb)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=self.mesh2d,
+                in_specs=P(PROC_AXIS, LOCAL_AXIS),
+                out_specs=P(PROC_AXIS), check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
     def alltoall(self, local: jax.Array) -> jax.Array:
+        if self.mesh2d is not None and int(local.size) > 0:
+            b = int(local.size) // self.world
+            rows = self._local(
+                self._alltoall_sharded_fn()(
+                    self._stage_sharded_blocks(jnp.ravel(local), self.world)
+                )
+            )[0]  # (world, k*mb): row i = rank i's block for this rank
+            return rows[:, :b].reshape(tuple(local.shape))
         out = self._alltoall_fn()(self.stage(local))
         return self._local(out)[0]
 

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Wait for the axon TPU tunnel to come back, then run the full hardware
+# measurement sweep (scripts/hw_sweep.sh) unattended.  The probe is cheap
+# (one jax.devices() with a hard timeout) so a multi-hour outage costs
+# nothing but probes; the first successful probe triggers the sweep.
+#
+#   scripts/tunnel_watch.sh [results_file]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/hw_sweep_results.jsonl}"
+while true; do
+    # The platform check matters: a failed TPU init can fall back to the
+    # CPU backend, which would "succeed" instantly mid-outage and launch
+    # the sweep against no hardware.
+    if timeout 240 python -c \
+            "import jax; assert jax.devices()[0].platform != 'cpu'" \
+            >/dev/null 2>&1; then
+        echo "# tunnel up at $(date -u +%FT%TZ); starting sweep" >&2
+        bash scripts/hw_sweep.sh "$OUT"
+        exit 0
+    fi
+    echo "# tunnel down at $(date -u +%FT%TZ); next probe in 300s" >&2
+    sleep 300
+done
