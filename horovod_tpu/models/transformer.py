@@ -156,57 +156,141 @@ def _attend(cfg: TransformerConfig, q, k, v, positions):
     )
 
 
+def block_math(cfg: TransformerConfig, x, positions, rope_tabs, *,
+               ln1, qkv, proj, ln2, mlp,
+               num_heads: Optional[int] = None,
+               num_kv_heads: Optional[int] = None):
+    """THE pre-LN transformer block wiring — the single source of truth.
+
+    ``LN → qkv → split-heads → rope → attend → proj(+res) → LN →
+    mlp(+res)``, shared by the flax :class:`Block`, the raw-weights
+    pipeline-parallel block (:func:`raw_block_forward`), and the
+    Megatron tensor-parallel block (``parallel/tensor_parallel.py``) so
+    a change to the block (a bias flag, a norm variant, the head
+    split) is made exactly once.
+
+    Callers supply the five parameterized layer applications as
+    callables (flax modules, raw-weight closures, or psum-rejoined
+    tensor-parallel closures); ``proj`` and ``mlp`` return the residual
+    DELTA (this function adds it to the stream).  ``num_heads`` /
+    ``num_kv_heads`` override the config's head counts for callers
+    operating on a per-rank head shard (TP).
+    """
+    b, s, _ = x.shape
+    nh = num_heads if num_heads is not None else cfg.num_heads
+    nkv = num_kv_heads if num_kv_heads is not None else cfg.kv_heads
+    hd = cfg.head_dim
+    q_dim = nh * hd
+    kv_dim = nkv * hd
+
+    h = ln1(x)
+    fused = qkv(h)
+    q = fused[..., :q_dim].reshape(b, s, nh, hd)
+    k = fused[..., q_dim:q_dim + kv_dim].reshape(b, s, nkv, hd)
+    v = fused[..., q_dim + kv_dim:].reshape(b, s, nkv, hd)
+    if rope_tabs is not None:
+        from ..ops.rope import apply_rope_tables  # noqa: PLC0415
+
+        q = apply_rope_tables(q, *rope_tabs)
+        k = apply_rope_tables(k, *rope_tabs)
+    attend_cfg = cfg
+    if nh != cfg.num_heads or nkv != cfg.kv_heads:
+        # per-rank head shard: _attend must see the LOCAL head geometry
+        attend_cfg = replace(cfg, num_heads=nh, num_kv_heads=nkv,
+                             emb_dim=q_dim)
+    att = _attend(attend_cfg, q, k, v, positions).reshape(b, s, q_dim)
+    x = x + proj(att)
+    return x + mlp(ln2(x))
+
+
+def raw_layer_norm(x, scale, bias, eps: float = 1e-6):
+    """LayerNorm from raw weights, fp32 math (matches flax's
+    ``nn.LayerNorm(dtype=jnp.float32)`` as the models use it)."""
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return y * scale + bias
+
+
+def raw_dense(sub, dtype):
+    """The dense-layer application from a raw ``{kernel, bias}`` subtree
+    in the given compute dtype — the one definition of "apply a Dense
+    from raw weights" shared by the pipeline and tensor-parallel block
+    closures."""
+    return lambda h: h.astype(dtype) @ sub["kernel"].astype(dtype) \
+        + sub["bias"].astype(dtype)
+
+
+def raw_block_forward(cfg: TransformerConfig, p, x, positions, rope_tabs):
+    """One dense transformer block from a raw ``Block`` weight subtree
+    ``p`` (keys ``ln1/qkv/proj/ln2/fc1/fc2``) — :func:`block_math` with
+    plain-matmul closures.  Used by the pipeline-parallel stage body
+    (``parallel/pipeline.py``); numerically equivalent to the flax
+    :class:`Block` (pinned by tests/test_pipeline.py)."""
+    dt = cfg.dtype
+
+    def mlp(h):
+        m = jax.nn.gelu(raw_dense(p["fc1"], dt)(h))
+        return raw_dense(p["fc2"], dt)(m)
+
+    return block_math(
+        cfg, x, positions, rope_tabs,
+        ln1=lambda h: raw_layer_norm(h, p["ln1"]["scale"], p["ln1"]["bias"]),
+        qkv=raw_dense(p["qkv"], dt),
+        proj=raw_dense(p["proj"], dt),
+        ln2=lambda h: raw_layer_norm(h, p["ln2"]["scale"], p["ln2"]["bias"]),
+        mlp=mlp,
+    )
+
+
 class Block(nn.Module):
-    """Pre-LN transformer block: LN → attn → +res, LN → MLP → +res."""
+    """Pre-LN transformer block: LN → attn → +res, LN → MLP → +res.
+
+    The wiring lives in :func:`block_math`; this module only declares
+    the flax parameters and hands their applications in as callables.
+    """
 
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, positions, rope_tabs=None):
         cfg = self.cfg
-        b, s, _ = x.shape
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         kv_dim = cfg.kv_heads * cfg.head_dim
-        qkv = nn.Dense(cfg.emb_dim + 2 * kv_dim, dtype=cfg.dtype,
-                       name="qkv")(h)
-        q = qkv[..., :cfg.emb_dim].reshape(b, s, cfg.num_heads, cfg.head_dim)
-        k = qkv[..., cfg.emb_dim:cfg.emb_dim + kv_dim].reshape(
-            b, s, cfg.kv_heads, cfg.head_dim
+
+        def mlp(h):
+            if cfg.moe_experts > 0:
+                from ..parallel.moe import (  # noqa: PLC0415
+                    moe_flax_params, moe_mlp,
+                )
+
+                moe_p = moe_flax_params(
+                    self, cfg.emb_dim, cfg.mlp_ratio * cfg.emb_dim,
+                    cfg.moe_experts,
+                )
+                y, aux = moe_mlp(
+                    h, moe_p, top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    group_size=cfg.moe_group_size, dtype=cfg.dtype,
+                )
+                self.sow("losses", "moe_aux", aux)
+                # y inherits ln2's fp32; keep the residual stream in the
+                # compute dtype like the dense-MLP path does
+                return y.astype(cfg.dtype)
+            m = nn.Dense(cfg.mlp_ratio * cfg.emb_dim, dtype=cfg.dtype,
+                         name="fc1")(h)
+            return nn.Dense(cfg.emb_dim, dtype=cfg.dtype,
+                            name="fc2")(nn.gelu(m))
+
+        return block_math(
+            cfg, x, positions, rope_tabs,
+            ln1=nn.LayerNorm(dtype=jnp.float32, name="ln1"),
+            qkv=nn.Dense(cfg.emb_dim + 2 * kv_dim, dtype=cfg.dtype,
+                         name="qkv"),
+            proj=nn.Dense(cfg.emb_dim, dtype=cfg.dtype, name="proj"),
+            ln2=nn.LayerNorm(dtype=jnp.float32, name="ln2"),
+            mlp=mlp,
         )
-        v = qkv[..., cfg.emb_dim + kv_dim:].reshape(
-            b, s, cfg.kv_heads, cfg.head_dim
-        )
-        if rope_tabs is not None:
-            from ..ops.rope import apply_rope_tables  # noqa: PLC0415
-
-            q = apply_rope_tables(q, *rope_tabs)
-            k = apply_rope_tables(k, *rope_tabs)
-        att = _attend(cfg, q, k, v, positions)
-        att = att.reshape(b, s, cfg.emb_dim)
-        x = x + nn.Dense(cfg.emb_dim, dtype=cfg.dtype, name="proj")(att)
-
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        if cfg.moe_experts > 0:
-            from ..parallel.moe import moe_flax_params, moe_mlp  # noqa: PLC0415
-
-            moe_p = moe_flax_params(
-                self, cfg.emb_dim, cfg.mlp_ratio * cfg.emb_dim,
-                cfg.moe_experts,
-            )
-            y, aux = moe_mlp(
-                h, moe_p, top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor,
-                group_size=cfg.moe_group_size, dtype=cfg.dtype,
-            )
-            self.sow("losses", "moe_aux", aux)
-            # y inherits ln2's fp32; keep the residual stream in the
-            # compute dtype like the dense-MLP path does
-            return x + y.astype(cfg.dtype)
-        h = nn.Dense(cfg.mlp_ratio * cfg.emb_dim, dtype=cfg.dtype,
-                     name="fc1")(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(cfg.emb_dim, dtype=cfg.dtype, name="fc2")(h)
-        return x
 
 
 class GPT(nn.Module):

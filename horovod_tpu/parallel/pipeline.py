@@ -65,39 +65,12 @@ def stack_pp_params(params, cfg, pp: int):
 
 
 def _dense_block(cfg, p, x, positions, rope_tabs):
-    """One transformer block from raw weights (mirrors models.Block)."""
-    from .tensor_parallel import _layer_norm  # noqa: PLC0415
-    from ..models.transformer import _attend  # noqa: PLC0415
+    """One transformer block from raw weights — the shared
+    ``models.transformer.block_math`` wiring via its raw-weights
+    entry point (single source of truth for the block forward)."""
+    from ..models.transformer import raw_block_forward  # noqa: PLC0415
 
-    b, s, _ = x.shape
-    dt = cfg.dtype
-    hn = _layer_norm(x, p["ln1"]["scale"], p["ln1"]["bias"])
-    qkv = hn.astype(dt) @ p["qkv"]["kernel"].astype(dt) \
-        + p["qkv"]["bias"].astype(dt)
-    kv_dim = cfg.kv_heads * cfg.head_dim
-    q = qkv[..., :cfg.emb_dim].reshape(
-        b, s, cfg.num_heads, cfg.head_dim
-    )
-    k = qkv[..., cfg.emb_dim:cfg.emb_dim + kv_dim].reshape(
-        b, s, cfg.kv_heads, cfg.head_dim
-    )
-    v = qkv[..., cfg.emb_dim + kv_dim:].reshape(
-        b, s, cfg.kv_heads, cfg.head_dim
-    )
-    if rope_tabs is not None:
-        from ..ops.rope import apply_rope_tables  # noqa: PLC0415
-
-        q = apply_rope_tables(q, *rope_tabs)
-        k = apply_rope_tables(k, *rope_tabs)
-    att = _attend(cfg, q, k, v, positions).reshape(b, s, cfg.emb_dim)
-    x = x + att.astype(dt) @ p["proj"]["kernel"].astype(dt) \
-        + p["proj"]["bias"].astype(dt)
-    hn = _layer_norm(x, p["ln2"]["scale"], p["ln2"]["bias"])
-    m = hn.astype(dt) @ p["fc1"]["kernel"].astype(dt) \
-        + p["fc1"]["bias"].astype(dt)
-    m = jax.nn.gelu(m)
-    return x + m @ p["fc2"]["kernel"].astype(dt) \
-        + p["fc2"]["bias"].astype(dt)
+    return raw_block_forward(cfg, p, x, positions, rope_tabs)
 
 
 def pp_gpt_apply(staged_params, replicated_params, cfg, tokens,

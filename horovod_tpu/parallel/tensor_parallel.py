@@ -129,12 +129,6 @@ def stack_tp_params(params, cfg, tp: int):
     return to_jnp(sharded), to_jnp(replicated)
 
 
-def _layer_norm(x, scale, bias):
-    x32 = x.astype(jnp.float32)
-    mu = x32.mean(-1, keepdims=True)
-    var = x32.var(-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-6)
-    return y * scale + bias
 
 
 def _gpt_embed(rep, cfg, tokens, pos_offset, positions):
@@ -168,51 +162,47 @@ def _gpt_embed(rep, cfg, tokens, pos_offset, positions):
 
 def _gpt_head(rep, cfg, x):
     """Shared replicated epilogue: final LN + LM head, fp32 logits."""
-    x = _layer_norm(x, rep["lnf"]["scale"], rep["lnf"]["bias"])
+    from ..models.transformer import raw_layer_norm  # noqa: PLC0415
+
+    x = raw_layer_norm(x, rep["lnf"]["scale"], rep["lnf"]["bias"])
     logits = x.astype(cfg.dtype) @ rep["head"]["kernel"].astype(cfg.dtype)
     return logits.astype(jnp.float32)
 
 
 def _tp_block(cfg, p, rep, x, positions, rope_tabs, tp_axis, tp):
-    """One transformer block on this rank's head/width shard; two psums."""
-    from ..models.transformer import _attend  # noqa: PLC0415
+    """One transformer block on this rank's head/width shard: the shared
+    ``block_math`` wiring with column-parallel qkv/fc1 and row-parallel
+    proj/fc2 closures — each row-parallel matmul rejoined by one psum,
+    its bias applied once after (the bias lives on the replicated
+    tree)."""
+    from ..models.transformer import (  # noqa: PLC0415
+        block_math, raw_dense, raw_layer_norm,
+    )
 
-    b, s, _ = x.shape
-    h_local = cfg.num_heads // tp
-    hkv_local = cfg.kv_heads // tp
-    hd = cfg.head_dim
     dt = cfg.dtype
 
-    hn = _layer_norm(x, rep["ln1"]["scale"], rep["ln1"]["bias"])
-    qkv = hn.astype(dt) @ p["qkv"]["kernel"].astype(dt) \
-        + p["qkv"]["bias"].astype(dt)
-    q_dim = h_local * hd
-    kv_dim = hkv_local * hd
-    q = qkv[..., :q_dim].reshape(b, s, h_local, hd)
-    k = qkv[..., q_dim:q_dim + kv_dim].reshape(b, s, hkv_local, hd)
-    v = qkv[..., q_dim + kv_dim:].reshape(b, s, hkv_local, hd)
-    if rope_tabs is not None:
-        from ..ops.rope import apply_rope_tables  # noqa: PLC0415
+    def row(kernel, bias):  # row-parallel: psum rejoin, then the bias
+        return lambda h: lax.psum(
+            h.astype(dt) @ kernel.astype(dt), tp_axis
+        ) + bias.astype(dt)
 
-        q = apply_rope_tables(q, *rope_tabs)
-        k = apply_rope_tables(k, *rope_tabs)
-    from dataclasses import replace  # noqa: PLC0415
+    def mlp(h):
+        return row(p["fc2"]["kernel"], rep["fc2_bias"])(
+            jax.nn.gelu(raw_dense(p["fc1"], dt)(h))
+        )
 
-    # emb_dim only feeds head_dim below this point; keep it consistent
-    local_cfg = replace(cfg, num_heads=h_local, num_kv_heads=hkv_local,
-                        emb_dim=h_local * hd)
-    att = _attend(local_cfg, q, k, v, positions).reshape(b, s, q_dim)
-    y = att.astype(dt) @ p["proj"]["kernel"].astype(dt)
-    y = lax.psum(y, tp_axis) + rep["proj_bias"].astype(dt)
-    x = x + y
-
-    hn = _layer_norm(x, rep["ln2"]["scale"], rep["ln2"]["bias"])
-    m = hn.astype(dt) @ p["fc1"]["kernel"].astype(dt) \
-        + p["fc1"]["bias"].astype(dt)
-    m = jax.nn.gelu(m)
-    m = m @ p["fc2"]["kernel"].astype(dt)
-    m = lax.psum(m, tp_axis) + rep["fc2_bias"].astype(dt)
-    return x + m
+    return block_math(
+        cfg, x, positions, rope_tabs,
+        ln1=lambda h: raw_layer_norm(h, rep["ln1"]["scale"],
+                                     rep["ln1"]["bias"]),
+        qkv=raw_dense(p["qkv"], dt),
+        proj=row(p["proj"]["kernel"], rep["proj_bias"]),
+        ln2=lambda h: raw_layer_norm(h, rep["ln2"]["scale"],
+                                     rep["ln2"]["bias"]),
+        mlp=mlp,
+        num_heads=cfg.num_heads // tp,
+        num_kv_heads=cfg.kv_heads // tp,
+    )
 
 
 def tp_gpt_apply(sharded_params, replicated_params, cfg, tokens,

@@ -8,6 +8,13 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/hw_sweep_results.jsonl}"
+# A broken environment (no jax, wrong python) would fail every probe with
+# the same silence as a tunnel outage and loop forever; tell them apart
+# up front.
+python -c "import jax" || {
+    echo "# python environment cannot import jax; aborting" >&2
+    exit 1
+}
 while true; do
     # The platform check matters: a failed TPU init can fall back to the
     # CPU backend, which would "succeed" instantly mid-outage and launch
