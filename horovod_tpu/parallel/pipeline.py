@@ -111,15 +111,28 @@ def pp_gpt_apply(staged_params, replicated_params, cfg, tokens,
     fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
     n_ticks = microbatches + pp - 1
 
-    def _varying(v):
-        """Mark a replicated value device-varying so the scan carry's
-        type matches the ppermute outputs under replication tracking
-        (check_vma=True) — a no-op without it."""
+    # The scan carry must have the same varying-axes set as the tick
+    # outputs: pp_axis (the ppermute), every axis the activations vary
+    # over (e.g. a dp axis in a composed dp x pp mesh — tokens sharded
+    # over dp make every stage output dp-varying), and every axis the
+    # stage weights vary over.
+    _carry_axes = {pp_axis}
+    for ref_val in (mbs, *jax.tree_util.tree_leaves(local)[:1]):
         try:
-            return lax.pcast(v, pp_axis, to="varying")
+            _carry_axes |= set(jax.typeof(ref_val).vma)
+        except (AttributeError, TypeError):
+            pass
+    _carry_axes = tuple(sorted(_carry_axes))
+
+    def _varying(v):
+        """Mark a replicated value device-varying over the carry's axes
+        so the scan carry's type matches the tick outputs under
+        replication tracking (check_vma=True) — a no-op without it."""
+        try:
+            return lax.pcast(v, _carry_axes, to="varying")
         except (AttributeError, TypeError):  # older jax: pvary spelling
             try:
-                return lax.pvary(v, pp_axis)
+                return lax.pvary(v, _carry_axes)
             except (AttributeError, TypeError):
                 return v  # very old jax: no vma tracking to satisfy
 
