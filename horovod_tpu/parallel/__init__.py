@@ -21,6 +21,8 @@ from .moe import (  # noqa: F401
     moe_mlp,
     moe_mlp_ep,
 )
-from .pipeline import pp_gpt_apply, stack_pp_params  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pp_gpt_apply, pp_gpt_loss, stack_pp_params,
+)
 from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
 from .tensor_parallel import stack_tp_params, tp_gpt_apply  # noqa: F401

@@ -294,10 +294,13 @@ def _np8_fn():
             "elapsed": elapsed}
 
 
+@pytest.mark.serial
 def test_np8_poll_multiplexed_negotiation():
     """np=8 native world (7 workers feeding the rank-0 coordinator through
     the poll-multiplexed gather): 20 negotiation+data rounds complete
-    correctly and promptly (VERDICT r2 item 5)."""
+    correctly and promptly (VERDICT r2 item 5).  serial: the 60s
+    wall-clock bound below is a timing assertion — an oversubscribed
+    parallel pass could flake it."""
     results = hvdrun.run(_np8_fn, np=8, use_cpu=True, timeout=300, env=ENV)
     assert all(r["ok"] for r in results)
     # generous bound: catches gross serialization (the serial-recv

@@ -18,7 +18,9 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.models.transformer import gpt
-from horovod_tpu.parallel.pipeline import pp_gpt_apply, stack_pp_params
+from horovod_tpu.parallel.pipeline import (
+    pp_gpt_apply, pp_gpt_loss, stack_pp_params,
+)
 
 PP = 4
 AXIS = "pp"
@@ -115,6 +117,111 @@ def test_pp_gradients_match():
         np.asarray(g_pp["fc2"]["kernel"][3, 0]),
         np.asarray(g_ref["block3"]["fc2"]["kernel"]),
         atol=2e-4, rtol=2e-4,
+    )
+
+
+def _ref_token_loss(model, params, tokens, targets):
+    logits = model.apply(params, tokens)
+    return -jnp.take_along_axis(
+        jax.nn.log_softmax(logits), targets[..., None], -1
+    ).mean()
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_pp_loss_matches_single_device(remat):
+    """pp_gpt_loss (stage-local head, scalar rejoin) equals the
+    unsharded token loss — with and without per-tick remat."""
+    model = _model()
+    tokens = _tokens(2)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(2), tokens)
+    ref = _ref_token_loss(model, params, tokens, targets)
+    staged, replicated = stack_pp_params(params, model.cfg, PP)
+
+    def local(staged, replicated, tok, tgt):
+        return pp_gpt_loss(staged, replicated, model.cfg, tok, tgt, AXIS,
+                           microbatches=2, remat=remat)
+
+    loss = jax.jit(
+        shard_map(
+            local, mesh=_mesh(),
+            in_specs=(P(AXIS), P(), P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )(staged, replicated, tokens, targets)
+    np.testing.assert_allclose(
+        float(loss), float(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_pp_loss_gradients_match():
+    """Training-path gradients through pp_gpt_loss: staged-block grads
+    AND the replicated embed/head grads equal the unsharded model's
+    (the scalar-psum rejoin must transpose to the same pullbacks as the
+    full-logit broadcast)."""
+    model = _model()
+    tokens = _tokens(3)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(3), tokens)
+    g_ref = jax.grad(
+        lambda p: _ref_token_loss(model, p, tokens, targets)
+    )(params)["params"]
+    staged, replicated = stack_pp_params(params, model.cfg, PP)
+
+    def local_loss(staged, replicated, tok, tgt):
+        return pp_gpt_loss(staged, replicated, model.cfg, tok, tgt, AXIS,
+                           microbatches=2, remat=True)
+
+    grad_fn = jax.jit(
+        shard_map(
+            jax.grad(local_loss, argnums=(0, 1)), mesh=_mesh(),
+            in_specs=(P(AXIS), P(), P(), P()),
+            out_specs=(P(AXIS), P()),
+            check_vma=True,
+        )
+    )
+    g_staged, g_rep = grad_fn(staged, replicated, tokens, targets)
+    np.testing.assert_allclose(
+        np.asarray(g_staged["qkv"]["kernel"][0, 0]),
+        np.asarray(g_ref["block0"]["qkv"]["kernel"]),
+        atol=2e-4, rtol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_staged["fc2"]["kernel"][3, 0]),
+        np.asarray(g_ref["block3"]["fc2"]["kernel"]),
+        atol=2e-4, rtol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_rep["wte"]["embedding"]),
+        np.asarray(g_ref["wte"]["embedding"]),
+        atol=2e-4, rtol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_rep["head"]["kernel"]),
+        np.asarray(g_ref["head"]["kernel"]),
+        atol=2e-4, rtol=2e-4,
+    )
+
+
+def test_pp_apply_remat_matches():
+    """remat=True is numerically a no-op for the logits path."""
+    model = _model()
+    tokens = _tokens(4)
+    params = model.init(jax.random.PRNGKey(4), tokens)
+    staged, replicated = stack_pp_params(params, model.cfg, PP)
+
+    def run(remat):
+        def local(staged, replicated, tok):
+            return pp_gpt_apply(staged, replicated, model.cfg, tok, AXIS,
+                                microbatches=2, remat=remat)
+        return jax.jit(
+            shard_map(local, mesh=_mesh(),
+                      in_specs=(P(AXIS), P(), P()), out_specs=P(),
+                      check_vma=False)
+        )(staged, replicated, tokens)
+
+    np.testing.assert_allclose(
+        np.asarray(run(True)), np.asarray(run(False)), atol=1e-6
     )
 
 

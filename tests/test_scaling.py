@@ -21,7 +21,8 @@ import pytest
 import horovod_tpu.run as hvdrun
 from horovod_tpu.runtime.native import native_available
 
-pytestmark = [pytest.mark.multiprocess, pytest.mark.full]
+pytestmark = [pytest.mark.multiprocess, pytest.mark.full,
+              pytest.mark.serial]
 
 _BASELINE_PATH = os.path.join(os.path.dirname(__file__),
                               "scaling_baseline.json")
