@@ -43,8 +43,12 @@ def measure_ratio(trials: int) -> dict:
         "trials": [round(r, 3) for r in ratios],
         # the gate takes best-of-N live trials and fails below
         # band * np8_over_np2 (noise only DEPRESSES the ratio, so
-        # best-of-N vs a banded median is one-sided-safe)
-        "band": 0.5,
+        # best-of-N vs a banded median is one-sided-safe).  0.7 is the
+        # widest band whose threshold still sits ABOVE the 0.25 cliff
+        # floor for this host's measured ratio (~0.47 idle, 1-core):
+        # any tighter and the trend gate is inert; any looser flakes
+        # against the observed ±10% trial spread.
+        "band": 0.7,
         "note": "refresh with scripts/record_scaling_baseline.py on an "
                 "idle machine; gate = max(0.25, band * np8_over_np2)",
     }
