@@ -85,7 +85,7 @@ def peak_flops_per_chip(device, dtype: str) -> float:
 
 def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                    attention: str = "flash", remat: bool = False,
-                   flash_block_q: int = 128, flash_block_k: int = 128,
+                   flash_block_q: int = 512, flash_block_k: int = 256,
                    kv_heads: int = 0, pos_embedding: str = "learned",
                    moe_experts: int = 0):
     """GPT causal-LM training step (flash attention) — the long-context
@@ -402,8 +402,10 @@ def main() -> int:
     parser.add_argument("--remat", action="store_true",
                         help="remat transformer blocks (dots-saveable "
                         "policy): trades recompute for HBM -> larger batch")
-    parser.add_argument("--flash-block-q", type=int, default=128)
-    parser.add_argument("--flash-block-k", type=int, default=128)
+    parser.add_argument("--flash-block-q", type=int, default=512,
+                        help="flash attention q tile (measured winner on "
+                        "v5e: 512; docs/performance.md round-5 sweep)")
+    parser.add_argument("--flash-block-k", type=int, default=256)
     parser.add_argument("--kv-heads", type=int, default=0,
                         help="GQA/MQA kv heads for the gpt models "
                         "(0 = MHA)")

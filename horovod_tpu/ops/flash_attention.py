@@ -52,8 +52,8 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 256,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over ``[batch, seq, heads, head_dim]`` inputs.

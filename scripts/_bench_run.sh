@@ -1,0 +1,33 @@
+# Shared helper for the hardware sweep drivers (hw_sweep.sh,
+# hw_sweep2.sh): source this file, set OUT, then
+#
+#   run <label> <outer-timeout-secs> <bench-budget-secs> [bench args...]
+#
+# bench.py bounds its own wall-clock (--total-budget-secs across all
+# retries); the outer timeout must be strictly larger so the sweep never
+# kills bench mid-retry and records null for a config that would have
+# recovered.  Every result is validated before it is embedded: the last
+# stdout line must be a strict-JSON OBJECT (no bare scalars, no
+# NaN/Infinity) or the config records null — a traceback tail must not
+# corrupt the results file.
+run() {
+    local label="$1" tmo="$2" budget="$3"; shift 3
+    echo "== $label: bench.py $* ==" >&2
+    local line
+    line=$(timeout "$tmo" python bench.py --total-budget-secs "$budget" \
+           "$@" 2>/dev/null | tail -1)
+    if [ -n "$line" ] && python - "$line" <<'EOF' 2>/dev/null
+import json, sys
+def _no_const(c):
+    raise ValueError(c)
+v = json.loads(sys.argv[1], parse_constant=_no_const)
+assert isinstance(v, dict)
+EOF
+    then
+        echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT"
+        echo "$line" >&2
+    else
+        echo "{\"config\": \"$label\", \"result\": null}" >> "$OUT"
+        echo "(no result)" >&2
+    fi
+}

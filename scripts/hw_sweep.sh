@@ -9,58 +9,31 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/hw_sweep_results.jsonl}"
 
-run() {
-    local label="$1"; shift
-    echo "== $label: bench.py $* ==" >&2
-    local line
-    # bench.py bounds its own wall-clock (--total-budget-secs, default
-    # 1440s across all retries); the outer timeout is a strictly larger
-    # backstop so the sweep never kills bench mid-retry and records null
-    # for a config that would have recovered.
-    line=$(timeout 1800 python bench.py --total-budget-secs 1440 "$@" \
-           2>/dev/null | tail -1)
-    # Validate before embedding: a non-JSON last stdout line (a traceback
-    # tail, a stray print) must not corrupt the results file.
-    if [ -n "$line" ] && python - "$line" <<'EOF' 2>/dev/null
-import json, sys
-# A real bench result is a JSON OBJECT; reject bare scalars (a stray
-# numeric line) and NaN/Infinity (json.loads accepts them but they
-# corrupt the strict-JSON results file).
-def _no_const(c):
-    raise ValueError(c)
-v = json.loads(sys.argv[1], parse_constant=_no_const)
-assert isinstance(v, dict)
-EOF
-    then
-        echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT"
-        echo "$line" >&2
-    else
-        echo "{\"config\": \"$label\", \"result\": null}" >> "$OUT"
-        echo "(no result)" >&2
-    fi
-}
+# run <label> <outer-timeout> <bench-budget> [bench args...] — shared
+# with hw_sweep2.sh (timeout/validation semantics documented there)
+. "$(dirname "$0")/_bench_run.sh"
 
 # 1. the headline record (VERDICT r3 item 1): expect ~2660 img/s bf16
 #    (batch 128 is the measured sweet spot — performance.md "Knobs tried")
-run resnet50_bf16_b128
+run resnet50_bf16_b128 1800 1440
 # 2. first real-chip GPT number (VERDICT r3 item 2)
-run gpt_small_base --model gpt-small
+run gpt_small_base 1800 1440 --model gpt-small --flash-block-q 128 --flash-block-k 128
 # 3. the round-4 levers, one at a time
-run gpt_small_remat --model gpt-small --remat
-run gpt_small_remat_b16 --model gpt-small --remat --batch-size 16
-run gpt_small_blocks256 --model gpt-small --flash-block-q 256 --flash-block-k 256
-run gpt_small_blocks512q --model gpt-small --flash-block-q 512 --flash-block-k 256
-run gpt_small_gqa4 --model gpt-small --kv-heads 4
-run gpt_small_rope --model gpt-small --pos-embedding rope
-run gpt_small_rope_gqa_remat --model gpt-small --pos-embedding rope --kv-heads 4 --remat --batch-size 16
+run gpt_small_remat 1800 1440 --model gpt-small --remat --flash-block-q 128 --flash-block-k 128
+run gpt_small_remat_b16 1800 1440 --model gpt-small --remat --batch-size 16 --flash-block-q 128 --flash-block-k 128
+run gpt_small_blocks256 1800 1440 --model gpt-small --flash-block-q 256 --flash-block-k 256
+run gpt_small_blocks512q 1800 1440 --model gpt-small --flash-block-q 512 --flash-block-k 256
+run gpt_small_gqa4 1800 1440 --model gpt-small --kv-heads 4 --flash-block-q 128 --flash-block-k 128
+run gpt_small_rope 1800 1440 --model gpt-small --pos-embedding rope --flash-block-q 128 --flash-block-k 128
+run gpt_small_rope_gqa_remat 1800 1440 --model gpt-small --pos-embedding rope --kv-heads 4 --remat --batch-size 16
 # 4. the other headline families (docs/benchmarks.md)
-run inception3_bf16 --model inception3 --batch-size 128
-run vgg16_bf16 --model vgg16 --batch-size 64
+run inception3_bf16 1800 1440 --model inception3 --batch-size 128
+run vgg16_bf16 1800 1440 --model vgg16 --batch-size 64
 # 5. fp8-vs-bf16 replication (VERDICT r4 weak #2): 3-run medians in one
 #    session; repeats are cache-warmed so each costs ~1 min of chip time
-run resnet50_bf16_rep2
-run resnet50_bf16_rep3
-run resnet50_fp8_rep1 --dtype fp8
-run resnet50_fp8_rep2 --dtype fp8
-run resnet50_fp8_rep3 --dtype fp8
+run resnet50_bf16_rep2 1800 1440
+run resnet50_bf16_rep3 1800 1440
+run resnet50_fp8_rep1 1800 1440 --dtype fp8
+run resnet50_fp8_rep2 1800 1440 --dtype fp8
+run resnet50_fp8_rep3 1800 1440 --dtype fp8
 echo "sweep complete -> $OUT" >&2

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Hardware sweep, part 2 — the configs the first tunnel window didn't
+# reach (the outage killed hw_sweep.sh at gpt_small_rope) plus the
+# follow-ups the part-1 results motivated: flash-block sizes were the
+# dominant lever (128->512q: +69% tokens/sec), so push that axis further
+# and retry the two GQA configs with a wider compile window (the kv-heads
+# compile burned its whole 1440s budget in part 1).
+#
+#   scripts/hw_sweep2.sh [results_file]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/hw_sweep2_results.jsonl}"
+
+. "$(dirname "$0")/_bench_run.sh"
+
+# 1. the must-land records first: bf16 3-run median completion + the fp8
+#    replication (VERDICT r5 task 8).  resnet executables are already in
+#    .jax_cache, so the bf16 reps cost ~2 min each.
+run resnet50_bf16_rep2 1800 1440
+run resnet50_bf16_rep3 1800 1440
+run resnet50_fp8_rep1 1800 1440 --dtype fp8
+run resnet50_fp8_rep2 1800 1440 --dtype fp8
+run resnet50_fp8_rep3 1800 1440 --dtype fp8
+# 2. the other headline conv families (docs/benchmarks.md)
+run inception3_bf16 1800 1440 --model inception3 --batch-size 128
+run vgg16_bf16 1800 1440 --model vgg16 --batch-size 64
+# 3. part-1 stragglers
+run gpt_small_rope 1800 1440 --model gpt-small --pos-embedding rope
+# 4. flash-block follow-ups (the big lever: 0.193 -> 0.325 MFU in part 1)
+run gpt_small_blocks512x512 1800 1440 --model gpt-small --flash-block-q 512 --flash-block-k 512
+run gpt_small_blocks1024q 1800 1440 --model gpt-small --flash-block-q 1024 --flash-block-k 256
+run gpt_small_blocks512q_b16 1800 1440 --model gpt-small --flash-block-q 512 --flash-block-k 256 --batch-size 16
+run gpt_small_ref_attn 1800 1440 --model gpt-small --attention reference
+# 5. GQA retries with a wide compile window (part-1 failure mode: compile
+#    alone outlived the 780s watchdog AND the 1440s budget)
+run gpt_small_gqa4 3000 2700 --model gpt-small --kv-heads 4 --watchdog-secs 2400
+run gpt_small_rope_gqa_remat 3000 2700 --model gpt-small --pos-embedding rope --kv-heads 4 --remat --batch-size 16 --watchdog-secs 2400
+# 6. scale-up: medium at the best small-model blocks
+run gpt_medium_blocks512q 3000 2700 --model gpt-medium --flash-block-q 512 --flash-block-k 256 --watchdog-secs 2400
+run gpt_small_moe8 3000 2700 --model gpt-small --moe-experts 8 --watchdog-secs 2400
+echo "sweep2 complete -> $OUT" >&2
