@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["stack_pp_params", "pp_gpt_apply", "pp_gpt_loss"]
+__all__ = ["stack_pp_params", "stack_pp_params_circular",
+           "pp_gpt_apply", "pp_gpt_loss", "pp_gpt_loss_circular"]
 
 
 def stack_pp_params(params, cfg, pp: int):
@@ -64,6 +65,40 @@ def stack_pp_params(params, cfg, pp: int):
     return staged, replicated
 
 
+def stack_pp_params_circular(params, cfg, pp: int, circles: int):
+    """Restack for the circular schedule: device ``s`` holds the
+    ``circles`` non-contiguous layer groups ``{s, s+pp, ..}`` —
+    ``staged`` leaves get leading dims ``[pp, circles, layers_per_group,
+    ...]`` (group ``v*pp + s`` at ``staged[s, v]``), so the microbatch
+    stream can wrap through every device ``circles`` times
+    (:func:`pp_gpt_loss_circular`).  ``replicated`` as in
+    :func:`stack_pp_params`."""
+    if circles < 1:
+        raise ValueError(f"circles={circles} must be >= 1")
+    if cfg.num_layers % (pp * circles):
+        raise ValueError(
+            f"pp*circles={pp}*{circles} must divide "
+            f"num_layers={cfg.num_layers}"
+        )
+    staged, replicated = stack_pp_params(params, cfg, pp)
+    per_group = cfg.num_layers // (pp * circles)
+    # stack_pp_params laid blocks contiguously: [pp, per_stage, ...] with
+    # per_stage = circles*per_group and stage s holding layers
+    # [s*per_stage, (s+1)*per_stage).  The circular layout instead puts
+    # layer (v*pp + s)*per_group + j at [s, v, j]; restack from the flat
+    # block order via [circles, pp, per_group] -> transpose.
+    def _restack(leaf):
+        flat = jnp.reshape(leaf, (cfg.num_layers,) + leaf.shape[2:])
+        grouped = jnp.reshape(
+            flat, (circles, pp, per_group) + leaf.shape[2:]
+        )
+        return jnp.transpose(
+            grouped, (1, 0, 2) + tuple(range(3, grouped.ndim))
+        )
+
+    return jax.tree_util.tree_map(_restack, staged), replicated
+
+
 def _dense_block(cfg, p, x, positions, rope_tabs):
     """One transformer block from raw weights — the shared
     ``models.transformer.block_math`` wiring via its raw-weights
@@ -73,6 +108,17 @@ def _dense_block(cfg, p, x, positions, rope_tabs):
     return raw_block_forward(cfg, p, x, positions, rope_tabs)
 
 
+def _head_loss(replicated_params, cfg, y, tgt):
+    """Per-microbatch token loss from a stage's final activation — the
+    one definition both the contiguous and circular training schedules
+    mask into their ticks."""
+    from .tensor_parallel import _gpt_head  # noqa: PLC0415
+
+    logits = _gpt_head(replicated_params, cfg, y)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.take_along_axis(logp, tgt[..., None], -1).mean()
+
+
 class _Schedule:
     """Everything the GPipe tick loop shares between the logits and the
     stage-local-loss entry points: the embedded microbatch stream, the
@@ -80,7 +126,8 @@ class _Schedule:
     plumbing for the scan carry."""
 
     def __init__(self, staged_params, replicated_params, cfg, tokens,
-                 pp_axis, microbatches, pos_offset, positions, remat):
+                 pp_axis, microbatches, pos_offset, positions, remat,
+                 contiguous=True):
         from .tensor_parallel import _gpt_embed  # noqa: PLC0415
 
         self.pp_axis = pp_axis
@@ -100,8 +147,26 @@ class _Schedule:
         self.mb = b // microbatches
         self.microbatches = microbatches
         self.mbs = x.reshape(microbatches, self.mb, s, cfg.emb_dim)
+        self.positions, self.rope_tabs = positions, rope_tabs
         local = jax.tree_util.tree_map(lambda a: a[0], staged_params)
+        self.local = local
         layers_per_stage = jax.tree_util.tree_leaves(local)[0].shape[0]
+        # Guard against circular-stacked params reaching a contiguous
+        # entry point: their extra [circles] leading dim would broadcast
+        # through the block matmuls and compose the layers in the wrong
+        # order — finite-looking but wrong loss, no error.  (The
+        # converse mistake is caught in pp_gpt_loss_circular.)
+        qkv = local["qkv"]["kernel"]
+        per_stage = cfg.num_layers // self.pp
+        if contiguous and (qkv.ndim != 3
+                           or layers_per_stage != per_stage):
+            raise ValueError(
+                f"staged qkv kernel has shape {qkv.shape}, expected "
+                f"[{per_stage}, emb, qkv_dim] (num_layers/pp contiguous "
+                "layers per device) — params stacked with "
+                "stack_pp_params_circular must go through "
+                "pp_gpt_loss_circular"
+            )
 
         def run_stage(x):
             for j in range(layers_per_stage):
@@ -231,8 +296,6 @@ def pp_gpt_loss(staged_params, replicated_params, cfg, tokens, targets,
     ``targets [batch, seq]`` are the next-token labels aligned with
     ``tokens``.  Returns the mean token loss, replicated over the axis.
     """
-    from .tensor_parallel import _gpt_head  # noqa: PLC0415
-
     sched = _Schedule(staged_params, replicated_params, cfg, tokens,
                       pp_axis, microbatches, pos_offset, positions, remat)
     pp, stage, mb, s = sched.pp, sched.stage, sched.mb, sched.s
@@ -240,9 +303,7 @@ def pp_gpt_loss(staged_params, replicated_params, cfg, tokens, targets,
     zero = sched.varying(jnp.zeros((mb, s, cfg.emb_dim), cfg.dtype))
 
     def head_loss(y, tgt):
-        logits = _gpt_head(replicated_params, cfg, y)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        return -jnp.take_along_axis(logp, tgt[..., None], -1).mean()
+        return _head_loss(replicated_params, cfg, y, tgt)
 
     if remat:
         head_loss = jax.checkpoint(head_loss)
@@ -268,3 +329,112 @@ def pp_gpt_loss(staged_params, replicated_params, cfg, tokens, targets,
     # every microbatch is the same size, so the mean of per-microbatch
     # means is the global token mean; the psum is the whole rejoin
     return lax.psum(loss_sum, pp_axis) / microbatches
+
+
+def pp_gpt_loss_circular(staged_params, replicated_params, cfg, tokens,
+                         targets, pp_axis: str, *, microbatches: int,
+                         circles: int, pos_offset=0, positions=None,
+                         remat: bool = True):
+    """:func:`pp_gpt_loss` on the circular (interleaved-group) schedule.
+
+    Each device holds ``circles`` non-contiguous layer groups
+    (:func:`stack_pp_params_circular`) and the microbatch stream wraps
+    through the ring ``circles`` times: device ``s`` at tick ``t`` works
+    stream position ``k = t - s`` — circle ``v = k // M``, microbatch
+    ``m = k % M`` — always exactly ONE group-forward per tick, so unlike
+    a 1F1B schedule there is no masked-branch compute waste (see
+    docs/pipeline.md).  Bubble shrinks from ``(P-1)/(M+P-1)`` to
+    ``(P-1)/(circles*M + P-1)`` — the praxis-style circular pipeline —
+    at the price of ``circles``x the ppermute hand-off traffic.
+
+    A circle-boundary activation (device P-1's output for circle
+    ``v < circles-1``) re-enters device 0 ``M - P + 1`` ticks after it
+    arrives, banked in an M-slot ring buffer: slot ``h % M`` is written
+    at tick ``h + P`` and read at tick ``h + M``, collision-free for
+    ``microbatches >= pp`` (enforced).  Loss/head/rejoin semantics are
+    exactly :func:`pp_gpt_loss` (stage-local head on the final circle,
+    one scalar psum).
+    """
+    sched = _Schedule(staged_params, replicated_params, cfg, tokens,
+                      pp_axis, microbatches, pos_offset, positions,
+                      remat=False,       # applied to run_group below
+                      contiguous=False)  # leaves are [circles, group, ..]
+    pp, stage, mb, s = sched.pp, sched.stage, sched.mb, sched.s
+    M = microbatches
+    if M < pp:
+        raise ValueError(
+            f"circular schedule needs microbatches >= pp ({M} < {pp}): "
+            "the ring buffer re-feeds device 0 M-P+1 ticks after arrival"
+        )
+    leaves = jax.tree_util.tree_leaves(sched.local)
+    if leaves[0].shape[0] != circles:
+        raise ValueError(
+            f"staged params carry {leaves[0].shape[0]} groups/device, "
+            f"expected circles={circles} — restack with "
+            "stack_pp_params_circular(params, cfg, pp, circles)"
+        )
+    per_group = leaves[0].shape[1]
+    tgt_mbs = targets.reshape(M, mb, s)
+
+    def run_group(v, x):
+        p_v = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            sched.local,
+        )
+        for j in range(per_group):
+            p_j = jax.tree_util.tree_map(lambda a: a[j], p_v)
+            x = _dense_block(cfg, p_j, x, sched.positions,
+                             sched.rope_tabs)
+        return x
+
+    def head_loss(y, tgt):
+        return _head_loss(replicated_params, cfg, y, tgt)
+
+    if remat:
+        run_group = jax.checkpoint(run_group)
+        head_loss = jax.checkpoint(head_loss)
+
+    n_ticks = circles * M + pp - 1
+    zero = sched.varying(jnp.zeros((mb, s, cfg.emb_dim), cfg.dtype))
+    queue0 = sched.varying(jnp.zeros((M, mb, s, cfg.emb_dim), cfg.dtype))
+    loss0 = sched.varying(jnp.zeros((), jnp.float32))
+
+    def tick(carry, t):
+        incoming, queue, loss_sum = carry
+        # (1) bank the arrival FIRST: device 0's incoming this tick is
+        # stream position h = t - pp (device P-1's output last tick);
+        # write-then-read makes the M == pp edge (write and read of the
+        # same slot in one tick) correct.
+        h = t - pp
+        slot = jnp.mod(h, M)  # non-negative for any h
+        queue = lax.dynamic_update_index_in_dim(
+            queue,
+            jnp.where(h >= 0, incoming,
+                      lax.dynamic_index_in_dim(queue, slot, 0,
+                                               keepdims=False)),
+            slot, axis=0,
+        )
+        # (2) this device's stream position
+        k = jnp.clip(t - stage, 0, circles * M - 1)
+        k_valid = jnp.logical_and(t - stage >= 0,
+                                  t - stage < circles * M)
+        v = k // M
+        m = jnp.mod(k, M)
+        fresh = lax.dynamic_index_in_dim(sched.mbs, m, 0, keepdims=False)
+        banked = lax.dynamic_index_in_dim(queue, m, 0, keepdims=False)
+        x0 = jnp.where(v == 0, fresh, banked)
+        x_in = jnp.where(stage == 0, x0, incoming)
+        y = run_group(v, x_in)
+        # (3) final-circle outputs of the last device carry the loss
+        tgt = lax.dynamic_index_in_dim(tgt_mbs, m, 0, keepdims=False)
+        take = jnp.logical_and(
+            jnp.logical_and(stage == pp - 1, v == circles - 1), k_valid
+        )
+        loss_sum = loss_sum + jnp.where(take, head_loss(y, tgt), 0.0)
+        handoff = lax.ppermute(y, pp_axis, sched.fwd_perm)
+        return (handoff, queue, loss_sum), None
+
+    (_, _, loss_sum), _ = lax.scan(
+        tick, (zero, queue0, loss0), jnp.arange(n_ticks)
+    )
+    return lax.psum(loss_sum, pp_axis) / M

@@ -19,7 +19,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.models.transformer import gpt
 from horovod_tpu.parallel.pipeline import (
-    pp_gpt_apply, pp_gpt_loss, stack_pp_params,
+    pp_gpt_apply, pp_gpt_loss, pp_gpt_loss_circular, stack_pp_params,
+    stack_pp_params_circular,
 )
 
 PP = 4
@@ -223,6 +224,132 @@ def test_pp_apply_remat_matches():
     np.testing.assert_allclose(
         np.asarray(run(True)), np.asarray(run(False)), atol=1e-6
     )
+
+
+@pytest.mark.parametrize("pp,circles,layers,mbs", [
+    (2, 2, 4, 4),   # 1 layer/group, stream wraps twice
+    (4, 2, 8, 4),   # M == pp: write-and-read same ring slot in one tick
+    (2, 3, 6, 2),   # three circles
+])
+def test_pp_circular_loss_matches_single_device(pp, circles, layers, mbs):
+    """Circular-schedule loss equals the unsharded token loss for
+    several (P, V, M) geometries, including the M == P ring-buffer
+    edge."""
+    model = _model(num_layers=layers)
+    tokens = _tokens(5)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(5), tokens)
+    ref = _ref_token_loss(model, params, tokens, targets)
+    staged, replicated = stack_pp_params_circular(
+        params, model.cfg, pp, circles
+    )
+    mesh = Mesh(np.asarray(jax.devices()[:pp]), (AXIS,))
+
+    def local(staged, replicated, tok, tgt):
+        return pp_gpt_loss_circular(
+            staged, replicated, model.cfg, tok, tgt, AXIS,
+            microbatches=mbs, circles=circles,
+        )
+
+    loss = jax.jit(
+        shard_map(
+            local, mesh=mesh,
+            in_specs=(P(AXIS), P(), P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+    )(staged, replicated, tokens, targets)
+    np.testing.assert_allclose(
+        float(loss), float(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_pp_circular_gradients_match():
+    """Gradients through the circular schedule: group grads land on the
+    right (stage, circle) slots and match the unsharded model's layer
+    grads; replicated embed/head grads match too."""
+    pp, circles = 2, 2
+    model = _model(num_layers=4)
+    tokens = _tokens(6)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(6), tokens)
+    g_ref = jax.grad(
+        lambda p: _ref_token_loss(model, p, tokens, targets)
+    )(params)["params"]
+    staged, replicated = stack_pp_params_circular(
+        params, model.cfg, pp, circles
+    )
+    mesh = Mesh(np.asarray(jax.devices()[:pp]), (AXIS,))
+
+    def local_loss(staged, replicated, tok, tgt):
+        return pp_gpt_loss_circular(
+            staged, replicated, model.cfg, tok, tgt, AXIS,
+            microbatches=4, circles=circles,
+        )
+
+    grad_fn = jax.jit(
+        shard_map(
+            jax.grad(local_loss, argnums=(0, 1)), mesh=mesh,
+            in_specs=(P(AXIS), P(), P(), P()),
+            out_specs=(P(AXIS), P()),
+            check_vma=True,
+        )
+    )
+    g_staged, g_rep = grad_fn(staged, replicated, tokens, targets)
+    # layer (v*pp + s)*per_group + j sits at staged[s, v, j]:
+    # block0 -> [0,0,0], block1 -> [1,0,0], block2 -> [0,1,0],
+    # block3 -> [1,1,0]
+    for blk, (st, v) in [(0, (0, 0)), (1, (1, 0)),
+                         (2, (0, 1)), (3, (1, 1))]:
+        np.testing.assert_allclose(
+            np.asarray(g_staged["qkv"]["kernel"][st, v, 0]),
+            np.asarray(g_ref[f"block{blk}"]["qkv"]["kernel"]),
+            atol=2e-4, rtol=2e-4,
+        )
+    np.testing.assert_allclose(
+        np.asarray(g_rep["wte"]["embedding"]),
+        np.asarray(g_ref["wte"]["embedding"]),
+        atol=2e-4, rtol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_rep["head"]["kernel"]),
+        np.asarray(g_ref["head"]["kernel"]),
+        atol=2e-4, rtol=2e-4,
+    )
+
+
+def test_pp_circular_validation_errors():
+    model = _model()  # 4 layers
+    params = model.init(jax.random.PRNGKey(0), _tokens())
+    with pytest.raises(ValueError, match="must divide"):
+        stack_pp_params_circular(params, model.cfg, 4, 2)  # 8 !| 4
+    staged, replicated = stack_pp_params_circular(params, model.cfg, 2, 2)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), (AXIS,))
+
+    def local(staged, replicated, tok, tgt):
+        return pp_gpt_loss_circular(
+            staged, replicated, model.cfg, tok, tgt, AXIS,
+            microbatches=1, circles=2,  # M < pp
+        )
+
+    with pytest.raises(Exception, match="microbatches >= pp"):
+        jax.jit(
+            shard_map(local, mesh=mesh,
+                      in_specs=(P(AXIS), P(), P(), P()), out_specs=P(),
+                      check_vma=False)
+        )(staged, replicated, _tokens(b=1), _tokens(b=1))
+
+    # circular-stacked params into a CONTIGUOUS entry point must raise,
+    # not silently broadcast the [circles] dim through the matmuls
+    def wrong(staged, replicated, tok, tgt):
+        return pp_gpt_loss(staged, replicated, model.cfg, tok, tgt, AXIS,
+                           microbatches=2)
+
+    with pytest.raises(Exception, match="pp_gpt_loss_circular"):
+        jax.jit(
+            shard_map(wrong, mesh=mesh,
+                      in_specs=(P(AXIS), P(), P(), P()), out_specs=P(),
+                      check_vma=False)
+        )(staged, replicated, _tokens(), _tokens())
 
 
 def test_pp_validation_errors():
