@@ -22,8 +22,8 @@ from .moe import (  # noqa: F401
     moe_mlp_ep,
 )
 from .pipeline import (  # noqa: F401
-    pp_gpt_apply, pp_gpt_loss, pp_gpt_loss_circular, stack_pp_params,
-    stack_pp_params_circular,
+    pp_gpt_apply, pp_gpt_loss, pp_gpt_loss_circular, pp_tp_gpt_loss,
+    stack_pp_params, stack_pp_params_circular, stack_tp_pp_params,
 )
 from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
 from .tensor_parallel import stack_tp_params, tp_gpt_apply  # noqa: F401

@@ -24,7 +24,8 @@ import numpy as np
 from jax import lax
 
 __all__ = ["stack_pp_params", "stack_pp_params_circular",
-           "pp_gpt_apply", "pp_gpt_loss", "pp_gpt_loss_circular"]
+           "stack_tp_pp_params", "pp_gpt_apply", "pp_gpt_loss",
+           "pp_gpt_loss_circular", "pp_tp_gpt_loss"]
 
 
 def stack_pp_params(params, cfg, pp: int):
@@ -119,6 +120,32 @@ def _head_loss(replicated_params, cfg, y, tgt):
     return -jnp.take_along_axis(logp, tgt[..., None], -1).mean()
 
 
+def _vma_axes(refs, base):
+    """The varying-axes set a scan carry must declare: ``base`` plus
+    every axis any of ``refs`` (activations, stage weights) varies
+    over — e.g. a dp axis in a composed dp x pp mesh."""
+    axes = set(base)
+    for r in refs:
+        try:
+            axes |= set(jax.typeof(r).vma)
+        except (AttributeError, TypeError):
+            pass
+    return tuple(sorted(axes))
+
+
+def _mark_varying(v, axes):
+    """Mark a replicated value device-varying over ``axes`` so a scan
+    carry's type matches the tick outputs under replication tracking
+    (check_vma=True) — a no-op without it."""
+    try:
+        return lax.pcast(v, axes, to="varying")
+    except (AttributeError, TypeError):  # older jax: pvary spelling
+        try:
+            return lax.pvary(v, axes)
+        except (AttributeError, TypeError):
+            return v  # very old jax: no vma tracking to satisfy
+
+
 class _Schedule:
     """Everything the GPipe tick loop shares between the logits and the
     stage-local-loss entry points: the embedded microbatch stream, the
@@ -127,7 +154,8 @@ class _Schedule:
 
     def __init__(self, staged_params, replicated_params, cfg, tokens,
                  pp_axis, microbatches, pos_offset, positions, remat,
-                 contiguous=True):
+                 contiguous=True, local=None, layer_fn=None,
+                 extra_axes=()):
         from .tensor_parallel import _gpt_embed  # noqa: PLC0415
 
         self.pp_axis = pp_axis
@@ -148,30 +176,44 @@ class _Schedule:
         self.microbatches = microbatches
         self.mbs = x.reshape(microbatches, self.mb, s, cfg.emb_dim)
         self.positions, self.rope_tabs = positions, rope_tabs
-        local = jax.tree_util.tree_map(lambda a: a[0], staged_params)
+        default_local = local is None
+        if default_local:
+            local = jax.tree_util.tree_map(lambda a: a[0], staged_params)
         self.local = local
         layers_per_stage = jax.tree_util.tree_leaves(local)[0].shape[0]
-        # Guard against circular-stacked params reaching a contiguous
-        # entry point: their extra [circles] leading dim would broadcast
-        # through the block matmuls and compose the layers in the wrong
-        # order — finite-looking but wrong loss, no error.  (The
-        # converse mistake is caught in pp_gpt_loss_circular.)
-        qkv = local["qkv"]["kernel"]
         per_stage = cfg.num_layers // self.pp
-        if contiguous and (qkv.ndim != 3
-                           or layers_per_stage != per_stage):
-            raise ValueError(
-                f"staged qkv kernel has shape {qkv.shape}, expected "
-                f"[{per_stage}, emb, qkv_dim] (num_layers/pp contiguous "
-                "layers per device) — params stacked with "
-                "stack_pp_params_circular must go through "
-                "pp_gpt_loss_circular"
-            )
+        if contiguous:
+            # Guard against mis-stacked params reaching a contiguous
+            # entry point — circular-stacked trees (extra [circles] dim
+            # broadcasting through the matmuls) or a stack built for a
+            # different pp (stages silently dropped): finite-looking
+            # but wrong loss, no error.  (The converse mistake is
+            # caught in pp_gpt_loss_circular.)
+            if default_local:
+                qkv = local["qkv"]["kernel"]
+                if qkv.ndim != 3 or layers_per_stage != per_stage:
+                    raise ValueError(
+                        f"staged qkv kernel has shape {qkv.shape}, "
+                        f"expected [{per_stage}, emb, qkv_dim] "
+                        "(num_layers/pp contiguous layers per device) — "
+                        "params stacked with stack_pp_params_circular "
+                        "must go through pp_gpt_loss_circular"
+                    )
+            elif layers_per_stage != per_stage:
+                raise ValueError(
+                    f"staged params carry {layers_per_stage} "
+                    f"layers/stage but num_layers/pp = {per_stage} — "
+                    "stacked for a different pp than this mesh axis?"
+                )
+
+        if layer_fn is None:
+            def layer_fn(p_j, x, positions, rope_tabs):
+                return _dense_block(cfg, p_j, x, positions, rope_tabs)
 
         def run_stage(x):
             for j in range(layers_per_stage):
                 p_j = jax.tree_util.tree_map(lambda a: a[j], local)
-                x = _dense_block(cfg, p_j, x, positions, rope_tabs)
+                x = layer_fn(p_j, x, positions, rope_tabs)
             return x
 
         if remat:
@@ -186,29 +228,19 @@ class _Schedule:
         self.n_ticks = microbatches + self.pp - 1
 
         # The scan carry must have the same varying-axes set as the tick
-        # outputs: pp_axis (the ppermute), every axis the activations
-        # vary over (e.g. a dp axis in a composed dp x pp mesh — tokens
-        # sharded over dp make every stage output dp-varying), and every
-        # axis the stage weights vary over.
-        carry_axes = {pp_axis}
-        for ref_val in (self.mbs, *jax.tree_util.tree_leaves(local)[:1]):
-            try:
-                carry_axes |= set(jax.typeof(ref_val).vma)
-            except (AttributeError, TypeError):
-                pass
-        self._carry_axes = tuple(sorted(carry_axes))
+        # outputs: pp_axis (the ppermute), every declared extra axis
+        # (e.g. tp in TP-in-PP), every axis the activations vary over
+        # (e.g. a dp axis in a composed dp x pp mesh — tokens sharded
+        # over dp make every stage output dp-varying), and every axis
+        # the stage weights vary over.
+        self._carry_axes = _vma_axes(
+            (self.mbs, *jax.tree_util.tree_leaves(local)[:1]),
+            {pp_axis, *extra_axes},
+        )
 
     def varying(self, v):
-        """Mark a replicated value device-varying over the carry's axes
-        so the scan carry's type matches the tick outputs under
-        replication tracking (check_vma=True) — a no-op without it."""
-        try:
-            return lax.pcast(v, self._carry_axes, to="varying")
-        except (AttributeError, TypeError):  # older jax: pvary spelling
-            try:
-                return lax.pvary(v, self._carry_axes)
-            except (AttributeError, TypeError):
-                return v  # very old jax: no vma tracking to satisfy
+        """:func:`_mark_varying` over this schedule's carry axes."""
+        return _mark_varying(v, self._carry_axes)
 
     def stage_io(self, incoming, t):
         """The per-tick stage input/output shared by every schedule:
@@ -298,7 +330,18 @@ def pp_gpt_loss(staged_params, replicated_params, cfg, tokens, targets,
     """
     sched = _Schedule(staged_params, replicated_params, cfg, tokens,
                       pp_axis, microbatches, pos_offset, positions, remat)
+    return _gpipe_loss(sched, replicated_params, cfg, targets, remat)
+
+
+def _gpipe_loss(sched, replicated_params, cfg, targets, remat):
+    """The GPipe loss tick loop shared by the contiguous and TP-in-PP
+    entry points: last stage finishes microbatch ``t - (pp-1)`` each
+    tick, runs head+loss on it there (SPMD: every stage computes them,
+    only the last stage's masked contribution survives — no
+    microbatch's final activation ever outlives its tick), and the
+    rejoin is one scalar psum."""
     pp, stage, mb, s = sched.pp, sched.stage, sched.mb, sched.s
+    microbatches = sched.microbatches
     tgt_mbs = targets.reshape(microbatches, mb, s)
     zero = sched.varying(jnp.zeros((mb, s, cfg.emb_dim), cfg.dtype))
 
@@ -311,10 +354,6 @@ def pp_gpt_loss(staged_params, replicated_params, cfg, tokens, targets,
     def tick(carry, t):
         incoming, loss_sum = carry
         y, handoff = sched.stage_io(incoming, t)
-        # last stage finished microbatch t - (pp - 1) this tick; its
-        # head+loss run here (SPMD: every stage computes them, only the
-        # last stage's masked contribution survives) so no microbatch's
-        # final activation ever outlives its tick
         out_idx = jnp.clip(t - (pp - 1), 0, microbatches - 1)
         tgt = lax.dynamic_index_in_dim(tgt_mbs, out_idx, axis=0,
                                        keepdims=False)
@@ -328,7 +367,7 @@ def pp_gpt_loss(staged_params, replicated_params, cfg, tokens, targets,
     )
     # every microbatch is the same size, so the mean of per-microbatch
     # means is the global token mean; the psum is the whole rejoin
-    return lax.psum(loss_sum, pp_axis) / microbatches
+    return lax.psum(loss_sum, sched.pp_axis) / microbatches
 
 
 def pp_gpt_loss_circular(staged_params, replicated_params, cfg, tokens,
@@ -438,3 +477,96 @@ def pp_gpt_loss_circular(staged_params, replicated_params, cfg, tokens,
         tick, (zero, queue0, loss0), jnp.arange(n_ticks)
     )
     return lax.psum(loss_sum, pp_axis) / M
+
+
+def stack_tp_pp_params(params, cfg, pp: int, tp: int):
+    """Restack for TP-inside-PP: pipeline stages whose blocks are
+    Megatron-sharded over a second mesh axis — the 3-axis
+    (dp x pp x tp) deployment shape.
+
+    Returns ``(staged_sharded, staged_replicated, replicated)``:
+
+    * ``staged_sharded`` — block matmul shards, leaves
+      ``[pp, tp, layers_per_stage, ...]``: ``in_specs=P(pp_axis,
+      tp_axis)``.
+    * ``staged_replicated`` — per-block LNs and post-psum biases
+      (tp-replicated but stage-local), leaves ``[pp, layers_per_stage,
+      ...]``: ``in_specs=P(pp_axis)``.
+    * ``replicated`` — embeddings, final LN, head: ``in_specs=P()``.
+    """
+    from .tensor_parallel import stack_tp_params  # noqa: PLC0415
+
+    if cfg.num_layers % pp:
+        raise ValueError(
+            f"pp={pp} must divide num_layers={cfg.num_layers}"
+        )
+    sharded, replicated = stack_tp_params(params, cfg, tp)
+    per = cfg.num_layers // pp
+
+    def _stack_blocks(tree_of_blocks, tp_leading):
+        blocks = [tree_of_blocks[f"block{i}"]
+                  for i in range(cfg.num_layers)]
+
+        def _leaf(*leaves):
+            stacked = jnp.stack([jnp.asarray(x) for x in leaves])
+            # [L, (tp,) ...] -> [pp, per, (tp,) ...]
+            stacked = jnp.reshape(
+                stacked, (pp, per) + stacked.shape[1:]
+            )
+            if tp_leading:  # -> [pp, tp, per, ...]
+                stacked = jnp.moveaxis(stacked, 2, 1)
+            return stacked
+
+        return jax.tree_util.tree_map(_leaf, *blocks)
+
+    staged_sharded = _stack_blocks(sharded, tp_leading=True)
+    staged_replicated = _stack_blocks(
+        {k: v for k, v in replicated.items() if k.startswith("block")},
+        tp_leading=False,
+    )
+    true_replicated = {
+        k: jax.tree_util.tree_map(jnp.asarray, v)
+        for k, v in replicated.items() if not k.startswith("block")
+    }
+    return staged_sharded, staged_replicated, true_replicated
+
+
+def pp_tp_gpt_loss(staged_sharded, staged_replicated, replicated_params,
+                   cfg, tokens, targets, pp_axis: str, tp_axis: str, *,
+                   microbatches: int, pos_offset=0, positions=None,
+                   remat: bool = True):
+    """:func:`pp_gpt_loss` with each stage's blocks Megatron-sharded
+    over ``tp_axis`` — TP inside PP, the composition a real multi-pod
+    deployment runs (dp x pp x tp; the dp axis comes from the caller's
+    mesh and gradient pmean as in ``tests/test_composed.py``).
+
+    Per tick each rank runs its stage's layers on its head/width shard
+    (two psums per block over ``tp_axis`` — parallel/tensor_parallel.py)
+    and hands the full activation to the next stage over ``pp_axis``;
+    head/loss/rejoin semantics are exactly :func:`pp_gpt_loss`.  Trees
+    from :func:`stack_tp_pp_params`.
+    """
+    from .tensor_parallel import _tp_block  # noqa: PLC0415
+
+    tp = lax.axis_size(tp_axis)
+    # slice off both sharded leading dims ([pp, tp, ...] / [pp, ...]);
+    # the tuple is one pytree so _Schedule's per-layer slicing and the
+    # layers-per-stage guard see both trees together
+    local = (
+        jax.tree_util.tree_map(lambda a: a[0][0], staged_sharded),
+        jax.tree_util.tree_map(lambda a: a[0], staged_replicated),
+    )
+
+    def layer_fn(p_j, x, positions, rope_tabs):
+        sh_j, rep_j = p_j
+        return _tp_block(cfg, sh_j, rep_j, x, positions, rope_tabs,
+                         tp_axis, tp)
+
+    sched = _Schedule(None, replicated_params, cfg, tokens, pp_axis,
+                      microbatches, pos_offset, positions, remat,
+                      local=local, layer_fn=layer_fn,
+                      extra_axes=(tp_axis,))
+    loss = _gpipe_loss(sched, replicated_params, cfg, targets, remat)
+    # value-identical on every tp rank (post-psum activations): the
+    # pmean collapses the tp axis for a replicated scalar return
+    return lax.pmean(loss, tp_axis)
