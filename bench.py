@@ -103,17 +103,14 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
     hvd.init()
     n_chips = hvd.num_devices()
 
-    if dtype == "fp8":
-        # No e4m3 activation-storage path exists for the transformer yet
-        # (TransformerConfig has no act_store_dtype); silently running
-        # bf16 under an fp8 label would corrupt the benchmark series.
-        raise SystemExit("--dtype fp8 is resnet-only (e4m3 act storage)")
     compute_dtype = jnp.float32 if dtype == "fp32" else jnp.bfloat16
+    act_store = jnp.float8_e4m3fn if dtype == "fp8" else None
     model = gpt(size, dtype=compute_dtype, max_len=seq_len,
                 attention_impl=attention, remat=remat,
                 flash_block_q=flash_block_q, flash_block_k=flash_block_k,
                 num_kv_heads=kv_heads or None,
-                pos_embedding=pos_embedding, moe_experts=moe_experts)
+                pos_embedding=pos_embedding, moe_experts=moe_experts,
+                act_store_dtype=act_store)
     vocab = model.cfg.vocab_size
 
     global_batch = batch_size * n_chips

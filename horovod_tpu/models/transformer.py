@@ -82,6 +82,14 @@ class TransformerConfig:
     # routing group (keeps dispatch O(n*group)); default tracks the one
     # source of truth in parallel/moe.py
     moe_group_size: int = MOE_DEFAULT_GROUP_SIZE
+    # Activation storage dtype (e.g. jnp.float8_e4m3fn) for the big saved
+    # activations backward re-reads: the residual-branch deltas (attention
+    # and MLP outputs), the pre-proj attention context, and the gelu
+    # intermediate (the 4x-wide one) materialize at 1 B/elt; matmuls widen
+    # in-register to the compute dtype.  Lossy — changes the numerics
+    # contract (tests/test_fp8.py pins how far it may drift) — so opt-in,
+    # mirroring models/resnet.py act_store_dtype.
+    act_store_dtype: Optional[Any] = None
 
     def __post_init__(self):
         if self.num_kv_heads is not None:
@@ -160,6 +168,16 @@ def _attend(cfg: TransformerConfig, q, k, v, positions):
     )
 
 
+def act_store(y, cfg: TransformerConfig):
+    """The opt-in lossy activation-storage round-trip: materialize ``y``
+    at ``cfg.act_store_dtype`` (1 B/elt for e4m3) and widen back to the
+    compute dtype — a no-op when the knob is off.  Shared by block_math
+    and every MLP closure so the fp8 story has one definition."""
+    if cfg.act_store_dtype is None:
+        return y
+    return jnp.asarray(jnp.asarray(y, cfg.act_store_dtype), cfg.dtype)
+
+
 def block_math(cfg: TransformerConfig, x, positions, rope_tabs, *,
                ln1, qkv, proj, ln2, mlp,
                num_heads: Optional[int] = None,
@@ -202,9 +220,11 @@ def block_math(cfg: TransformerConfig, x, positions, rope_tabs, *,
         # per-rank head shard: _attend must see the LOCAL head geometry
         attend_cfg = replace(cfg, num_heads=nh, num_kv_heads=nkv,
                              emb_dim=q_dim)
-    att = _attend(attend_cfg, q, k, v, positions).reshape(b, s, q_dim)
-    x = x + proj(att)
-    return x + mlp(ln2(x))
+    att = act_store(
+        _attend(attend_cfg, q, k, v, positions).reshape(b, s, q_dim), cfg
+    )
+    x = x + act_store(proj(att), cfg)
+    return x + act_store(mlp(ln2(x)), cfg)
 
 
 def raw_layer_norm(x, scale, bias, eps: float = 1e-6):
@@ -235,7 +255,7 @@ def raw_block_forward(cfg: TransformerConfig, p, x, positions, rope_tabs):
     dt = cfg.dtype
 
     def mlp(h):
-        m = jax.nn.gelu(raw_dense(p["fc1"], dt)(h))
+        m = act_store(jax.nn.gelu(raw_dense(p["fc1"], dt)(h)), cfg)
         return raw_dense(p["fc2"], dt)(m)
 
     return block_math(
@@ -276,6 +296,7 @@ class Block(nn.Module):
                     h, moe_p, top_k=cfg.moe_top_k,
                     capacity_factor=cfg.moe_capacity_factor,
                     group_size=cfg.moe_group_size, dtype=cfg.dtype,
+                    act_store_dtype=cfg.act_store_dtype,
                 )
                 self.sow("losses", "moe_aux", aux)
                 # y inherits ln2's fp32; keep the residual stream in the
@@ -284,7 +305,7 @@ class Block(nn.Module):
             m = nn.Dense(cfg.mlp_ratio * cfg.emb_dim, dtype=cfg.dtype,
                          name="fc1")(h)
             return nn.Dense(cfg.emb_dim, dtype=cfg.dtype,
-                            name="fc2")(nn.gelu(m))
+                            name="fc2")(act_store(nn.gelu(m), cfg))
 
         return block_math(
             cfg, x, positions, rope_tabs,

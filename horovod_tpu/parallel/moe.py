@@ -133,10 +133,16 @@ def _routing(x2, router, num_experts: int, top_k: int, capacity: int,
     return dispatch, combine, aux_loss
 
 
-def _expert_ffn(buf, w1, b1, w2, b2, dtype):
-    """Batched expert FFN on ``buf [E_local, C, d]``."""
+def _expert_ffn(buf, w1, b1, w2, b2, dtype, act_store_dtype=None):
+    """Batched expert FFN on ``buf [E_local, C, d]``.  When
+    ``act_store_dtype`` is set, the gelu intermediate (the 4x-wide
+    saved activation) materializes at that dtype — the MoE leg of the
+    transformer's opt-in fp8 activation storage
+    (models/transformer.py act_store)."""
     h = jnp.einsum("ecd,edf->ecf", buf.astype(dtype), w1.astype(dtype))
     h = jax.nn.gelu(h + b1[:, None, :].astype(dtype))
+    if act_store_dtype is not None:
+        h = jnp.asarray(jnp.asarray(h, act_store_dtype), dtype)
     out = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype))
     return out + b2[:, None, :].astype(dtype)
 
@@ -169,7 +175,7 @@ def _grouped_routing(x2, router, num_experts, top_k, capacity_factor,
 def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
             capacity_factor: float = 2.0,
             group_size: int = DEFAULT_GROUP_SIZE,
-            dtype=jnp.float32):
+            dtype=jnp.float32, act_store_dtype=None):
     """Dense (single-device / data-parallel) MoE MLP.
 
     ``x [b, s, d]`` -> ``(y [b, s, d], aux_loss)``.  Tokens route within
@@ -189,7 +195,7 @@ def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
     buf = jnp.einsum("gnec,gnd->gecd", dispatch, xg.astype(jnp.float32))
     buf = buf.transpose(1, 0, 2, 3).reshape(num_experts, G * capacity, d)
     out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
-                      dtype)
+                      dtype, act_store_dtype)
     out = out.reshape(num_experts, G, capacity, d).transpose(1, 0, 2, 3)
     y = jnp.einsum("gnec,gecd->gnd", combine, out.astype(jnp.float32))
     y = y.reshape(-1, d)[:n]  # drop padding rows
@@ -198,7 +204,8 @@ def moe_mlp(x, params: MoEParams, *, top_k: int = 2,
 
 def moe_mlp_ep(x, params: MoEParams, ep_axis: str, *, top_k: int = 2,
                capacity_factor: float = 2.0,
-               group_size: int = DEFAULT_GROUP_SIZE, dtype=jnp.float32):
+               group_size: int = DEFAULT_GROUP_SIZE, dtype=jnp.float32,
+               act_store_dtype=None):
     """Expert-parallel MoE MLP: call inside ``shard_map``.
 
     Sharding: ``x [b_local, s, d]`` tokens sharded over ``ep_axis``;
@@ -239,7 +246,7 @@ def moe_mlp_ep(x, params: MoEParams, ep_axis: str, *, top_k: int = 2,
                          tiled=False)          # [P, e_local, G*C, d]
     buf = buf.transpose(1, 0, 2, 3).reshape(e_local, p * cap_total, d)
     out = _expert_ffn(buf, params.w1, params.b1, params.w2, params.b2,
-                      dtype)
+                      dtype, act_store_dtype)
     out = out.reshape(e_local, p, cap_total, d).transpose(1, 0, 2, 3)
     out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0,
                          tiled=False)          # [P, e_local, G*C, d] home

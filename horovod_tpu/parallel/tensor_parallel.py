@@ -187,8 +187,10 @@ def _tp_block(cfg, p, rep, x, positions, rope_tabs, tp_axis, tp):
         ) + bias.astype(dt)
 
     def mlp(h):
+        from ..models.transformer import act_store  # noqa: PLC0415
+
         return row(p["fc2"]["kernel"], rep["fc2_bias"])(
-            jax.nn.gelu(raw_dense(p["fc1"], dt)(h))
+            act_store(jax.nn.gelu(raw_dense(p["fc1"], dt)(h)), cfg)
         )
 
     return block_math(

@@ -31,6 +31,9 @@ run gpt_small_blocks512x512 1800 1440 --model gpt-small --flash-block-q 512 --fl
 run gpt_small_blocks1024q 1800 1440 --model gpt-small --flash-block-q 1024 --flash-block-k 256
 run gpt_small_blocks512q_b16 1800 1440 --model gpt-small --flash-block-q 512 --flash-block-k 256 --batch-size 16
 run gpt_small_ref_attn 1800 1440 --model gpt-small --attention reference
+# 4b. transformer fp8 act storage (round-5 feature: e4m3 attention
+#     context + branch deltas + gelu intermediates)
+run gpt_small_fp8 1800 1440 --model gpt-small --dtype fp8
 # 5. GQA retries with a wide compile window (part-1 failure mode: compile
 #    alone outlived the 780s watchdog AND the 1440s budget)
 run gpt_small_gqa4 3000 2700 --model gpt-small --kv-heads 4 --watchdog-secs 2400
