@@ -41,4 +41,9 @@ run gpt_small_rope_gqa_remat 3000 2700 --model gpt-small --pos-embedding rope --
 # 6. scale-up: medium at the best small-model blocks
 run gpt_medium_blocks512q 3000 2700 --model gpt-medium --flash-block-q 512 --flash-block-k 256 --watchdog-secs 2400
 run gpt_small_moe8 3000 2700 --model gpt-small --moe-experts 8 --watchdog-secs 2400
+# 7. trace-grade residual-bound analysis of the winning gpt config
+#    (cache-warmed by section 4, so this costs ~2 min of chip time);
+#    the per-category breakdown prints to the sweep log
+timeout 900 python scripts/profile_bench.py --model gpt-small \
+    --out /root/repo/gpt_trace_r05 2>&1 | tail -30 >&2 || true
 echo "sweep2 complete -> $OUT" >&2
