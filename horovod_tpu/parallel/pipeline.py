@@ -14,6 +14,19 @@ pipelined region (they are marginal at these widths); each stage holds
 only its ``num_layers / P`` blocks' weights.  Equivalence with the
 unsharded model — forward and gradients — is pinned by
 tests/test_pipeline.py.
+
+The schedule family (docs/pipeline.md):
+
+* :func:`pp_gpt_apply` — GPipe forward, full logits on every rank
+  (inference/eval, equivalence tests).
+* :func:`pp_gpt_loss` — training: stage-local head + token loss inside
+  the tick, ONE scalar psum rejoin, per-tick remat.
+* :func:`pp_gpt_loss_circular` — circular/interleaved groups: each
+  device holds ``circles`` non-contiguous layer groups and the stream
+  wraps the ring, shrinking the bubble ~``circles``x with no
+  masked-branch waste (the SPMD answer to 1F1B — see docs/pipeline.md).
+* :func:`pp_tp_gpt_loss` — TP-sharded blocks inside stages: the 3-axis
+  ``dp x pp x tp`` deployment shape.
 """
 
 from __future__ import annotations
