@@ -58,4 +58,12 @@ for ex in torch_mnist tf2_mnist keras_mnist adasum_small_model \
     JAX_PLATFORMS=cpu \
         python -m horovod_tpu.run -np 2 python "examples/$ex.py"
 done
+
+# single-process multi-device examples (in-process mesh, --cpu sets the
+# platform inside the process like tests/conftest.py)
+for argset in "--smoke --cpu" "--smoke --cpu --circles 2"; do
+    echo "== example smoke: pipeline_train $argset =="
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python examples/pipeline_train.py $argset
+done
 echo "matrix OK"
