@@ -24,6 +24,9 @@ from .moe import (  # noqa: F401
 from .pipeline import (  # noqa: F401
     pp_gpt_apply, pp_gpt_loss, pp_gpt_loss_circular, pp_tp_gpt_loss,
     stack_pp_params, stack_pp_params_circular, stack_tp_pp_params,
+    unstack_pp_params, unstack_pp_params_circular, unstack_tp_pp_params,
 )
 from .sync_batch_norm import SyncBatchNorm, sync_batch_stats  # noqa: F401
-from .tensor_parallel import stack_tp_params, tp_gpt_apply  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    stack_tp_params, tp_gpt_apply, unstack_tp_params,
+)

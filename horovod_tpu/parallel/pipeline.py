@@ -37,8 +37,10 @@ import numpy as np
 from jax import lax
 
 __all__ = ["stack_pp_params", "stack_pp_params_circular",
-           "stack_tp_pp_params", "pp_gpt_apply", "pp_gpt_loss",
-           "pp_gpt_loss_circular", "pp_tp_gpt_loss"]
+           "stack_tp_pp_params", "unstack_pp_params",
+           "unstack_pp_params_circular", "unstack_tp_pp_params",
+           "pp_gpt_apply", "pp_gpt_loss", "pp_gpt_loss_circular",
+           "pp_tp_gpt_loss"]
 
 
 def stack_pp_params(params, cfg, pp: int):
@@ -111,6 +113,77 @@ def stack_pp_params_circular(params, cfg, pp: int, circles: int):
         )
 
     return jax.tree_util.tree_map(_restack, staged), replicated
+
+
+def _check_staged_lead(staged, want: tuple, what: str):
+    """Loud mismatch guard for the unstack inverses: JAX index clamping
+    would otherwise turn a wrong pp/circles/tp into a silently
+    corrupted (correct-shaped!) checkpoint."""
+    got = jax.tree_util.tree_leaves(staged)[0].shape[:len(want)]
+    if tuple(got) != want:
+        raise ValueError(
+            f"staged leaves have leading dims {tuple(got)}, expected "
+            f"{want} ({what}) — unstacking with different factors than "
+            "the tree was stacked with"
+        )
+
+
+def unstack_pp_params(staged, replicated, cfg, pp: int):
+    """Inverse of :func:`stack_pp_params`: reassemble the canonical GPT
+    parameter pytree (``block{i}`` entries + embeddings/head) from the
+    staged tree — docs/inference.md's "unstack the leading dims"
+    instruction as code (round-trip pinned by tests/test_pipeline.py).
+    """
+    per = cfg.num_layers // pp
+    _check_staged_lead(staged, (pp, per), "pp, layers_per_stage")
+    out = dict(replicated)
+    for i in range(cfg.num_layers):
+        s, j = divmod(i, per)
+        out[f"block{i}"] = jax.tree_util.tree_map(
+            lambda a: a[s, j], staged
+        )
+    return out
+
+
+def unstack_pp_params_circular(staged, replicated, cfg, pp: int,
+                               circles: int):
+    """Inverse of :func:`stack_pp_params_circular` (layer
+    ``(v*pp + s)*per_group + j`` lives at ``staged[s, v, j]``)."""
+    per_group = cfg.num_layers // (pp * circles)
+    _check_staged_lead(staged, (pp, circles, per_group),
+                       "pp, circles, layers_per_group")
+    out = dict(replicated)
+    for i in range(cfg.num_layers):
+        g, j = divmod(i, per_group)
+        v, s = divmod(g, pp)
+        out[f"block{i}"] = jax.tree_util.tree_map(
+            lambda a: a[s, v, j], staged
+        )
+    return out
+
+
+def unstack_tp_pp_params(staged_sharded, staged_replicated, replicated,
+                         cfg, pp: int, tp: int):
+    """Inverse of :func:`stack_tp_pp_params`: per-block per-rank shards
+    are re-formed and handed to ``unstack_tp_params`` — a TP-in-PP
+    training state round-trips to the canonical checkpoint format."""
+    from .tensor_parallel import unstack_tp_params  # noqa: PLC0415
+
+    per = cfg.num_layers // pp
+    _check_staged_lead(staged_sharded, (pp, tp, per),
+                       "pp, tp, layers_per_stage")
+    _check_staged_lead(staged_replicated, (pp, per),
+                       "pp, layers_per_stage")
+    sharded, rep = {}, dict(replicated)
+    for i in range(cfg.num_layers):
+        s, j = divmod(i, per)
+        sharded[f"block{i}"] = jax.tree_util.tree_map(
+            lambda a: a[s, :, j], staged_sharded
+        )
+        rep[f"block{i}"] = jax.tree_util.tree_map(
+            lambda a: a[s, j], staged_replicated
+        )
+    return unstack_tp_params(sharded, rep, cfg, tp)
 
 
 def _dense_block(cfg, p, x, positions, rope_tabs):

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["stack_tp_params", "tp_gpt_apply"]
+__all__ = ["stack_tp_params", "unstack_tp_params", "tp_gpt_apply"]
 
 
 def _split_qkv_columns(kernel, bias, cfg, tp: int):
@@ -129,6 +129,70 @@ def stack_tp_params(params, cfg, tp: int):
     return to_jnp(sharded), to_jnp(replicated)
 
 
+
+
+def unstack_tp_params(sharded, replicated, cfg, tp: int):
+    """Inverse of :func:`stack_tp_params`: reassemble the canonical GPT
+    parameter pytree from the per-rank shards — the code behind
+    docs/inference.md's "invert the column/row splits" instruction, so a
+    TP-trained state round-trips to the single-device checkpoint format
+    (pinned by tests/test_tensor_parallel.py)."""
+    emb = cfg.emb_dim
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    qw, kw = emb // tp, kv_dim // tp
+    out = {k: v for k, v in replicated.items()
+           if not k.startswith("block")}
+    for name, blk in sharded.items():
+        lead = np.asarray(blk["qkv"]["kernel"]).shape[0]
+        if lead != tp:
+            # numpy slicing never goes out of bounds, so a wrong tp
+            # would reassemble a CORRECT-SHAPED but scrambled qkv
+            # kernel — fail loudly instead
+            raise ValueError(
+                f"{name} shards carry leading dim {lead}, expected "
+                f"tp={tp} — unstacking with a different tp than the "
+                "tree was stacked with"
+            )
+        rep_blk = replicated[name]
+        kern = np.asarray(blk["qkv"]["kernel"])  # [tp, emb, qw+2kw]
+        bias = np.asarray(blk["qkv"]["bias"])    # [tp, qw+2kw]
+        qkv_kernel = np.concatenate(
+            [np.concatenate(list(part), axis=1)
+             for part in (kern[:, :, :qw], kern[:, :, qw:qw + kw],
+                          kern[:, :, qw + kw:])],
+            axis=1,
+        )
+        qkv_bias = np.concatenate(
+            [np.concatenate(list(part))
+             for part in (bias[:, :qw], bias[:, qw:qw + kw],
+                          bias[:, qw + kw:])]
+        )
+        out[name] = {
+            "ln1": rep_blk["ln1"],
+            "ln2": rep_blk["ln2"],
+            "qkv": {"kernel": jnp.asarray(qkv_kernel),
+                    "bias": jnp.asarray(qkv_bias)},
+            "proj": {
+                # row-parallel: shards concatenate back on the input dim
+                "kernel": jnp.concatenate(
+                    list(blk["proj"]["kernel"]), axis=0
+                ),
+                "bias": rep_blk["proj_bias"],
+            },
+            "fc1": {
+                "kernel": jnp.concatenate(
+                    list(blk["fc1"]["kernel"]), axis=1
+                ),
+                "bias": jnp.concatenate(list(blk["fc1"]["bias"])),
+            },
+            "fc2": {
+                "kernel": jnp.concatenate(
+                    list(blk["fc2"]["kernel"]), axis=0
+                ),
+                "bias": rep_blk["fc2_bias"],
+            },
+        }
+    return out
 
 
 def _gpt_embed(rep, cfg, tokens, pos_offset, positions):

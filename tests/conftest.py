@@ -76,3 +76,16 @@ def _world():
     assert jax.device_count() == 8, "virtual CPU mesh failed to materialize"
     yield
     hvd.shutdown()
+
+
+def assert_trees_equal(got, want):
+    """Exact-equality pytree comparison shared by the param-layout
+    round-trip tests (pipeline/tensor-parallel unstackers)."""
+    import numpy as _np
+
+    jax.tree_util.tree_map(
+        lambda g, w: _np.testing.assert_array_equal(
+            _np.asarray(g), _np.asarray(w)
+        ),
+        got, want,
+    )

@@ -362,3 +362,44 @@ def test_pp_validation_errors():
     params = model.init(jax.random.PRNGKey(0), _tokens())
     with pytest.raises(Exception, match="microbatches"):
         _pp_fwd(model, params, _tokens(b=3), microbatches=2)
+
+
+def test_unstack_round_trips():
+    """stack -> unstack is the identity for all three param layouts —
+    the docs/inference.md reconstruction path as code — and unstacking
+    with the WRONG factors raises instead of silently corrupting (JAX
+    index clamping would otherwise produce a correct-shaped garbage
+    checkpoint)."""
+    from conftest import assert_trees_equal
+    from horovod_tpu.parallel.pipeline import (
+        stack_tp_pp_params, unstack_pp_params,
+        unstack_pp_params_circular, unstack_tp_pp_params,
+    )
+
+    model = _model()  # 4 layers
+    params = model.init(jax.random.PRNGKey(7), _tokens())["params"]
+
+    staged, rep = stack_pp_params({"params": params}, model.cfg, PP)
+    assert_trees_equal(
+        unstack_pp_params(staged, rep, model.cfg, PP), params
+    )
+    with pytest.raises(ValueError, match="leading dims"):
+        unstack_pp_params(staged, rep, model.cfg, 2)
+
+    staged, rep = stack_pp_params_circular(
+        {"params": params}, model.cfg, 2, 2
+    )
+    assert_trees_equal(
+        unstack_pp_params_circular(staged, rep, model.cfg, 2, 2), params
+    )
+    with pytest.raises(ValueError, match="leading dims"):
+        unstack_pp_params_circular(staged, rep, model.cfg, 2, 1)
+
+    st_sh, st_rep, rep = stack_tp_pp_params(
+        {"params": params}, model.cfg, 2, 2
+    )
+    assert_trees_equal(
+        unstack_tp_pp_params(st_sh, st_rep, rep, model.cfg, 2, 2), params
+    )
+    with pytest.raises(ValueError, match="leading dims"):
+        unstack_tp_pp_params(st_sh, st_rep, rep, model.cfg, 4, 2)

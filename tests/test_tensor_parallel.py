@@ -188,3 +188,22 @@ def test_tp_divisibility_errors():
     params = model.init(jax.random.PRNGKey(0), _tokens())
     with pytest.raises(ValueError, match="must divide num_heads"):
         stack_tp_params(params, model.cfg, 3)
+
+
+def test_unstack_tp_round_trips():
+    """stack_tp_params -> unstack_tp_params is the identity (the
+    docs/inference.md column/row-split inversion as code); a wrong tp
+    raises instead of reassembling a correct-shaped scrambled kernel."""
+    import pytest
+    from conftest import assert_trees_equal
+    from horovod_tpu.parallel.tensor_parallel import unstack_tp_params
+
+    model = _model()
+    params = model.init(jax.random.PRNGKey(8), _tokens())["params"]
+    sharded, replicated = stack_tp_params({"params": params},
+                                          model.cfg, 2)
+    assert_trees_equal(
+        unstack_tp_params(sharded, replicated, model.cfg, 2), params
+    )
+    with pytest.raises(ValueError, match="leading dim"):
+        unstack_tp_params(sharded, replicated, model.cfg, 4)
