@@ -87,7 +87,7 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                    attention: str = "flash", remat: bool = False,
                    flash_block_q: int = 512, flash_block_k: int = 256,
                    kv_heads: int = 0, pos_embedding: str = "learned",
-                   moe_experts: int = 0):
+                   moe_experts: int = 0, attention_window: int = 0):
     """GPT causal-LM training step (flash attention) — the long-context
     counterpart of the ResNet bench.  Returns ``(step, state, static)``
     like ``build_step``; throughput is reported in tokens/sec/chip."""
@@ -110,7 +110,8 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                 flash_block_q=flash_block_q, flash_block_k=flash_block_k,
                 num_kv_heads=kv_heads or None,
                 pos_embedding=pos_embedding, moe_experts=moe_experts,
-                act_store_dtype=act_store)
+                act_store_dtype=act_store,
+                attention_window=attention_window or None)
     vocab = model.cfg.vocab_size
 
     global_batch = batch_size * n_chips
@@ -411,6 +412,10 @@ def main() -> int:
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="replace gpt MLPs with this many experts "
                         "(0 = dense); aux loss folded into the objective")
+    parser.add_argument("--attention-window", type=int, default=0,
+                        help="sliding-window attention (last W keys; "
+                        "0 = full causal); flash-only, banded tiles "
+                        "skipped in fwd+bwd")
     parser.add_argument("--iters", type=int, default=10,
                         help="timed steps (the medium is +-3% run-to-run; "
                         "more iters buys nothing but window risk)")
@@ -462,6 +467,7 @@ def main() -> int:
                 flash_block_k=args.flash_block_k,
                 kv_heads=args.kv_heads, pos_embedding=args.pos_embedding,
                 moe_experts=args.moe_experts,
+                attention_window=args.attention_window,
             )
             carry, const = state[:-1], state[-1:]
         else:

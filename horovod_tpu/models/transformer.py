@@ -55,6 +55,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     attention_impl: str = "flash"  # flash | reference | ring | ulysses | zigzag
     sp_axis: Optional[str] = None  # mesh axis for ring/ulysses/zigzag
+    # Mistral-style sliding window (each position sees its last W keys,
+    # self included).  Flash-kernel-only: the banded tiles are skipped in
+    # fwd AND bwd, so attention compute scales with S*W instead of S^2.
+    attention_window: Optional[int] = None
     # "learned" = wpe table (GPT-2 style); "rope" = rotary, driven by the
     # explicit per-token position vector, so it composes with ANY sequence
     # layout (contiguous or zigzag shards).
@@ -124,6 +128,12 @@ def _attend(cfg: TransformerConfig, q, k, v, positions):
         return flash_attention(
             q, k, v, causal=True,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            window=cfg.attention_window,
+        )
+    if cfg.attention_window is not None:
+        raise ValueError(
+            "attention_window is flash-only; "
+            f"attention_impl={cfg.attention_impl!r} does not support it"
         )
     if cfg.kv_heads != cfg.num_heads and cfg.attention_impl in (
         "reference", "ulysses"

@@ -54,6 +54,7 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 256,
+    window: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over ``[batch, seq, heads, head_dim]`` inputs.
@@ -61,6 +62,13 @@ def flash_attention(
     Differentiable; numerically matches
     :func:`horovod_tpu.parallel.local_attention` to fp32 tolerance.
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU.
+
+    ``window=W`` (requires ``causal=True``) restricts each position to
+    its last ``W`` keys (self included) — Mistral-style sliding-window
+    attention.  Tiles entirely outside the band are SKIPPED in forward
+    and backward (the same mechanism as the causal upper-triangle skip),
+    so compute scales with ``S*W``, not ``S^2``; ``W >= S`` degenerates
+    to plain causal.
     """
     b, s, h, d = q.shape
     if k.shape != v.shape:
@@ -75,6 +83,13 @@ def flash_attention(
             "batch/seq/head_dim must match and num_heads must be a "
             "multiple of num_kv_heads (MQA/GQA)"
         )
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window >= s:
+            window = None  # full causal; skip/mask logic not needed
     scale_ = scale if scale is not None else d ** -0.5
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
@@ -87,27 +102,30 @@ def flash_attention(
         b * x.shape[2], s, d
     )
     out = _flash(fold(q), fold(k), fold(v), causal, scale_, bq, bk,
-                 h, hkv, bool(interpret))
+                 h, hkv, window, bool(interpret))
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, causal, scale, bq, bk, h, hkv, window, interpret):
     o, _ = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv,
-                             interpret)
+                             window, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
+def _flash_fwd(q, k, v, causal, scale, bq, bk, h, hkv, window,
+               interpret):
     o, lse = _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv,
-                               interpret)
+                               window, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, bq, bk, h, hkv, interpret, res, do):
+def _flash_bwd(causal, scale, bq, bk, h, hkv, window, interpret, res,
+               do):
     q, k, v, o, lse = res
     return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
-                             h, hkv, interpret)
+                             h, hkv, window, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -118,7 +136,8 @@ def _kv_row(zi, h: int, hkv: int):
     return (zi // h) * hkv + (zi % h) // (h // hkv)
 
 
-def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
+def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv, window,
+                      interpret):
     """Returns (o [Z,S,D], lse [Z,S]) with Z = batch*heads.
 
     K tiles live on the innermost grid dimension, so only (1, bk, d) of K
@@ -146,9 +165,14 @@ def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
             m_ref[...] = jnp.full_like(m_ref, NEG_INF)
             l_ref[...] = jnp.zeros_like(l_ref)
 
-        # Causal: K tiles strictly above the diagonal contribute nothing —
-        # skip their compute entirely (their DMA is pipelined regardless).
+        # Causal: K tiles strictly above the diagonal contribute
+        # nothing; with a window, tiles entirely below the band are dead
+        # too — skip both (their DMA is pipelined regardless).
         needed = (j * bk <= (i + 1) * bq - 1) if causal else (j >= 0)
+        if window is not None:
+            needed = jnp.logical_and(
+                needed, (j + 1) * bk - 1 >= i * bq - (window - 1)
+            )
 
         @pl.when(needed)
         def _compute():
@@ -164,6 +188,9 @@ def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
                     jnp.int32, (bq, bk), 1
                 )
                 st = jnp.where(k_pos > q_pos, NEG_INF, st)
+                if window is not None:
+                    st = jnp.where(k_pos < q_pos - (window - 1),
+                                   NEG_INF, st)
             m_prev = m_ref[...]                       # [bq, LANES], lanes equal
             m_new = jnp.maximum(m_prev, st.max(-1)[:, None])
             p = jnp.exp(st - m_new[:, :1])
@@ -211,7 +238,7 @@ def _flash_fwd_kernel(q, k, v, causal, scale, bq, bk, h, hkv, interpret):
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
-                      h, hkv, interpret):
+                      h, hkv, window, interpret):
     """Fused Pallas flash backward: two passes, both tiled, both skipping
     fully-masked causal blocks (the scan fallback below computes the whole
     upper triangle and streams O(S*bk) score tiles through HBM — on a
@@ -255,6 +282,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             p = jnp.where(k_pos > q_pos, 0.0, p)
+            if window is not None:
+                p = jnp.where(k_pos < q_pos - (window - 1), 0.0, p)
         dp = jnp.dot(dob, vb.T, preferred_element_type=f32)
         ds = p * (dp - delta_col)
         return qb, kb, dob, p, ds
@@ -270,8 +299,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             dk_acc[...] = jnp.zeros_like(dk_acc)
             dv_acc[...] = jnp.zeros_like(dv_acc)
 
-        # Q tiles entirely above the diagonal see only masked scores.
+        # Q tiles entirely above the diagonal see only masked scores;
+        # with a window, Q tiles entirely past the band do too.
         needed = ((i + 1) * bq - 1 >= j * bk) if causal else (i >= 0)
+        if window is not None:
+            needed = jnp.logical_and(
+                needed, (j + 1) * bk - 1 >= i * bq - (window - 1)
+            )
 
         @pl.when(needed)
         def _compute():
@@ -297,6 +331,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
             dq_acc[...] = jnp.zeros_like(dq_acc)
 
         needed = (j * bk <= (i + 1) * bq - 1) if causal else (j >= 0)
+        if window is not None:
+            needed = jnp.logical_and(
+                needed, (j + 1) * bk - 1 >= i * bq - (window - 1)
+            )
 
         @pl.when(needed)
         def _compute():
@@ -367,7 +405,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, bq, bk,
     return dq, dk, dv
 
 
-def _flash_bwd_blockwise(q, k, v, o, lse, do, causal, scale, bk):
+def _flash_bwd_blockwise(q, k, v, o, lse, do, causal, scale, bk,
+                         window=None):
     """Blockwise flash backward (pure JAX scan over K tiles) — kept as the
     differential reference for the Pallas backward (tests pin equality)
     and as a debugging fallback.
@@ -387,6 +426,11 @@ def _flash_bwd_blockwise(q, k, v, o, lse, do, causal, scale, bk):
         if causal:
             k_pos = j * bk + jnp.arange(bk)
             p = jnp.where(k_pos[None, :] > q_pos[:, None], 0.0, p)
+            if window is not None:
+                p = jnp.where(
+                    k_pos[None, :] < q_pos[:, None] - (window - 1),
+                    0.0, p,
+                )
         dp = jnp.einsum("zqd,zkd->zqk", dof, vb)
         ds = p * (dp - delta[..., None])
         dq = dq + jnp.einsum("zqk,zkd->zqd", ds, kb) * scale

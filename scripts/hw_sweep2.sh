@@ -34,6 +34,12 @@ run gpt_small_ref_attn 1800 1440 --model gpt-small --attention reference
 # 4b. transformer fp8 act storage (round-5 feature: e4m3 attention
 #     context + branch deltas + gelu intermediates)
 run gpt_small_fp8 1800 1440 --model gpt-small --dtype fp8
+# 4c. sliding-window attention (round-5 feature: banded tiles skipped
+#     fwd+bwd).  128x128 tiles on purpose: W=256 at seq 1024 then skips
+#     21/36 causal tiles (58%) — at the default 512x256 tiles the band
+#     only removes 1/6 and measures nothing.  Compare vs gpt_small_base
+#     (also 128x128, part-1: 57.5k tok/s).
+run gpt_small_window256 1800 1440 --model gpt-small --attention-window 256 --flash-block-q 128 --flash-block-k 128
 # 5. GQA retries with a wide compile window (part-1 failure mode: compile
 #    alone outlived the 780s watchdog AND the 1440s budget)
 run gpt_small_gqa4 3000 2700 --model gpt-small --kv-heads 4 --watchdog-secs 2400
