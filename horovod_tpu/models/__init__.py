@@ -9,5 +9,8 @@ params, channel sizes that tile onto the 128x128 MXU."""
 from .inception import InceptionV3  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
 from .simple import MLP, ConvNet  # noqa: F401
+from .decode import (  # noqa: F401
+    decode_step, generate, init_cache, prefill,
+)
 from .transformer import GPT, GPT_CONFIGS, TransformerConfig, gpt  # noqa: F401
 from .vgg import VGG16, VGG19  # noqa: F401

@@ -1,0 +1,157 @@
+"""KV-cache incremental decoding for the GPT family.
+
+The reference framework is not in the serving path (docs/inference.md,
+≙ ref docs/inference.rst) — but its model zoo still has to be *usable*
+for generation, so the GPT family ships a functional decode path:
+
+* :func:`init_cache` — per-layer K/V buffers ``[L, b, max_len, kv_heads,
+  head_dim]`` plus the write position.
+* :func:`decode_step` — one token for every sequence in the batch:
+  append its K/V, attend the single query against the cache prefix,
+  return next-token logits.  O(max_len) per step instead of the
+  O(S^2) full forward.
+* :func:`prefill` — feed a prompt through ``decode_step`` under
+  ``lax.scan`` (one compiled loop), returning per-position logits and
+  the filled cache.
+* :func:`generate` — greedy continuation, one ``lax.scan`` over steps.
+
+The block wiring is NOT re-implemented here: each step runs
+``raw_block_forward`` (the single-source :func:`block_math`) with an
+``attend`` override that appends to the cache and attends the single
+query against the prefix — so rope, GQA head routing, fp8 activation
+storage, and any future block change flow into decoding automatically.
+Equivalence with the full (training) forward — logits at every prompt
+position and greedy continuations token-for-token — is pinned by
+tests/test_decode.py.
+
+Dense blocks only (MoE is training-path-only, parallel/moe.py).
+Decoding past the cache end poisons the logits with NaN (the same
+loud-failure contract as the out-of-range wpe gather in
+``GPT.__call__``) instead of silently overwriting the last slot.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import TransformerConfig, raw_block_forward
+
+__all__ = ["init_cache", "decode_step", "prefill", "generate"]
+
+
+def _params(params):
+    if set(params.keys()) == {"params"}:
+        params = params["params"]
+    return params
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len=None):
+    """Empty decode state: per-layer K/V at the cache dtype + position."""
+    if cfg.moe_experts > 0:
+        raise ValueError("decode cache supports dense blocks only")
+    s = max_len or cfg.max_len
+    kv = (cfg.num_layers, batch, s, cfg.kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(kv, cfg.dtype),
+        "v": jnp.zeros(kv, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attend_cached(cfg, q, k_cache, v_cache, pos):
+    """One query against the cache prefix: ``q [b, h, hd]``,
+    ``k/v_cache [b, S, hkv, hd]`` -> ``[b, h, hd]``.  Unwritten
+    positions (> pos) are masked; with ``cfg.attention_window`` the
+    band's lower edge is masked too (parity with the flash kernel's
+    sliding window); GQA queries fold onto their kv group via reshape,
+    no K/V broadcast."""
+    b, h, hd = q.shape
+    s = k_cache.shape[1]
+    group = h // cfg.kv_heads
+    qg = q.reshape(b, cfg.kv_heads, group, hd).astype(jnp.float32)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    st = jnp.einsum("bkgd,bskd->bkgs", qg, kf) * (hd ** -0.5)
+    idx = jnp.arange(s)[None, None, None, :]
+    mask = idx > pos
+    if cfg.attention_window is not None:
+        mask = mask | (idx < pos - (cfg.attention_window - 1))
+    st = jnp.where(mask, jnp.finfo(jnp.float32).min / 2, st)
+    p = jax.nn.softmax(st, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", p, vf)
+    return out.reshape(b, h, hd)
+
+
+def decode_step(cfg: TransformerConfig, params, cache, tokens_t):
+    """Decode one token per sequence: ``tokens_t [b]`` ->
+    ``(logits [b, vocab], cache)`` with the token's K/V appended at
+    ``cache["pos"]``."""
+    from ..parallel.tensor_parallel import (  # noqa: PLC0415
+        _gpt_embed, _gpt_head,
+    )
+
+    p = _params(params)
+    pos = cache["pos"]
+    s_cache = cache["k"].shape[2]
+    # shared scaffold: wte + wpe (NaN fill past max_len) / rope tables
+    # at the explicit position
+    x, positions, rope_tabs = _gpt_embed(
+        p, cfg, tokens_t[:, None], 0, pos[None]
+    )
+
+    k_new, v_new = cache["k"], cache["v"]
+    for i in range(cfg.num_layers):
+
+        def attend(q, k_t, v_t, _i=i):
+            # q [b, 1, nh, hd]; k_t/v_t [b, 1, nkv, hd], rope-applied by
+            # block_math — append, then attend against the prefix
+            nonlocal k_new, v_new
+            k_new = lax.dynamic_update_slice(
+                k_new, k_t.astype(cfg.dtype)[None], (_i, 0, pos, 0, 0)
+            )
+            v_new = lax.dynamic_update_slice(
+                v_new, v_t.astype(cfg.dtype)[None], (_i, 0, pos, 0, 0)
+            )
+            att = _attend_cached(cfg, q[:, 0], k_new[_i], v_new[_i], pos)
+            return att[:, None]
+
+        x = raw_block_forward(cfg, p[f"block{i}"], x, positions,
+                              rope_tabs, attend=attend)
+
+    logits = _gpt_head(p, cfg, x)[:, 0]
+    # past the cache end the write index would CLAMP (silently
+    # overwriting the last slot) — poison instead, like the wpe gather
+    logits = jnp.where(pos >= s_cache, jnp.nan, logits)
+    return logits, {"k": k_new, "v": v_new, "pos": pos + 1}
+
+
+def prefill(cfg: TransformerConfig, params, tokens, max_len=None):
+    """Feed a prompt ``[b, s]``: per-position logits ``[b, s, vocab]``
+    and the filled cache, as one scanned decode loop."""
+    b, s = tokens.shape
+    cache = init_cache(cfg, b, max_len)
+
+    def step(cache, tok_t):
+        logits, cache = decode_step(cfg, params, cache, tok_t)
+        return cache, logits
+
+    cache, logits = lax.scan(step, cache, tokens.T)
+    return jnp.transpose(logits, (1, 0, 2)), cache
+
+
+def generate(cfg: TransformerConfig, params, prompt, steps: int,
+             max_len=None):
+    """Greedy continuation: ``prompt [b, s]`` -> ``[b, steps]`` tokens."""
+    logits, cache = prefill(cfg, params, prompt, max_len)
+    first = jnp.argmax(logits[:, -1], axis=-1)
+
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = decode_step(cfg, params, cache, tok)
+        nxt = jnp.argmax(logits, axis=-1)
+        return (cache, nxt), tok
+
+    (_, _), toks = lax.scan(step, (cache, first), None, length=steps)
+    return toks.T

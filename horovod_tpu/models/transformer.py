@@ -191,7 +191,8 @@ def act_store(y, cfg: TransformerConfig):
 def block_math(cfg: TransformerConfig, x, positions, rope_tabs, *,
                ln1, qkv, proj, ln2, mlp,
                num_heads: Optional[int] = None,
-               num_kv_heads: Optional[int] = None):
+               num_kv_heads: Optional[int] = None,
+               attend=None):
     """THE pre-LN transformer block wiring — the single source of truth.
 
     ``LN → qkv → split-heads → rope → attend → proj(+res) → LN →
@@ -206,7 +207,12 @@ def block_math(cfg: TransformerConfig, x, positions, rope_tabs, *,
     tensor-parallel closures); ``proj`` and ``mlp`` return the residual
     DELTA (this function adds it to the stream).  ``num_heads`` /
     ``num_kv_heads`` override the config's head counts for callers
-    operating on a per-rank head shard (TP).
+    operating on a per-rank head shard (TP).  ``attend`` overrides the
+    attention schedule itself: a callable ``(q, k, v) -> att`` over the
+    rope-applied ``[b, s, heads, head_dim]`` tensors — the KV-cache
+    decode path (models/decode.py) supplies one that appends to its
+    cache and attends the single query against the prefix, so decoding
+    reuses THIS wiring instead of a third copy.
     """
     b, s, _ = x.shape
     nh = num_heads if num_heads is not None else cfg.num_heads
@@ -225,14 +231,16 @@ def block_math(cfg: TransformerConfig, x, positions, rope_tabs, *,
 
         q = apply_rope_tables(q, *rope_tabs)
         k = apply_rope_tables(k, *rope_tabs)
-    attend_cfg = cfg
-    if nh != cfg.num_heads or nkv != cfg.kv_heads:
-        # per-rank head shard: _attend must see the LOCAL head geometry
-        attend_cfg = replace(cfg, num_heads=nh, num_kv_heads=nkv,
-                             emb_dim=q_dim)
-    att = act_store(
-        _attend(attend_cfg, q, k, v, positions).reshape(b, s, q_dim), cfg
-    )
+    if attend is None:
+        attend_cfg = cfg
+        if nh != cfg.num_heads or nkv != cfg.kv_heads:
+            # per-rank head shard: _attend sees the LOCAL head geometry
+            attend_cfg = replace(cfg, num_heads=nh, num_kv_heads=nkv,
+                                 emb_dim=q_dim)
+        att_4d = _attend(attend_cfg, q, k, v, positions)
+    else:
+        att_4d = attend(q, k, v)
+    att = act_store(att_4d.reshape(b, s, q_dim), cfg)
     x = x + act_store(proj(att), cfg)
     return x + act_store(mlp(ln2(x)), cfg)
 
@@ -256,12 +264,14 @@ def raw_dense(sub, dtype):
         + sub["bias"].astype(dtype)
 
 
-def raw_block_forward(cfg: TransformerConfig, p, x, positions, rope_tabs):
+def raw_block_forward(cfg: TransformerConfig, p, x, positions, rope_tabs,
+                      attend=None):
     """One dense transformer block from a raw ``Block`` weight subtree
     ``p`` (keys ``ln1/qkv/proj/ln2/fc1/fc2``) — :func:`block_math` with
     plain-matmul closures.  Used by the pipeline-parallel stage body
-    (``parallel/pipeline.py``); numerically equivalent to the flax
-    :class:`Block` (pinned by tests/test_pipeline.py)."""
+    (``parallel/pipeline.py``) and, with an ``attend`` override, the
+    KV-cache decode path (models/decode.py); numerically equivalent to
+    the flax :class:`Block` (pinned by tests/test_pipeline.py)."""
     dt = cfg.dtype
 
     def mlp(h):
@@ -275,6 +285,7 @@ def raw_block_forward(cfg: TransformerConfig, p, x, positions, rope_tabs):
         proj=raw_dense(p["proj"], dt),
         ln2=lambda h: raw_layer_norm(h, p["ln2"]["scale"], p["ln2"]["bias"]),
         mlp=mlp,
+        attend=attend,
     )
 
 
