@@ -142,16 +142,37 @@ def prefill(cfg: TransformerConfig, params, tokens, max_len=None):
 
 
 def generate(cfg: TransformerConfig, params, prompt, steps: int,
-             max_len=None):
-    """Greedy continuation: ``prompt [b, s]`` -> ``[b, steps]`` tokens."""
-    logits, cache = prefill(cfg, params, prompt, max_len)
-    first = jnp.argmax(logits[:, -1], axis=-1)
+             max_len=None, temperature: float = 0.0, top_k: int = 0,
+             key=None):
+    """Continuation: ``prompt [b, s]`` -> ``[b, steps]`` tokens.
 
-    def step(carry, _):
+    ``temperature == 0`` (default) is greedy argmax.  ``temperature > 0``
+    samples ``softmax(logits / temperature)`` (requires ``key``);
+    ``top_k > 0`` additionally truncates to the k most likely tokens
+    before sampling."""
+    if temperature > 0 and key is None:
+        raise ValueError("temperature > 0 requires a PRNG key")
+
+    def pick(logits, k):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1)
+        lt = logits / temperature
+        if top_k > 0:
+            kth = lax.top_k(lt, top_k)[0][..., -1:]
+            lt = jnp.where(lt < kth, -jnp.inf, lt)
+        return jax.random.categorical(k, lt, axis=-1)
+
+    keys = (
+        jax.random.split(key, steps + 1) if key is not None
+        else jnp.zeros((steps + 1, 2), jnp.uint32)
+    )
+    logits, cache = prefill(cfg, params, prompt, max_len)
+    first = pick(logits[:, -1], keys[0])
+
+    def step(carry, k):
         cache, tok = carry
         logits, cache = decode_step(cfg, params, cache, tok)
-        nxt = jnp.argmax(logits, axis=-1)
-        return (cache, nxt), tok
+        return (cache, pick(logits, k)), tok
 
-    (_, _), toks = lax.scan(step, (cache, first), None, length=steps)
+    (_, _), toks = lax.scan(step, (cache, first), keys[1:])
     return toks.T

@@ -127,3 +127,25 @@ def test_decode_past_cache_end_poisons():
     logits, _ = decode_step(model.cfg, params, cache,
                             prompt[:, 0])  # pos == cache size
     assert not np.isfinite(np.asarray(logits)).any()
+
+
+def test_sampled_generation():
+    """Sampling: reproducible under a fixed key, top_k=1 degenerates to
+    greedy, temperature>0 without a key raises."""
+    model = _model()
+    prompt = _prompt(model, s=6, seed=6)
+    params = model.init(jax.random.PRNGKey(5), prompt)
+
+    key = jax.random.PRNGKey(7)
+    a = generate(model.cfg, params, prompt, 5, temperature=1.0, key=key)
+    b = generate(model.cfg, params, prompt, 5, temperature=1.0, key=key)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (np.asarray(a) < model.cfg.vocab_size).all()
+
+    greedy = generate(model.cfg, params, prompt, 5)
+    topk1 = generate(model.cfg, params, prompt, 5, temperature=0.5,
+                     top_k=1, key=key)
+    np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
+
+    with pytest.raises(ValueError, match="requires a PRNG key"):
+        generate(model.cfg, params, prompt, 5, temperature=1.0)
