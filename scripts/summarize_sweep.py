@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Summarize hw_sweep results (JSONL from scripts/hw_sweep*.sh):
+
+* a markdown table (config, value, unit, MFU) ready for
+  docs/performance.md,
+* replication medians ± spread for any config family with reps
+  (``<name>_rep<N>`` rows fold into one median row),
+* the fp8-vs-bf16 ratio when both medians exist.
+
+Usage: python scripts/summarize_sweep.py results.jsonl [more.jsonl ...]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import statistics
+import sys
+from collections import defaultdict
+
+
+def load(paths):
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    return rows
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    rows = load(sys.argv[1:])
+
+    reps = defaultdict(list)
+    singles = []
+    for row in rows:
+        r = row["result"]
+        if r is None:
+            singles.append((row["config"], None))
+            continue
+        m = re.fullmatch(r"(.*)_rep\d+", row["config"])
+        if m:
+            reps[m.group(1)].append(r)
+        else:
+            singles.append((row["config"], r))
+
+    print("| Config | value | unit | MFU |")
+    print("|---|---|---|---|")
+    for name, r in singles:
+        if r is None:
+            print(f"| {name} | (no result) | | |")
+        else:
+            print(f"| {name} | {r['value']:,} | {r['unit']} "
+                  f"| {r.get('mfu')} |")
+    medians = {}
+    for name, results in sorted(reps.items()):
+        vals = [r["value"] for r in results]
+        med = statistics.median(vals)
+        medians[name] = med
+        spread = (max(vals) - min(vals)) / med * 100 if med else 0
+        print(f"| {name} (median of {len(vals)}) | {med:,} "
+              f"| {results[0]['unit']} ± {spread:.1f}% "
+              f"| {statistics.median(r.get('mfu') or 0 for r in results)} |")
+
+    fp8 = next((v for k, v in medians.items() if "fp8" in k), None)
+    bf16 = next((v for k, v in medians.items()
+                 if "bf16" in k and "fp8" not in k), None)
+    if fp8 and bf16:
+        print(f"\nfp8 / bf16 median ratio: {fp8 / bf16:.4f} "
+              f"({(fp8 / bf16 - 1) * 100:+.1f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
