@@ -93,6 +93,7 @@ def __getattr__(name):
         "Store",
         "LocalStore",
         "save_checkpoint",
+        "save_checkpoint_async",
         "restore_checkpoint",
         "latest_checkpoint_step",
     ):

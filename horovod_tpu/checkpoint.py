@@ -33,6 +33,8 @@ __all__ = [
     "Store",
     "LocalStore",
     "save_checkpoint",
+    "save_checkpoint_async",
+    "AsyncSave",
     "restore_checkpoint",
     "latest_checkpoint_step",
 ]
@@ -139,7 +141,7 @@ def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:010d}")
 
 
-def _rank0_checkpointer():
+def _rank0_checkpointer(async_: bool = False):
     """An orbax checkpointer that only involves THIS process.
 
     Orbax's default checkpointers run global barriers across every jax
@@ -147,12 +149,16 @@ def _rank0_checkpointer():
     pattern — ranks != 0 never enter save().  Restricting
     active_processes={me} keeps orbax's atomicity/async machinery without
     the cross-process sync; our own engine barrier provides the job-wide
-    ordering instead.
+    ordering instead.  ``async_=True`` forces the AsyncCheckpointer even
+    single-process (the background-write path of
+    :func:`save_checkpoint_async`).
     """
     import orbax.checkpoint as ocp  # noqa: PLC0415
 
     me = jax.process_index()
     if jax.process_count() <= 1:
+        if async_:
+            return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         return ocp.StandardCheckpointer()
     return ocp.AsyncCheckpointer(
         ocp.StandardCheckpointHandler(),
@@ -179,27 +185,81 @@ def save_checkpoint(
     ``keep``: retain at most this many newest step directories (>= 1).
     Returns the step directory path.
     """
+    return save_checkpoint_async(directory, state, step,
+                                 keep=keep).wait()
+
+
+class AsyncSave:
+    """Handle for an in-flight :func:`save_checkpoint_async`.
+
+    ``wait()`` finalizes the save — rank 0 blocks until orbax's
+    background write commits, applies retention, and closes the
+    checkpointer; every rank then passes the job barrier.  Call it
+    before the next save to the same directory (or at shutdown); until
+    then training steps overlap the checkpoint I/O.
+    """
+
+    def __init__(self, path, ckptr=None, directory=None, keep=None):
+        self.path = path
+        self._ckptr = ckptr
+        self._directory = directory
+        self._keep = keep
+        self._finalized = False
+
+    def wait(self) -> str:
+        if self._finalized:
+            return self.path
+        try:
+            if self._ckptr is not None:  # rank 0
+                self._ckptr.wait_until_finished()
+                self._ckptr.close()
+                if self._keep is not None:
+                    steps = sorted(_list_step_dirs(self._directory))
+                    for old in steps[: max(len(steps) - self._keep, 0)]:
+                        shutil.rmtree(_step_dir(self._directory, old),
+                                      ignore_errors=True)
+        finally:
+            # a failed background write must still release the peers:
+            # without the barrier in the finally, ranks != 0 (whose
+            # handles have no checkpointer) would block forever while
+            # rank 0 raises
+            _barrier()
+            self._finalized = True
+        return self.path
+
+
+def save_checkpoint_async(
+    directory: str,
+    state: Any,
+    step: int,
+    *,
+    keep: Optional[int] = None,
+) -> AsyncSave:
+    """:func:`save_checkpoint` without stalling the training loop.
+
+    Rank 0 hands the pytree to an orbax ``AsyncCheckpointer`` (device
+    arrays are snapshotted, then written by a background thread) and
+    returns immediately; the returned handle's ``wait()`` is the commit
+    point — retention and the job-wide barrier happen there, so the
+    reference's rank-0-writes/all-ranks-sync contract still holds, just
+    deferred.  The TPU-native goodput move the reference has no analog
+    for: steps keep running while the checkpoint streams out.
+    """
     if keep is not None and keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
     path = _step_dir(directory, step)
-    if rank() == 0:
-        os.makedirs(directory, exist_ok=True)
-        ckptr = _rank0_checkpointer()
-        # orbax refuses to overwrite; force=True matches the reference's
-        # framework-checkpoint overwrite behavior on re-save of a step.
-        ckptr.save(
-            os.path.abspath(path),
-            jax.tree_util.tree_map(np.asarray, state),
-            force=True,
-        )
-        ckptr.wait_until_finished()
-        ckptr.close()
-        if keep is not None:
-            steps = sorted(_list_step_dirs(directory))
-            for old in steps[: max(len(steps) - keep, 0)]:
-                shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
-    _barrier()
-    return path
+    if rank() != 0:
+        return AsyncSave(path)
+    os.makedirs(directory, exist_ok=True)
+    ckptr = _rank0_checkpointer(async_=True)
+    # orbax refuses to overwrite; force=True matches the reference's
+    # framework-checkpoint overwrite behavior on re-save of a step.
+    ckptr.save(
+        os.path.abspath(path),
+        jax.tree_util.tree_map(np.asarray, state),
+        force=True,
+    )
+    return AsyncSave(path, ckptr, directory, keep)
 
 
 def _list_step_dirs(directory: str):

@@ -63,9 +63,10 @@ def main() -> int:
         med = statistics.median(vals)
         medians[name] = med
         spread = (max(vals) - min(vals)) / med * 100 if med else 0
+        mfus = [r["mfu"] for r in results if r.get("mfu") is not None]
+        mfu = statistics.median(mfus) if mfus else ""
         print(f"| {name} (median of {len(vals)}) | {med:,} "
-              f"| {results[0]['unit']} ± {spread:.1f}% "
-              f"| {statistics.median(r.get('mfu') or 0 for r in results)} |")
+              f"| {results[0]['unit']} ± {spread:.1f}% | {mfu} |")
 
     fp8 = next((v for k, v in medians.items() if "fp8" in k), None)
     bf16 = next((v for k, v in medians.items()

@@ -3,6 +3,7 @@ broadcast-on-start; Store mirrors horovod/spark/common/store.py)."""
 
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -102,3 +103,43 @@ def test_local_store_metadata_and_paths(tmp_path):
     assert not any(p.endswith(".tmp") for p in os.listdir(
         os.path.dirname(store.metadata_path("run1"))
     ))
+
+
+def test_async_save_restore_roundtrip(tmp_path):
+    """save_checkpoint_async returns before commit; wait() is the
+    commit point, after which restore sees the same pytree as a sync
+    save would."""
+    from horovod_tpu.checkpoint import save_checkpoint_async
+
+    state = _state(3)
+    handle = save_checkpoint_async(str(tmp_path), state, step=1)
+    path = handle.wait()
+    assert path.endswith("step_0000000001")
+    got = restore_checkpoint(str(tmp_path), state, broadcast=False)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        got, state,
+    )
+    # wait() is idempotent
+    assert handle.wait() == path
+
+
+def test_async_save_retention(tmp_path):
+    from horovod_tpu.checkpoint import save_checkpoint_async
+
+    for step in (1, 2, 3):
+        save_checkpoint_async(
+            str(tmp_path), _state(step), step=step, keep=2
+        ).wait()
+    assert latest_checkpoint_step(str(tmp_path)) == 3
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["step_0000000002", "step_0000000003"]
+
+
+def test_async_save_keep_validated(tmp_path):
+    from horovod_tpu.checkpoint import save_checkpoint_async
+
+    with pytest.raises(ValueError, match="keep"):
+        save_checkpoint_async(str(tmp_path), _state(), step=1, keep=0)

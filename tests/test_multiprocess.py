@@ -199,6 +199,34 @@ def test_checkpoint_rank0_write_broadcast_restore(engine_env, tmp_path):
         assert r == [1.0, 1.0, 1.0]  # rank 0's state everywhere
 
 
+def _ckpt_async_fn(ckpt_dir):
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.checkpoint import (
+        restore_checkpoint, save_checkpoint_async,
+    )
+
+    hvd.init()
+    r = hvd.rank()
+    state = {"w": np.full((3,), float(r + 1), np.float32)}
+    handle = save_checkpoint_async(ckpt_dir, state, step=1)
+    # training would continue here; wait() is the commit point + barrier
+    handle.wait()
+    out = restore_checkpoint(ckpt_dir, {"w": np.zeros((3,), np.float32)})
+    hvd.shutdown()
+    return np.asarray(out["w"]).tolist()
+
+
+def test_checkpoint_async_rank0_write_broadcast_restore(engine_env,
+                                                        tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt_async")
+    results = hvdrun.run(_ckpt_async_fn, (ckpt_dir,), np=2, use_cpu=True,
+                         timeout=180, env=engine_env)
+    for r in results:
+        assert r == [1.0, 1.0, 1.0]
+
+
 def _ckpt_nonshared_fn(ckpt_dir):
     import os
 
