@@ -29,10 +29,94 @@ import sys
 import threading
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
+
+def _budget_left(args) -> float:
+    """Seconds until the TOTAL wall-clock budget expires.  The deadline is
+    an epoch timestamp minted by the first process and carried through
+    every re-exec, so retries and backoff sleeps all draw from one budget
+    sized to the driver's window (r04 lesson: per-attempt accounting let
+    cumulative attempts overrun the window and land rc=124)."""
+    return args.deadline_epoch - time.time()
+
+
+def _reexec_next_attempt(args) -> None:
+    argv = [a for a in sys.argv[1:]
+            if not (a.startswith("--retry-attempt")
+                    or a.startswith("--deadline-epoch"))]
+    argv.append(f"--retry-attempt={args.retry_attempt + 1}")
+    argv.append(f"--deadline-epoch={args.deadline_epoch}")
+    os.execv(sys.executable,
+             [sys.executable, os.path.abspath(__file__)] + argv)
+
+
+def _give_up_or_retry(args, why: str) -> None:
+    """Common tail for watchdog fires and UNAVAILABLE exceptions: re-exec
+    if both a retry and enough budget for a cache-warmed attempt (~3 min)
+    remain, else exit 86 immediately so the driver gets a clean rc instead
+    of an outer-timeout rc=124."""
+    left = _budget_left(args)
+    if args.retry_attempt < args.attempts and left > 180:
+        print(f"# {why} (attempt {args.retry_attempt + 1} of "
+              f"{args.attempts + 1}, {left:.0f}s budget left); re-execing",
+              file=sys.stderr, flush=True)
+        _reexec_next_attempt(args)  # never returns
+    print(f"# {why}; no retries or budget left — giving up",
+          file=sys.stderr, flush=True)
+    os._exit(86)
+
+
+def _import_guard_args():
+    """The budget/retry knobs, parsed WITHOUT the full parser: the
+    import guard below must run before anything heavyweight."""
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument("--attempts", type=int, default=4)
+    p.add_argument("--total-budget-secs", type=int, default=1440)
+    p.add_argument("--retry-attempt", type=int, default=0)
+    p.add_argument("--deadline-epoch", type=float, default=0.0)
+    p.add_argument("--cpu", action="store_true")
+    a, _ = p.parse_known_args()
+    if not a.deadline_epoch:
+        a.deadline_epoch = time.time() + a.total_budget_secs
+    return a
+
+
+# --- import guard -----------------------------------------------------
+# In the r05 outage mode a dead tunnel hangs ``import jax`` ITSELF (the
+# axon plugin handshakes at import) — before main(), before the phase
+# watchdog arms — so an unguarded bench would silently eat the driver's
+# whole window and land rc=124.  A pre-import daemon gives that mode the
+# same re-exec/give-up treatment as an in-flight hang: each attempt gets
+# a 300s import window, the shared total budget caps the retries, and
+# the give-up is a clean exit 86.
+_IMPORT_GUARD = _import_guard_args()
+_import_ok = threading.Event()
+
+
+def _import_watchdog() -> None:
+    start = time.monotonic()
+    while not _import_ok.wait(15):
+        if _budget_left(_IMPORT_GUARD) <= 0:
+            _give_up_or_retry(
+                _IMPORT_GUARD,
+                "watchdog: total budget exhausted during jax import")
+        if time.monotonic() - start > 300:
+            _give_up_or_retry(
+                _IMPORT_GUARD,
+                "jax import made no progress in 300s (tunnel down?)")
+
+
+# Script-mode only: importers (pytest, scripts/profile_bench.py) must
+# not have a daemon parsing THEIR argv and execv-ing/exiting them.
+if __name__ == "__main__" and not _IMPORT_GUARD.cpu:
+    threading.Thread(target=_import_watchdog, daemon=True).start()
+
+import jax  # noqa: E402  (guarded: may hang on a dead tunnel)
+
+_import_ok.set()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
 
 BASELINE_IMG_PER_SEC_PER_ACCEL = 103.55  # docs/benchmarks.rst:43 (1656.82/16)
 
@@ -275,28 +359,9 @@ def _is_unavailable(exc: BaseException) -> bool:
     return "UNAVAILABLE" in msg or "Unable to initialize backend" in msg
 
 
-def _reexec_next_attempt(args) -> None:
-    argv = [a for a in sys.argv[1:]
-            if not (a.startswith("--retry-attempt")
-                    or a.startswith("--deadline-epoch"))]
-    argv.append(f"--retry-attempt={args.retry_attempt + 1}")
-    argv.append(f"--deadline-epoch={args.deadline_epoch}")
-    os.execv(sys.executable,
-             [sys.executable, os.path.abspath(__file__)] + argv)
-
-
 _watchdog_disarm = threading.Event()
 _last_progress = time.monotonic()
 _phase_window = 300.0  # init phase default; _touch_progress re-sets it
-
-
-def _budget_left(args) -> float:
-    """Seconds until the TOTAL wall-clock budget expires.  The deadline is
-    an epoch timestamp minted by the first process and carried through
-    every re-exec, so retries and backoff sleeps all draw from one budget
-    sized to the driver's window (r04 lesson: per-attempt accounting let
-    cumulative attempts overrun the window and land rc=124)."""
-    return args.deadline_epoch - time.time()
 
 
 def _touch_progress(next_window: float = 300.0) -> None:
@@ -309,22 +374,6 @@ def _touch_progress(next_window: float = 300.0) -> None:
     global _last_progress, _phase_window
     _last_progress = time.monotonic()
     _phase_window = next_window
-
-
-def _give_up_or_retry(args, why: str) -> None:
-    """Common tail for watchdog fires and UNAVAILABLE exceptions: re-exec
-    if both a retry and enough budget for a cache-warmed attempt (~3 min)
-    remain, else exit 86 immediately so the driver gets a clean rc instead
-    of an outer-timeout rc=124."""
-    left = _budget_left(args)
-    if args.retry_attempt < args.attempts and left > 180:
-        print(f"# {why} (attempt {args.retry_attempt + 1} of "
-              f"{args.attempts + 1}, {left:.0f}s budget left); re-execing",
-              file=sys.stderr, flush=True)
-        _reexec_next_attempt(args)  # never returns
-    print(f"# {why}; no retries or budget left — giving up",
-          file=sys.stderr, flush=True)
-    os._exit(86)
 
 
 def _retry_exec(args, exc: BaseException) -> None:

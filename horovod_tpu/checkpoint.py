@@ -199,25 +199,40 @@ class AsyncSave:
     then training steps overlap the checkpoint I/O.
     """
 
-    def __init__(self, path, ckptr=None, directory=None, keep=None):
+    def __init__(self, path, ckptr=None, directory=None, keep=None,
+                 error=None):
         self.path = path
         self._ckptr = ckptr
         self._directory = directory
         self._keep = keep
+        self._error = error  # a save() failure deferred to wait()
         self._finalized = False
 
     def wait(self) -> str:
         if self._finalized:
+            # repeat wait() must not silently bless a failed save
+            if self._error is not None:
+                raise self._error
             return self.path
         try:
             if self._ckptr is not None:  # rank 0
-                self._ckptr.wait_until_finished()
-                self._ckptr.close()
-                if self._keep is not None:
-                    steps = sorted(_list_step_dirs(self._directory))
-                    for old in steps[: max(len(steps) - self._keep, 0)]:
-                        shutil.rmtree(_step_dir(self._directory, old),
-                                      ignore_errors=True)
+                try:
+                    self._ckptr.wait_until_finished()
+                    if self._keep is not None:
+                        steps = sorted(_list_step_dirs(self._directory))
+                        for old in steps[: max(len(steps) - self._keep,
+                                               0)]:
+                            shutil.rmtree(
+                                _step_dir(self._directory, old),
+                                ignore_errors=True,
+                            )
+                except Exception as exc:
+                    self._error = exc
+                finally:
+                    try:
+                        self._ckptr.close()
+                    except Exception:
+                        pass
         finally:
             # a failed background write must still release the peers:
             # without the barrier in the finally, ranks != 0 (whose
@@ -225,6 +240,8 @@ class AsyncSave:
             # rank 0 raises
             _barrier()
             self._finalized = True
+        if self._error is not None:
+            raise self._error
         return self.path
 
 
@@ -250,15 +267,21 @@ def save_checkpoint_async(
     path = _step_dir(directory, step)
     if rank() != 0:
         return AsyncSave(path)
-    os.makedirs(directory, exist_ok=True)
-    ckptr = _rank0_checkpointer(async_=True)
-    # orbax refuses to overwrite; force=True matches the reference's
-    # framework-checkpoint overwrite behavior on re-save of a step.
-    ckptr.save(
-        os.path.abspath(path),
-        jax.tree_util.tree_map(np.asarray, state),
-        force=True,
-    )
+    try:
+        os.makedirs(directory, exist_ok=True)
+        ckptr = _rank0_checkpointer(async_=True)
+        # orbax refuses to overwrite; force=True matches the reference's
+        # framework-checkpoint overwrite behavior on re-save of a step.
+        ckptr.save(
+            os.path.abspath(path),
+            jax.tree_util.tree_map(np.asarray, state),
+            force=True,
+        )
+    except Exception as exc:
+        # rank 0 failing before a handle exists must not strand ranks
+        # != 0 in wait()'s barrier — defer the raise to wait(), after
+        # the barrier releases everyone
+        return AsyncSave(path, error=exc)
     return AsyncSave(path, ckptr, directory, keep)
 
 

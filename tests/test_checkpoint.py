@@ -143,3 +143,20 @@ def test_async_save_keep_validated(tmp_path):
 
     with pytest.raises(ValueError, match="keep"):
         save_checkpoint_async(str(tmp_path), _state(), step=1, keep=0)
+
+
+def test_async_save_failure_raises_at_wait(tmp_path):
+    """A failed save must surface at wait() — and keep surfacing on
+    repeat wait() — never silently bless the step."""
+    from horovod_tpu.checkpoint import save_checkpoint_async
+
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    # directory path nested under a regular FILE: makedirs fails
+    handle = save_checkpoint_async(
+        str(blocker / "ckpt"), _state(), step=1
+    )
+    with pytest.raises(Exception):
+        handle.wait()
+    with pytest.raises(Exception):
+        handle.wait()
