@@ -67,7 +67,20 @@ def _give_up_or_retry(args, why: str) -> None:
 
 def _import_guard_args():
     """The budget/retry knobs, parsed WITHOUT the full parser: the
-    import guard below must run before anything heavyweight."""
+    import guard below must run before anything heavyweight.
+
+    Script-mode only.  Importers (pytest, scripts/profile_bench.py) get
+    the static default namespace instead: parse_known_args over a
+    FOREIGN argv can still SystemExit (a prefix-ambiguous ``--c...``
+    flag, or a type error on an unrelated ``--attempts``), and minting
+    ``deadline_epoch`` at import time would start the bench budget
+    clock on processes that never bench.
+    """
+    if __name__ != "__main__":
+        return argparse.Namespace(
+            attempts=4, total_budget_secs=1440, retry_attempt=0,
+            deadline_epoch=float("inf"), cpu=True,
+        )
     p = argparse.ArgumentParser(add_help=False)
     p.add_argument("--attempts", type=int, default=4)
     p.add_argument("--total-budget-secs", type=int, default=1440)

@@ -13,9 +13,20 @@ make -C cpp
 
 if [ "${1:-full}" = "quick" ]; then
     # per-commit tier: everything except the long pole (soak, differential
-    # fuzz, fp8 numerics contract, scaling gates) — see pytest.ini markers
+    # fuzz, fp8 numerics contract, scaling gates) — see pytest.ini markers.
+    # The elastic/fault-injection suite runs first and by name: recovery
+    # paths only stay honest while the chaos tests that drive them
+    # (ISSUE 1 acceptance) are exercised on every commit.
+    echo "== quick tier: elastic fault-tolerance + injection paths =="
+    python -m pytest tests/test_elastic.py \
+        "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks" \
+        -x -q
     echo "== quick tier: unit + multiprocess suite minus -m full =="
-    python -m pytest tests/ -x -q -m "not full"
+    # test_elastic.py and the injection case already ran above — don't
+    # pay for the multiprocess chaos cases twice per commit.
+    python -m pytest tests/ -x -q -m "not full" \
+        --ignore=tests/test_elastic.py \
+        --deselect "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks"
     exit 0
 fi
 
@@ -66,4 +77,15 @@ for argset in "--smoke --cpu" "--smoke --cpu --circles 2"; do
     XLA_FLAGS=--xla_force_host_platform_device_count=4 \
         python examples/pipeline_train.py $argset
 done
+
+# Elastic chaos smoke through the real launcher: a rank is killed
+# deterministically mid-training (HVDTPU_FAULT_SPEC), the job must
+# recover via rollback + respawn (the example asserts it did).
+echo "== elastic chaos smoke: recovery after injected worker death =="
+JAX_PLATFORMS=cpu python examples/elastic_train.py \
+    --np 3 --fault worker_exit:step=4:rank=1
+echo "== elastic chaos smoke: shrink when the respawn budget is spent =="
+JAX_PLATFORMS=cpu python examples/elastic_train.py \
+    --np 3 --fault worker_exit:step=4:rank=1 \
+    --max-retries 0 --min-workers 2
 echo "matrix OK"

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Elastic fault-tolerant training demo (docs/elastic.md).
+
+Runs a small data-parallel SGD loop under ``elastic.launch`` and — when
+``--fault`` is given — proves the recovery path by deterministically
+killing a rank mid-training with the fault-injection registry
+(``HVDTPU_FAULT_SPEC``), then showing the job finish with the same
+committed state a no-fault run reaches.
+
+    # clean run
+    python examples/elastic_train.py --np 3
+
+    # chaos run: rank 1 is killed at its 4th step, respawned, and the
+    # job recovers via rollback + re-rendezvous
+    python examples/elastic_train.py --np 3 --fault worker_exit:step=4:rank=1
+
+    # budget-spent shrink: no respawns allowed, world shrinks to 2
+    python examples/elastic_train.py --np 3 --fault worker_exit:step=4:rank=1 \
+        --max-retries 0 --min-workers 2
+"""
+
+import argparse
+
+import numpy as np
+
+
+def train(steps):
+    import numpy as np
+
+    import horovod_tpu.elastic as elastic
+
+    ctx = elastic.context()
+    state = elastic.State(w=np.zeros(8), step=0)
+
+    @elastic.run
+    def loop(state):
+        while state.step < steps:
+            # Toy "gradient": deterministic per (step, rank) so a
+            # recovered run reproduces a no-fault run exactly.
+            grad = np.full(8, float(state.step + 1) * (ctx.rank + 1))
+            state.w = state.w - 0.01 * ctx.allreduce(
+                grad, name=f"grad{state.step}")
+            state.step += 1
+            state.commit()
+        return float(state.w[0]), state.step
+
+    return loop(state)
+
+
+def main() -> int:
+    import horovod_tpu.elastic as elastic
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--np", type=int, default=3, dest="num_proc")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--fault", default="",
+                   help="HVDTPU_FAULT_SPEC for the workers, e.g. "
+                        "worker_exit:step=4:rank=1")
+    p.add_argument("--max-retries", type=int, default=3)
+    p.add_argument("--min-workers", type=int, default=None)
+    args = p.parse_args()
+
+    env = {"JAX_PLATFORMS": "cpu"}
+    if args.fault:
+        env["HVDTPU_FAULT_SPEC"] = args.fault
+    results, job = elastic.launch(
+        train, args=(args.steps,), np=args.num_proc, env=env,
+        max_retries=args.max_retries, min_workers=args.min_workers,
+        timeout=300,
+    )
+    print(f"final world: {job.world} (epoch {job.epoch})")
+    for event in job.trace:
+        print(f"  trace: {event}")
+    w0 = {r: results[r][0] for r in sorted(results)}
+    print(f"w[0] per rank: {w0}")
+    assert len(set(w0.values())) == 1, "ranks disagree on final state"
+    if args.fault and args.max_retries > 0:
+        assert any(e[0] == "respawn" for e in job.trace), \
+            "fault spec set but no respawn happened"
+        print("recovered: rollback + respawn verified")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

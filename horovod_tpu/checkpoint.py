@@ -240,6 +240,26 @@ class AsyncSave:
             # rank 0 raises
             _barrier()
             self._finalized = True
+        # Commit-status propagation (ADVICE r5 #2): without this, a
+        # failed rank-0 save raised on rank 0 only — every other rank
+        # returned the step path and trained on believing the commit
+        # point exists.  After the release barrier, rank 0 broadcasts
+        # its outcome; survivors turn a non-None outcome into their own
+        # raise, so the commit contract is all-or-nothing on EVERY rank.
+        if size() > 1:
+            from .optim import broadcast_object  # noqa: PLC0415
+
+            summary = (
+                f"{type(self._error).__name__}: {self._error}"
+                if self._error is not None and rank() == 0 else None
+            )
+            summary = broadcast_object(summary, root_rank=0)
+            if summary is not None and self._error is None:
+                self._error = RuntimeError(
+                    f"checkpoint save of {self.path!r} failed on rank 0 "
+                    f"({summary}); no rank may treat this step as "
+                    f"committed"
+                )
         if self._error is not None:
             raise self._error
         return self.path
@@ -268,6 +288,12 @@ def save_checkpoint_async(
     if rank() != 0:
         return AsyncSave(path)
     try:
+        from .testing.faults import maybe_fail  # noqa: PLC0415
+
+        # Chaos point "ckpt_write": a deterministic stand-in for the disk
+        # full / permission lost / orbax failure the deferred-error path
+        # exists for (HVDTPU_FAULT_SPEC="ckpt_write:step=N:rank=0").
+        maybe_fail("ckpt_write", step=step)
         os.makedirs(directory, exist_ok=True)
         ckptr = _rank0_checkpointer(async_=True)
         # orbax refuses to overwrite; force=True matches the reference's

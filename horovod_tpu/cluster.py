@@ -92,6 +92,8 @@ def run_on_cluster(
     job_timeout: Optional[float] = None,
     env: Optional[Dict[str, str]] = None,
     driver_host: Optional[str] = None,
+    min_workers: Optional[int] = None,
+    max_retries: int = 0,
 ):
     """Run ``fn`` as a ``num_proc``-rank horovod_tpu job inside cluster
     task slots; returns the per-rank results in rank order (reference
@@ -104,11 +106,57 @@ def run_on_cluster(
     driver address for networks where the outbound-interface probe picks
     the wrong NIC.
 
+    Elastic knobs (matching the launcher's, run/runner.py):
+    ``min_workers`` — when the registration deadline passes with at
+    least this many tasks checked in, the job proceeds with the reduced
+    world instead of failing start-up (unregistered slots are released
+    with a ``None`` rank assignment); default ``None`` keeps the strict
+    all-``num_proc`` contract.  ``max_retries`` — re-run the whole
+    attempt (fresh rendezvous + executor invocation) up to this many
+    times when a task fails; default 0 keeps fail-fast.
+
     ``executor(num_tasks, driver_addr, secret)`` must arrange for
     :func:`task_main`-equivalent execution in each slot; returning an
     object with ``failed()`` / ``join()`` / ``terminate()`` gives the
     driver fast failure detection and cleanup.
     """
+    attempts = 0
+    while True:
+        try:
+            return _run_cluster_attempt(
+                fn, args, kwargs,
+                num_proc=num_proc, executor=executor,
+                start_timeout=start_timeout, job_timeout=job_timeout,
+                env=env, driver_host=driver_host,
+                min_workers=min_workers,
+            )
+        except (RuntimeError, TimeoutError) as exc:
+            attempts += 1
+            if attempts > max_retries:
+                raise
+            print(
+                f"horovod_tpu.cluster: attempt {attempts} failed "
+                f"({exc}); retrying ({max_retries - attempts + 1} "
+                f"retries left)",
+                file=sys.stderr,
+            )
+
+
+def _run_cluster_attempt(
+    fn: Callable,
+    args: tuple,
+    kwargs: Optional[dict],
+    *,
+    num_proc: int,
+    executor: Callable[[int, str, str], object],
+    start_timeout: float,
+    job_timeout: Optional[float],
+    env: Optional[Dict[str, str]],
+    driver_host: Optional[str],
+    min_workers: Optional[int],
+):
+    """One rendezvous + execution attempt (the pre-elastic
+    run_on_cluster body)."""
     # Bind every interface and advertise the outbound-interface address:
     # task slots generally live on OTHER hosts (same logic as the
     # launcher's KV server, run/api.py bind_all=not all_local; the probe
@@ -136,6 +184,21 @@ def run_on_cluster(
                     return j, value
         return None
 
+    def check_executor_failure(what: str) -> None:
+        """Fail the job promptly when a slot died: surface its posted
+        traceback when one exists, else a generic death notice."""
+        failed = getattr(handle, "failed", None)
+        if failed is None or not failed():
+            return
+        post = posted_failure()
+        if post is not None:
+            j, tb = post
+            raise RuntimeError(f"cluster task {j} raised:\n{tb}")
+        raise RuntimeError(
+            f"a cluster task died during {what} without reporting "
+            "a result (see its slot's logs)"
+        )
+
     def wait_kv(scope: str, key: str, deadline, what: str) -> bytes:
         """Poll the KV in short slices, interleaving executor-death checks
         so a crashed slot fails the job promptly instead of burning the
@@ -145,40 +208,69 @@ def run_on_cluster(
                 return kv.wait(scope, key, timeout=5.0)
             except TimeoutError:
                 pass
-            failed = getattr(handle, "failed", None)
-            if failed is not None and failed():
-                post = posted_failure()
-                if post is not None:
-                    j, tb = post
-                    raise RuntimeError(f"cluster task {j} raised:\n{tb}")
-                raise RuntimeError(
-                    f"a cluster task died during {what} without reporting "
-                    "a result (see its slot's logs)"
-                )
+            check_executor_failure(what)
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"cluster {what} timed out waiting for {scope}/{key}"
                 )
 
     try:
-        # 1. registration (reference: driver.task_host_hash_indices)
+        # 1. registration (reference: driver.task_host_hash_indices).
+        # With min_workers set, a deadline pass with at least that many
+        # registrants proceeds on the reduced world (the cluster-level
+        # analog of the elastic launcher's shrink path) instead of
+        # failing start-up on stragglers the scheduler never placed.
         start_deadline = time.monotonic() + start_timeout
         task_hosts: Dict[int, str] = {}
+        pending = set(range(num_proc))
+        while pending:
+            # Block server-side on ONE representative key (so the common
+            # fast path has sub-second latency without hammering the
+            # single-threaded KV store at poll rate), then sweep the
+            # rest with cheap gets once per wakeup.
+            probe = min(pending)
+            try:
+                raw = kv.wait("register", str(probe), timeout=1.0)
+                task_hosts[probe] = pickle.loads(raw)["host_hash"]
+            except TimeoutError:
+                pass
+            for i in sorted(pending - set(task_hosts)):
+                raw = kv.get("register", str(i))
+                if raw is not None:
+                    task_hosts[i] = pickle.loads(raw)["host_hash"]
+            pending -= set(task_hosts)
+            if not pending:
+                break
+            check_executor_failure("start-up")
+            if time.monotonic() > start_deadline:
+                if (min_workers is not None
+                        and len(task_hosts) >= min_workers):
+                    break
+                raise TimeoutError(
+                    f"cluster start-up timed out with "
+                    f"{len(task_hosts)}/{num_proc} tasks registered"
+                    + (f" (min_workers={min_workers})"
+                       if min_workers is not None else "")
+                )
+        # 2. rank assignment, published per task.  assign_ranks wants
+        # dense indexes, so a reduced world is densified first; slots
+        # that never registered get an explicit None so a late-arriving
+        # task releases its slot cleanly instead of hanging on the key.
+        registered = sorted(task_hosts)
+        dense = assign_ranks(
+            {pos: task_hosts[i] for pos, i in enumerate(registered)}
+        )
+        slots = {i: dense[pos] for pos, i in enumerate(registered)}
         for i in range(num_proc):
-            raw = wait_kv("register", str(i), start_deadline, "start-up")
-            task_hosts[i] = pickle.loads(raw)["host_hash"]
-        # 2. rank assignment, published per task
-        slots = assign_ranks(task_hosts)
-        for i, slot in enumerate(slots):
-            kv.put("slot", str(i), pickle.dumps(slot))
+            kv.put("slot", str(i), pickle.dumps(slots.get(i)))
         # 3. results, in rank order (bounded only by job_timeout; a task
         # that died without posting is detected through the executor
         # handle rather than a timeout)
         job_deadline = (
             time.monotonic() + job_timeout if job_timeout else None
         )
-        results = [None] * num_proc
-        for i in range(num_proc):
+        results = [None] * len(registered)
+        for i in registered:
             ok, value = pickle.loads(
                 wait_kv("result", str(i), job_deadline, "job")
             )
@@ -226,6 +318,12 @@ def task_main(index: int, driver_addr: str, secret: str) -> None:
                           "pid": os.getpid()}),
         )
         slot = pickle.loads(kv.wait("slot", str(index), timeout=600))
+        if slot is None:
+            # Reduced world (driver proceeded with min_workers before
+            # this task registered): release the slot without error so
+            # the executor's handle never reads it as a failure.
+            kv.put("result", str(index), pickle.dumps((True, pickle.dumps(None))))
+            return
         extra_env = pickle.loads(kv.wait("job", "env", timeout=60))
         os.environ.update(extra_env)
         os.environ.update({

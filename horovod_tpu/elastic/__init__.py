@@ -1,0 +1,64 @@
+"""Elastic fault-tolerant training (the TPU-native answer to Elastic
+Horovod's commit-and-rollback + re-rendezvous design).
+
+Three layers (see docs/elastic.md for the full state machine):
+
+* **User API** — :class:`State` (commit/restore/sync) and :func:`run`
+  (catch recoverable world failures, roll back, re-rendezvous, resume).
+* **Launcher** — ``run/runner.py:launch_elastic_job`` /
+  :func:`launch`: per-rank failure detection (exit code + heartbeat),
+  host blacklisting with exponential backoff (``run/blacklist.py``),
+  bounded respawn into re-minted rendezvous epochs.
+* **Fault injection** — ``horovod_tpu/testing/faults.py``
+  (``HVDTPU_FAULT_SPEC``), so the recovery paths are exercised
+  deterministically on CPU in tier-1.
+
+Minimal elastic training loop::
+
+    import horovod_tpu.elastic as elastic
+
+    def train():
+        ctx = elastic.context()
+        state = elastic.State(w=np.zeros(4), step=0)
+
+        @elastic.run
+        def loop(state):
+            while state.step < 100:
+                grad = compute_grad(state)
+                state.w -= 0.1 * ctx.allreduce(grad, name=f"g{state.step}")
+                state.step += 1
+                state.commit()
+            return state.w
+
+        return loop(state)
+
+    results, job = elastic.launch(train, np=4, min_workers=2)
+"""
+
+from .context import (  # noqa: F401
+    ElasticContext,
+    LocalContext,
+    context,
+    reset_context,
+)
+from .exceptions import (  # noqa: F401
+    HorovodShutdownError,
+    RankDroppedError,
+    WorkersAvailableException,
+)
+from .launch import launch  # noqa: F401
+from .run import run  # noqa: F401
+from .state import State  # noqa: F401
+
+__all__ = [
+    "State",
+    "run",
+    "launch",
+    "context",
+    "reset_context",
+    "ElasticContext",
+    "LocalContext",
+    "HorovodShutdownError",
+    "RankDroppedError",
+    "WorkersAvailableException",
+]

@@ -1,0 +1,87 @@
+"""Python launch API for elastic jobs: ``elastic.launch(fn, np=4)``.
+
+The fault-tolerant sibling of ``horovod_tpu.run.run()``: pickles the
+function into the launcher's rendezvous store, drives
+``run/runner.py:launch_elastic_job`` (failure detection, blacklist,
+respawn, epoch minting), and collects per-rank results from the ranks
+that survived to the final world.
+
+Returns ``(results, job)`` where ``results`` maps rank -> value for
+every rank of the final world (a shrunken job returns fewer entries)
+and ``job`` is the :class:`~..run.runner.ElasticJobResult` whose
+``trace`` is the deterministic recovery event list chaos tests compare
+across runs.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+from ..run.rendezvous import KVStoreServer
+from ..run.runner import ElasticJobResult, launch_elastic_job
+
+_SCOPE = "elastic"
+
+__all__ = ["launch"]
+
+
+def launch(
+    fn,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    *,
+    np: int = 1,
+    hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    min_workers: Optional[int] = None,
+    max_retries: int = 3,
+    heartbeat_timeout: float = 60.0,
+    blacklist_cooldown: float = 10.0,
+    timeout: Optional[float] = None,
+) -> Tuple[Dict[int, Any], ElasticJobResult]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` elastic workers.
+
+    ``fn`` runs under the ambient elastic context
+    (``horovod_tpu.elastic.context()``); wrap its training loop with
+    ``elastic.run`` and keep its state in an ``elastic.State`` to get
+    rollback-and-resume on worker failure.
+    """
+    from ..run.api import _parse_host_slots, _pickle_func  # noqa: PLC0415
+    from ..run.allocate import is_local_host  # noqa: PLC0415
+
+    host_slots = _parse_host_slots(hosts, hostfile)
+    all_local = all(is_local_host(h.hostname) for h in host_slots)
+    server = KVStoreServer(bind_all=not all_local)
+    server.start()
+    from ..run.rendezvous import KVStoreClient  # noqa: PLC0415
+
+    kv = KVStoreClient(f"127.0.0.1:{server.port}", server.secret)
+    kv.put(_SCOPE, "func", _pickle_func(fn, args, kwargs or {}))
+    try:
+        job = launch_elastic_job(
+            [sys.executable, "-m", "horovod_tpu.elastic.worker"],
+            np,
+            hosts=hosts,
+            hostfile=hostfile,
+            env=env,
+            min_workers=min_workers,
+            max_retries=max_retries,
+            heartbeat_timeout=heartbeat_timeout,
+            blacklist_cooldown=blacklist_cooldown,
+            job_timeout=timeout,
+            kv_server=server,
+        )
+        results: Dict[int, Any] = {}
+        for rank in job.world:
+            blob = kv.wait(_SCOPE, f"result_{rank}", timeout=30)
+            ok, value = cloudpickle.loads(blob)
+            if not ok:  # pragma: no cover - monitor aborts first
+                raise RuntimeError(f"rank {rank} raised:\n{value}")
+            results[rank] = value
+        return results, job
+    finally:
+        server.stop()

@@ -1,0 +1,130 @@
+"""Commit/restore/sync training state for elastic runs.
+
+The elastic analog of the AsyncSave commit-point discipline in
+checkpoint.py: training mutates ``State`` attributes freely; ``commit()``
+snapshots them (host copies, like checkpoint.py's ``np.asarray`` of the
+pytree) as the rollback point; ``restore()`` rewinds to it after a
+recoverable failure; ``sync()`` makes the whole (possibly re-formed)
+world agree on the newest committed snapshot — a respawned rank with no
+history adopts a survivor's state, the broadcast-from-a-surviving-rank
+the ISSUE names.
+
+Upstream mirror: horovod's elastic ``State``/``ObjectState`` with
+commit()/restore()/sync() (horovod/common/elastic.py in the post-0.19
+line); here sync rides the epoch-scoped KV owner election instead of an
+MPI broadcast.
+"""
+
+from __future__ import annotations
+
+import copy
+import pickle
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from .context import context as _ambient_context
+from .exceptions import WorkersAvailableException
+
+__all__ = ["State"]
+
+
+def _clone(tree):
+    """Host-side deep copy of a pytree: arrays land as fresh numpy
+    buffers (a jax.Array snapshot is materialized to host, matching the
+    checkpoint layer), everything else deep-copies."""
+
+    def leaf(x):
+        if isinstance(x, np.ndarray):
+            return x.copy()
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return copy.deepcopy(x)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class State:
+    """A bag of named training objects with commit/rollback semantics.
+
+    >>> state = State(params=params, opt_state=opt_state, step=0)
+    >>> state.step += 1          # attribute access hits the live values
+    >>> state.commit()           # rollback point
+    >>> state.restore()          # rewind to the last commit
+    """
+
+    def __init__(self, **values: Any):
+        # object.__setattr__ for internals so __setattr__ below can route
+        # everything non-underscore into the value dict.
+        object.__setattr__(self, "_values", dict(values))
+        object.__setattr__(self, "_snapshot", _clone(values))
+        object.__setattr__(self, "_commits", 0)
+        object.__setattr__(self, "_ctx", None)
+
+    # -- attribute routing ------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        values: Dict[str, Any] = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(
+            f"elastic State has no value {name!r}; registered: "
+            f"{sorted(values)}"
+        )
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._values[name] = value
+
+    def register(self, **values: Any) -> None:
+        """Add more objects to the state (tracked from the next commit)."""
+        self._values.update(values)
+
+    @property
+    def commits(self) -> int:
+        """Number of commits applied (the freshness key sync elects on)."""
+        return self._commits
+
+    def values(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    # -- commit discipline ------------------------------------------------
+
+    def commit(self) -> None:
+        """Snapshot the live values as the rollback point.
+
+        When the launcher has re-minted the rendezvous epoch since this
+        rank last rendezvoused, raises :class:`WorkersAvailableException`
+        AFTER taking the snapshot — the commit is durable, and
+        ``elastic.run`` re-rendezvouses before the next step touches the
+        stale world."""
+        object.__setattr__(self, "_snapshot", _clone(self._values))
+        object.__setattr__(self, "_commits", self._commits + 1)
+        ctx = self._ctx
+        if ctx is not None and ctx.world_changed():
+            raise WorkersAvailableException(
+                f"rendezvous epoch advanced past {ctx.epoch}; "
+                f"re-rendezvous before the next step"
+            )
+
+    def restore(self) -> None:
+        """Rewind the live values to the last commit (initial values when
+        nothing has been committed yet)."""
+        object.__setattr__(self, "_values", _clone(self._snapshot))
+
+    def sync(self, ctx=None) -> None:
+        """Make every rank in the current world hold the newest committed
+        snapshot: the rank with the highest commit count (ties: lowest
+        rank) broadcasts; everyone adopts it as both snapshot and live
+        values."""
+        ctx = ctx or self._ctx or _ambient_context()
+        blob = ctx.sync_state(
+            pickle.dumps((self._snapshot, self._commits)), self._commits
+        )
+        snapshot, commits = pickle.loads(blob)
+        object.__setattr__(self, "_snapshot", snapshot)
+        object.__setattr__(self, "_commits", commits)
+        object.__setattr__(self, "_values", _clone(snapshot))

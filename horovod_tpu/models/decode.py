@@ -162,17 +162,25 @@ def generate(cfg: TransformerConfig, params, prompt, steps: int,
             lt = jnp.where(lt < kth, -jnp.inf, lt)
         return jax.random.categorical(k, lt, axis=-1)
 
+    if steps <= 0:
+        return jnp.zeros((prompt.shape[0], 0), jnp.int32)
     keys = (
-        jax.random.split(key, steps + 1) if key is not None
-        else jnp.zeros((steps + 1, 2), jnp.uint32)
+        jax.random.split(key, steps) if key is not None
+        else jnp.zeros((steps, 2), jnp.uint32)
     )
     logits, cache = prefill(cfg, params, prompt, max_len)
     first = pick(logits[:, -1], keys[0])
 
+    # Emit the NEWLY picked token from the scan (seeded with ``first``):
+    # token i+1 costs exactly one decode_step on token i, so ``steps``
+    # tokens take ``steps - 1`` scan iterations — the old shape emitted
+    # the input token and burned a final decode_step whose pick was
+    # discarded.
     def step(carry, k):
         cache, tok = carry
         logits, cache = decode_step(cfg, params, cache, tok)
-        return (cache, pick(logits, k)), tok
+        new = pick(logits, k)
+        return (cache, new), new
 
     (_, _), toks = lax.scan(step, (cache, first), keys[1:])
-    return toks.T
+    return jnp.concatenate([first[:, None], toks.T], axis=1)

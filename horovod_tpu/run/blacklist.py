@@ -1,0 +1,100 @@
+"""Host blacklist with exponential-backoff re-admission.
+
+The elastic launcher's memory of which hosts keep killing workers
+(upstream analog: the elastic driver's host blacklist in
+horovod/runner/elastic/discovery.py, which a fixed cooldown re-admits;
+here the cooldown doubles per repeat failure so a flapping host backs
+off geometrically instead of thrashing the respawn budget).
+
+Single-host degenerate case: when EVERY candidate is blacklisted the
+selector returns the one whose re-admission lands soonest rather than
+deadlocking — on a localhost-only job the only host is also the only
+place a respawn can go, and failing the job because its one host had one
+crash would make the blacklist strictly worse than no blacklist.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["HostBlacklist"]
+
+DEFAULT_COOLDOWN_BASE_SECS = 10.0
+DEFAULT_COOLDOWN_CAP_SECS = 300.0
+
+
+@dataclass
+class _Entry:
+    failures: int = 0
+    readmit_at: float = 0.0
+
+
+class HostBlacklist:
+    """Tracks per-host failures; a host is inadmissible until its
+    cooldown (base * 2^(failures-1), capped) elapses."""
+
+    def __init__(
+        self,
+        cooldown_base: float = DEFAULT_COOLDOWN_BASE_SECS,
+        cooldown_cap: float = DEFAULT_COOLDOWN_CAP_SECS,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cooldown_base = cooldown_base
+        self.cooldown_cap = cooldown_cap
+        self._clock = clock
+        self._hosts: Dict[str, _Entry] = {}
+
+    def record_failure(self, host: str) -> int:
+        """Register a worker failure on ``host``; returns the host's
+        total failure count."""
+        entry = self._hosts.setdefault(host, _Entry())
+        entry.failures += 1
+        cooldown = min(
+            self.cooldown_base * (2 ** (entry.failures - 1)),
+            self.cooldown_cap,
+        )
+        entry.readmit_at = self._clock() + cooldown
+        return entry.failures
+
+    def failures(self, host: str) -> int:
+        entry = self._hosts.get(host)
+        return entry.failures if entry else 0
+
+    def is_admissible(self, host: str) -> bool:
+        """Clean hosts and hosts whose cooldown has elapsed are fair
+        game; re-admission is implicit (no state change needed)."""
+        entry = self._hosts.get(host)
+        return entry is None or self._clock() >= entry.readmit_at
+
+    def readmission_in(self, host: str) -> float:
+        """Seconds until ``host`` is admissible again (0 when it already
+        is)."""
+        entry = self._hosts.get(host)
+        if entry is None:
+            return 0.0
+        return max(entry.readmit_at - self._clock(), 0.0)
+
+    def select(self, hosts: Sequence[str],
+               prefer: Optional[str] = None) -> str:
+        """Pick a respawn host: ``prefer`` (the failed rank's original
+        host) if admissible, else the first admissible candidate in
+        order, else the candidate closest to re-admission (degraded
+        single-host mode — see module docstring)."""
+        if not hosts:
+            raise ValueError("no candidate hosts")
+        if prefer is not None and prefer in hosts \
+                and self.is_admissible(prefer):
+            return prefer
+        for host in hosts:
+            if self.is_admissible(host):
+                return host
+        return min(hosts, key=lambda h: (self.readmission_in(h),
+                                         hosts.index(h)))
+
+    def blacklisted(self) -> List[str]:
+        now = self._clock()
+        return sorted(
+            h for h, e in self._hosts.items() if now < e.readmit_at
+        )

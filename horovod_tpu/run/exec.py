@@ -155,6 +155,46 @@ class ProcessSet:
         with self._lock:
             self._procs.append(_Proc(rank, popen, threads))
 
+    # -- per-rank lifecycle (elastic launcher) ---------------------------
+    # wait() keeps the reference's all-or-nothing contract (first failure
+    # kills the job); the elastic monitor instead polls exits rank by
+    # rank, discards the dead entry, and relaunches into the same set.
+
+    def poll_exits(self) -> List[tuple]:
+        """Reap newly exited workers: returns ``[(rank, returncode)]``
+        and removes them from the set (their stream pumps drain on their
+        own).  Non-destructive to still-running workers."""
+        done: List[tuple] = []
+        with self._lock:
+            remaining = []
+            for p in self._procs:
+                rc = p.popen.poll()
+                if rc is None:
+                    remaining.append(p)
+                else:
+                    done.append((p.rank, rc))
+            self._procs = remaining
+        return done
+
+    def alive_ranks(self) -> List[int]:
+        with self._lock:
+            return sorted(
+                p.rank for p in self._procs if p.popen.poll() is None
+            )
+
+    def terminate_rank(self, rank: int) -> None:
+        """Tree-kill one worker (heartbeat-dead path: the process is
+        still alive as far as the OS knows, but the job has declared it
+        lost); its exit then surfaces through poll_exits()."""
+        with self._lock:
+            procs = [p for p in self._procs if p.rank == rank]
+        for p in procs:
+            if p.popen.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.popen.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
     def wait(self, timeout: Optional[float] = None) -> Dict[int, int]:
         """Wait for all; on first non-zero exit, terminate the rest and
         raise.  Returns {rank: returncode} when all succeed."""

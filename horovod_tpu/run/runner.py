@@ -27,6 +27,7 @@ from .allocate import (
     parse_hostfile,
     parse_hosts,
 )
+from .blacklist import HostBlacklist
 from .config_parser import _StoreOverrideAction, _StoreTrueOverrideAction
 from .exec import ProcessSet, make_ssh_command
 
@@ -92,6 +93,32 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "driver_service.py:128-197).",
     )
     parser.add_argument("--verbose", action="store_true", dest="verbose")
+
+    elastic = parser.add_argument_group("elastic fault tolerance")
+    elastic.add_argument(
+        "--elastic", action="store_true", dest="elastic",
+        help="Launch in elastic mode: per-rank failure detection, host "
+             "blacklisting, bounded respawn into a re-minted rendezvous "
+             "epoch (workers use the horovod_tpu.elastic API).",
+    )
+    elastic.add_argument(
+        "--min-workers", type=int, action=_StoreOverrideAction,
+        dest="min_workers", default=None,
+        help="Smallest world the elastic job may shrink to once the "
+             "respawn budget is spent (default: np — never shrink).",
+    )
+    elastic.add_argument(
+        "--max-elastic-retries", type=int, action=_StoreOverrideAction,
+        dest="max_elastic_retries", default=None,
+        help="Total failed-rank respawns across the job (default 3).",
+    )
+    elastic.add_argument(
+        "--blacklist-cooldown-secs", type=float,
+        action=_StoreOverrideAction,
+        dest="blacklist_cooldown_secs", default=None,
+        help="Base host-blacklist cooldown; doubles per repeat failure "
+             "(default 10).",
+    )
     parser.add_argument(
         "--output-filename", action=_StoreOverrideAction,
         dest="output_filename", default=None,
@@ -401,31 +428,321 @@ def launch_job(
     procs.install_signal_handlers()
     for slot in slots:
         slot_env = build_slot_env(slot, coordinator, base_env)
-        if is_local_host(slot.hostname):
-            procs.launch(slot.rank, command, slot_env, tag_output=tag_output,
-                         output_dir=output_filename, num_proc=np)
-        else:
-            # Remote slots go over ssh with env inlined (reference
-            # gloo_run get_remote_command); only HVDTPU_/JAX_/XLA_ vars
-            # travel — a full env copy would break the remote shell.
-            travel = {
-                k: v
-                for k, v in slot_env.items()
-                if k.startswith(("HVDTPU_", "JAX_", "XLA_", "TPU_"))
-            }
-            ssh_cmd, stdin_data = make_ssh_command(
-                slot.hostname, command, travel, ssh_port
-            )
-            procs.launch(
-                slot.rank,
-                ssh_cmd,
-                base_env,
-                tag_output=tag_output,
-                stdin_data=stdin_data,
-                output_dir=output_filename,
-                num_proc=np,
-            )
+        _spawn_worker(
+            procs, slot.rank, slot.hostname, command, slot_env, base_env,
+            ssh_port=ssh_port, tag_output=tag_output,
+            output_dir=output_filename, num_proc=np,
+        )
     return procs.wait(timeout=job_timeout)
+
+
+def _spawn_worker(
+    procs, rank: int, host: str, command: List[str],
+    worker_env: Dict[str, str], local_env: Dict[str, str], *,
+    ssh_port: Optional[int], tag_output: bool,
+    output_dir: Optional[str], num_proc: int,
+) -> None:
+    """Shared local/ssh rank spawn for :func:`launch_job` and the
+    elastic monitor.  Local ranks get ``worker_env`` directly; remote
+    ranks go over ssh with env inlined (reference gloo_run
+    get_remote_command) — only the HVDTPU_/JAX_/XLA_/TPU_ families
+    travel, a full env copy would break the remote shell.  ``local_env``
+    is what the local ssh client process itself runs under."""
+    if is_local_host(host):
+        procs.launch(rank, command, worker_env, tag_output=tag_output,
+                     output_dir=output_dir, num_proc=num_proc)
+        return
+    travel = {
+        k: v for k, v in worker_env.items()
+        if k.startswith(("HVDTPU_", "JAX_", "XLA_", "TPU_"))
+    }
+    ssh_cmd, stdin_data = make_ssh_command(host, command, travel, ssh_port)
+    procs.launch(rank, ssh_cmd, local_env, tag_output=tag_output,
+                 stdin_data=stdin_data, output_dir=output_dir,
+                 num_proc=num_proc)
+
+
+class ElasticJobResult:
+    """What an elastic run leaves behind: per-rank exit codes of the
+    FINAL incarnation of each rank, the last epoch, the world (every
+    rank that completed and delivered a result), and the recovery
+    trace — a deterministic event list (no timestamps) so two runs with
+    the same fault spec compare equal."""
+
+    def __init__(self):
+        self.exit_codes: Dict[int, int] = {}
+        self.epoch = 0
+        self.world: List[int] = []
+        self.trace: List[tuple] = []
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"ElasticJobResult(epoch={self.epoch}, "
+                f"world={self.world}, trace={self.trace})")
+
+
+def launch_elastic_job(
+    command: List[str],
+    np: int,
+    hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    *,
+    env: Optional[Dict[str, str]] = None,
+    ssh_port: Optional[int] = None,
+    min_workers: Optional[int] = None,
+    max_retries: int = 3,
+    heartbeat_timeout: float = 60.0,
+    blacklist_cooldown: float = 10.0,
+    job_timeout: Optional[float] = None,
+    kv_server=None,
+    tag_output: bool = True,
+    output_filename: Optional[str] = None,
+) -> ElasticJobResult:
+    """Elastic counterpart of :func:`launch_job`: per-rank failure
+    detection (exit code + KV heartbeat), host blacklisting with
+    exponential-backoff re-admission, and bounded respawn of failed
+    ranks into a re-minted rendezvous epoch.
+
+    Worker contract: each rank runs ``command`` with the
+    ``HVDTPU_ELASTIC_*`` env (see elastic/context.py) and coordinates
+    through the launcher's KV store; jax.distributed is deliberately NOT
+    bootstrapped (its membership cannot survive a rank death).
+
+    ``min_workers``: once the respawn budget is spent, the job may
+    continue with a SHRUNKEN world as long as at least this many ranks
+    survive (default np — any unrecoverable failure aborts).
+    ``max_retries`` bounds total respawns across the job.
+    ``kv_server``: a caller-started rendezvous server already seeded
+    with job payloads (the python API path); created/stopped internally
+    when None.
+    """
+    import pickle  # noqa: PLC0415
+    import time  # noqa: PLC0415
+
+    from .rendezvous import (  # noqa: PLC0415
+        KVStoreClient, KVStoreServer, SECRET_ENV,
+    )
+
+    if min_workers is None:
+        min_workers = np
+    if not 1 <= min_workers <= np:
+        raise ValueError(
+            f"min_workers must be in [1, np]; got {min_workers} for np={np}"
+        )
+
+    host_slots = _resolve_host_slots(hosts, hostfile, f"localhost:{np}")
+    slots = allocate(host_slots, np)
+    host_of: Dict[int, str] = {s.rank: s.hostname for s in slots}
+    host_order: List[str] = []
+    for hs in host_slots:
+        if hs.hostname not in host_order:
+            host_order.append(hs.hostname)
+    all_local = all(is_local_host(h) for h in host_order)
+
+    owns_server = kv_server is None
+    if owns_server:
+        kv_server = KVStoreServer(bind_all=not all_local)
+        kv_server.start()
+    port = kv_server.port
+    kv = KVStoreClient(f"127.0.0.1:{port}", kv_server.secret)
+    if all_local:
+        kv_addr = f"127.0.0.1:{port}"
+    else:
+        from .allocate import routable_ip  # noqa: PLC0415
+
+        probe = next((h for h in host_order if not is_local_host(h)),
+                     "127.0.0.1")
+        kv_addr = f"{routable_ip(probe)}:{port}"
+
+    base_env = dict(os.environ)
+    if env:
+        base_env.update(env)
+    base_env[SECRET_ENV] = kv_server.secret
+    base_env["HVDTPU_ELASTIC_KV"] = kv_addr
+    if output_filename:
+        os.makedirs(output_filename, exist_ok=True)
+
+    result = ElasticJobResult()
+    trace = result.trace
+    blacklist = HostBlacklist(cooldown_base=blacklist_cooldown)
+    procs = ProcessSet()
+    procs.install_signal_handlers()
+
+    def mint_epoch(epoch: int, world: List[int]) -> None:
+        # World before epoch: a worker that sees the new epoch number
+        # must find its membership already published.
+        kv.put("elastic", f"world_{epoch}", pickle.dumps(sorted(world)))
+        kv.put("elastic", "epoch", str(epoch).encode())
+
+    def spawn(rank: int, host: str, epoch: int) -> None:
+        worker_env = dict(base_env)
+        worker_env.update({
+            "HVDTPU_ELASTIC_RANK": str(rank),
+            "HVDTPU_ELASTIC_EPOCH": str(epoch),
+            "HVDTPU_ELASTIC_NP": str(np),
+        })
+        # Epoch-qualified capture dir: a respawn must not truncate the
+        # dead incarnation's logs — they are the primary evidence of
+        # why it died.
+        out_dir = (os.path.join(output_filename, f"epoch.{epoch}")
+                   if output_filename else None)
+        _spawn_worker(
+            procs, rank, host, command, worker_env, base_env,
+            ssh_port=ssh_port, tag_output=tag_output,
+            output_dir=out_dir, num_proc=np,
+        )
+
+    def posted_error(rank: int, up_to_epoch: int) -> Optional[str]:
+        """A worker that RAISED (vs crashed) posted its traceback under
+        an epoch-qualified key before exiting; that diagnostic both
+        aborts the job and wins over the generic exit-code error."""
+        import cloudpickle  # noqa: PLC0415
+
+        for e in range(up_to_epoch + 1):
+            raw = kv.get("elastic", f"error_{rank}_{e}")
+            if raw is not None:
+                return cloudpickle.loads(raw)
+        return None
+
+    epoch = 0
+    world = list(range(np))
+    finished: Dict[int, int] = {}
+    hb_seen: Dict[int, tuple] = {}
+    hb_next_scan = 0.0
+    respawns_used = 0
+    deadline = time.monotonic() + job_timeout if job_timeout else None
+
+    try:
+        mint_epoch(epoch, world)
+        for rank in world:
+            spawn(rank, host_of[rank], epoch)
+            trace.append(("spawn", rank, epoch, host_of[rank]))
+
+        while True:
+            for rank, rc in procs.poll_exits():
+                if rc == 0:
+                    finished[rank] = 0
+                    continue
+                tb = posted_error(rank, epoch)
+                if tb is not None:
+                    raise RuntimeError(
+                        f"elastic rank {rank} raised:\n{tb}"
+                    )
+                host = host_of[rank]
+                count = blacklist.record_failure(host)
+                trace.append(("failure", rank, rc, epoch))
+                trace.append(("blacklist", host, count))
+                LOG.warning(
+                    "elastic: rank %d on %s exited %d (failure %d on "
+                    "this host)", rank, host, rc, count,
+                )
+                alive = procs.alive_ranks()
+                if not alive and finished:
+                    # Every peer already exited 0: a replacement would
+                    # have no survivor to sync state from and would
+                    # retrain alone from initial values.  The committed
+                    # result is already replicated across the finished
+                    # ranks — finish with them instead of respawning.
+                    if len(finished) < min_workers:
+                        raise RuntimeError(
+                            f"elastic job lost rank {rank} after only "
+                            f"{len(finished)} workers finished "
+                            f"(< min_workers={min_workers})"
+                        )
+                    epoch += 1
+                    world = sorted(finished)
+                    mint_epoch(epoch, world)
+                    trace.append(("shrink", epoch, tuple(world)))
+                    LOG.warning(
+                        "elastic: rank %d died after all peers finished; "
+                        "completing with %d/%d workers", rank,
+                        len(world), np,
+                    )
+                    continue
+                if respawns_used < max_retries:
+                    respawns_used += 1
+                    new_host = blacklist.select(host_order, prefer=host)
+                    host_of[rank] = new_host
+                    epoch += 1
+                    world = sorted(set(alive) | {rank})
+                    mint_epoch(epoch, world)
+                    # The dead incarnation's last observed beat must not
+                    # count against the successor's first-beat window.
+                    hb_seen.pop(rank, None)
+                    spawn(rank, new_host, epoch)
+                    trace.append(("respawn", rank, epoch, new_host))
+                elif len(set(alive) | set(finished)) >= min_workers:
+                    # Budget spent: continue with the shrunken world
+                    # (the dead rank's slot is dropped for good).
+                    # min_workers counts CONTRIBUTING ranks — alive ones
+                    # plus those that already delivered a result — so an
+                    # early finisher is not held against the job.
+                    epoch += 1
+                    world = sorted(alive)
+                    mint_epoch(epoch, world)
+                    trace.append(("shrink", epoch, tuple(world)))
+                    LOG.warning(
+                        "elastic: respawn budget spent; continuing with "
+                        "%d/%d workers", len(world), np,
+                    )
+                else:
+                    raise RuntimeError(
+                        f"elastic job lost rank {rank} with the respawn "
+                        f"budget spent and only "
+                        f"{len(set(alive) | set(finished))} workers "
+                        f"contributing (< min_workers={min_workers})"
+                    )
+            if (heartbeat_timeout and heartbeat_timeout > 0
+                    and time.monotonic() >= hb_next_scan):
+                # Beats only change once per worker heartbeat period, so
+                # scanning them on every 50 ms monitor tick is np wasted
+                # KV round-trips; exits stay on the fast tick.
+                hb_next_scan = time.monotonic() + min(
+                    1.0, heartbeat_timeout / 4
+                )
+                # Staleness is judged entirely on the launcher's clock —
+                # the window starts when the launcher OBSERVES a new beat
+                # value, never by comparing against the worker's wall
+                # clock (cross-host skew > timeout would otherwise kill
+                # healthy remote workers in a loop).
+                now = time.monotonic()
+                for rank in procs.alive_ranks():
+                    raw = kv.get("elastic", f"hb_{rank}")
+                    if raw is None:
+                        continue  # not beating yet (still importing)
+                    seen = hb_seen.get(rank)
+                    if seen is None or seen[0] != raw:
+                        hb_seen[rank] = (raw, now)
+                        continue
+                    if now - seen[1] > heartbeat_timeout:
+                        trace.append(("heartbeat_lost", rank, epoch))
+                        LOG.warning(
+                            "elastic: rank %d heartbeat stale > %.0fs; "
+                            "declaring it dead", rank, heartbeat_timeout,
+                        )
+                        # Restart the window so the successor incarnation
+                        # gets a full timeout before its first beat lands.
+                        hb_seen.pop(rank, None)
+                        procs.terminate_rank(rank)
+            if all(r in finished for r in world):
+                result.exit_codes = dict(finished)
+                result.epoch = epoch
+                # Every rank that delivered a result — not just the last
+                # rendezvous world, which drops ranks that finished
+                # before a late respawn/shrink re-formed it.
+                result.world = sorted(finished)
+                return result
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic job timed out after {job_timeout}s "
+                    f"(finished={sorted(finished)}, world={world})"
+                )
+            time.sleep(0.05)
+    except BaseException:
+        procs.terminate()
+        raise
+    finally:
+        if owns_server:
+            kv_server.stop()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -468,6 +785,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     config_parser.set_env_from_args(env, args)
     try:
         LOG.info("launching %d processes: %s", args.np, " ".join(command))
+        if getattr(args, "elastic", False):
+            launch_elastic_job(
+                command,
+                args.np,
+                hosts=args.hosts,
+                hostfile=args.hostfile,
+                env=env,
+                ssh_port=args.ssh_port,
+                min_workers=getattr(args, "min_workers", None),
+                # `x or default` would coerce an EXPLICIT 0 (zero
+                # respawns / zero cooldown) back to the default.
+                max_retries=(
+                    3 if getattr(args, "max_elastic_retries", None) is None
+                    else args.max_elastic_retries
+                ),
+                blacklist_cooldown=(
+                    10.0
+                    if getattr(args, "blacklist_cooldown_secs", None) is None
+                    else args.blacklist_cooldown_secs
+                ),
+                output_filename=args.output_filename,
+            )
+            return 0
         launch_job(
             command,
             args.np,

@@ -10,6 +10,7 @@ import traceback
 
 import cloudpickle
 
+from ..testing.faults import maybe_fail
 from .rendezvous import KVStoreClient
 
 _SCOPE = "runfunc"
@@ -18,6 +19,10 @@ _SCOPE = "runfunc"
 def main() -> int:
     addr = os.environ["HVDTPU_RUN_FUNC_ADDR"]
     rank = int(os.environ.get("HVDTPU_RANK", "0"))
+    # Chaos point "task_fn": kill (or fail) a worker before the user
+    # function runs — the launcher-side failure-propagation surface
+    # (HVDTPU_FAULT_SPEC="task_fn:rank=1").
+    maybe_fail("task_fn", rank=rank)
     client = KVStoreClient(addr)
     blob = client.wait(_SCOPE, "func", timeout=60)
     func, args, kwargs = cloudpickle.loads(blob)

@@ -40,6 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..basics import global_topology
+from ..exceptions import HorovodShutdownError
+from ..testing.faults import maybe_fail
 from ..utils import env as envmod
 from ..utils.logging import get_logger
 from . import response_cache as rcache
@@ -258,6 +260,10 @@ class EagerEngine:
         postscale: float = 1.0,
     ) -> concurrent.futures.Future:
         """reference EnqueueTensorAllreduce/... operations.cc:803-954."""
+        # Deterministic chaos (HVDTPU_FAULT_SPEC "enqueue:..."): fail the
+        # submission before it reaches negotiation, the same surface an
+        # OOM snapshotting the payload or a dead transport would present.
+        maybe_fail("enqueue", name=name)
         shape = tuple(tensor.shape) if tensor is not None else ()
         dtype = str(tensor.dtype) if tensor is not None else "float32"
         req = Request(
@@ -288,7 +294,9 @@ class EagerEngine:
             return entry.future
         with self._lock:
             if self._done:
-                entry.future.set_exception(RuntimeError(SHUT_DOWN_ERROR))
+                entry.future.set_exception(
+                    HorovodShutdownError(SHUT_DOWN_ERROR)
+                )
                 return entry.future
             if name in self._table:
                 entry.future.set_exception(
@@ -355,7 +363,10 @@ class EagerEngine:
             elapsed = time.monotonic() - start
             if elapsed < self.cycle_s:
                 time.sleep(self.cycle_s - elapsed)
-        self._fail_all(RuntimeError(SHUT_DOWN_ERROR))
+        # Typed so elastic.run can classify engine teardown as recoverable
+        # (HorovodShutdownError subclasses RuntimeError — pre-elastic call
+        # sites keep working).
+        self._fail_all(HorovodShutdownError(SHUT_DOWN_ERROR))
         self._done = True
 
     def _run_loop_once(self) -> bool:

@@ -1,0 +1,7 @@
+"""Test-support machinery shipped with the package (not under tests/):
+the deterministic fault-injection registry lives here because its
+injection points are compiled into production code paths (checkpoint
+writes, engine enqueue, worker entry) and must be importable wherever
+those run."""
+
+from . import faults  # noqa: F401

@@ -1,0 +1,212 @@
+"""Deterministic, env-driven fault injection.
+
+Chaos testing the elastic subsystem needs real failures — a rank dying
+mid-step, a checkpoint write erroring on rank 0 — that are *reproducible*
+under ``JAX_PLATFORMS=cpu`` in tier-1.  This registry provides them: the
+launcher (or a test) sets ``HVDTPU_FAULT_SPEC`` and the injection points
+threaded through production code fire exactly where the spec says, every
+run, no timing dependence.
+
+Grammar::
+
+    HVDTPU_FAULT_SPEC := fault ("," fault)*
+    fault             := point (":" key "=" value)*
+    key               := rank | step | epoch | count | action | code | name
+
+    HVDTPU_FAULT_SPEC="ckpt_write:step=3:rank=0,worker_exit:step=5:rank=2"
+
+* ``point`` — the injection-site name.  Sites wired in this PR:
+  ``ckpt_write`` (checkpoint.py rank-0 write), ``enqueue`` (eager-engine
+  enqueue path), ``worker_exit`` (elastic context, once per collective;
+  also run/task_fn.py at function start), ``task_fn`` (run/task_fn.py
+  before the user function runs).
+* ``rank`` — only fire on this rank (resolved from the ``rank=`` call
+  argument, else ``HVDTPU_RANK``, else ``HVDTPU_ELASTIC_RANK``).  Absent
+  means any rank.
+* ``step`` — fire when the observed step equals N.  Call sites with a
+  natural step (checkpoint saves) pass it explicitly; sites without one
+  (enqueue) use the per-point 1-based invocation counter.  Absent means
+  the first eligible call.
+* ``epoch`` — the rendezvous epoch to fire in, default 0 (``any`` to
+  disable the filter).  The default is what keeps chaos runs convergent:
+  a respawned worker re-executes the same steps at epoch >= 1 and must
+  NOT re-trigger the fault that killed its predecessor.
+* ``count`` — times to fire (default 1).
+* ``action`` — ``raise`` (default) raises :class:`InjectedFault`;
+  ``exit`` calls ``os._exit(code)``.  ``worker_exit``/``task_fn`` points
+  default to ``exit``.
+* ``code`` — exit code for ``action=exit`` (default 43, distinguishable
+  from real crashes in launcher traces).
+* ``name`` — only fire when the call site passes a matching ``name=``
+  (e.g. a tensor name on the enqueue path).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["InjectedFault", "maybe_fail", "parse_spec", "reset", "active"]
+
+SPEC_ENV = "HVDTPU_FAULT_SPEC"
+DEFAULT_EXIT_CODE = 43
+_EXIT_POINTS = ("worker_exit", "task_fn")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a fired ``action=raise`` fault; carries the site name."""
+
+    def __init__(self, point: str, detail: str):
+        super().__init__(
+            f"injected fault at {point!r} ({detail}) — HVDTPU_FAULT_SPEC"
+        )
+        self.point = point
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    rank: Optional[int] = None
+    step: Optional[int] = None
+    epoch: Optional[int] = 0
+    count: int = 1
+    action: str = "raise"
+    code: int = DEFAULT_EXIT_CODE
+    name: Optional[str] = None
+    fired: int = field(default=0, compare=False)
+
+    def describe(self) -> str:
+        parts = [self.point]
+        for k in ("rank", "step", "epoch", "count", "name"):
+            v = getattr(self, k)
+            if v is not None:
+                parts.append(f"{k}={v}")
+        return ":".join(parts)
+
+
+def parse_spec(raw: str) -> List[FaultSpec]:
+    """Parse a spec string; raises ``ValueError`` on malformed entries so
+    a typo'd spec fails the run loudly instead of silently never firing."""
+    specs: List[FaultSpec] = []
+    for chunk in raw.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = chunk.split(":")
+        point = fields[0].strip()
+        if not point:
+            raise ValueError(f"fault spec entry has no point name: {chunk!r}")
+        spec = FaultSpec(point=point)
+        if point in _EXIT_POINTS:
+            spec.action = "exit"
+        for kv in fields[1:]:
+            if "=" not in kv:
+                raise ValueError(
+                    f"fault spec field {kv!r} in {chunk!r} is not key=value"
+                )
+            key, value = (s.strip() for s in kv.split("=", 1))
+            if key in ("rank", "step", "count", "code"):
+                setattr(spec, key, int(value))
+            elif key == "epoch":
+                spec.epoch = None if value in ("any", "*") else int(value)
+            elif key == "action":
+                if value not in ("raise", "exit"):
+                    raise ValueError(f"unknown fault action {value!r}")
+                spec.action = value
+            elif key == "name":
+                spec.name = value
+            else:
+                raise ValueError(
+                    f"unknown fault spec key {key!r} in {chunk!r}"
+                )
+        specs.append(spec)
+    return specs
+
+
+# Parsed cache, keyed by the raw env string so a test that monkeypatches
+# the env (or calls reset()) gets a fresh registry.
+_cache_raw: Optional[str] = None
+_specs: Dict[str, List[FaultSpec]] = {}
+_counters: Dict[str, int] = {}
+
+
+def reset() -> None:
+    """Drop the parsed registry and per-point counters (tests)."""
+    global _cache_raw
+    _cache_raw = None
+    _specs.clear()
+    _counters.clear()
+
+
+def _load() -> Dict[str, List[FaultSpec]]:
+    global _cache_raw
+    raw = os.environ.get(SPEC_ENV, "")
+    if raw != _cache_raw:
+        _specs.clear()
+        _counters.clear()
+        for spec in parse_spec(raw):
+            _specs.setdefault(spec.point, []).append(spec)
+        _cache_raw = raw
+    return _specs
+
+
+def active() -> bool:
+    """True when any fault spec is loaded (cheap hot-path gate)."""
+    return bool(_load())
+
+
+def _resolve_rank(rank: Optional[int]) -> Optional[int]:
+    if rank is not None:
+        return rank
+    for env in ("HVDTPU_RANK", "HVDTPU_ELASTIC_RANK"):
+        value = os.environ.get(env)
+        if value not in (None, ""):
+            return int(value)
+    return None
+
+
+def _resolve_epoch() -> int:
+    value = os.environ.get("HVDTPU_ELASTIC_EPOCH")
+    return int(value) if value not in (None, "") else 0
+
+
+def maybe_fail(
+    point: str,
+    *,
+    step: Optional[int] = None,
+    rank: Optional[int] = None,
+    name: Optional[str] = None,
+) -> None:
+    """Fire any matching fault for ``point``; no-op when none match.
+
+    ``step=None`` uses the per-point invocation counter (1-based) — the
+    counter advances on every call whether or not a fault fires, so
+    ``step=N`` deterministically means "the Nth visit to this point".
+    """
+    specs = _load().get(point)
+    counter = None
+    if specs is not None or point in _counters:
+        counter = _counters[point] = _counters.get(point, 0) + 1
+    if not specs:
+        return
+    observed_step = step if step is not None else counter
+    observed_rank = _resolve_rank(rank)
+    observed_epoch = _resolve_epoch()
+    for spec in specs:
+        if spec.fired >= spec.count:
+            continue
+        if spec.rank is not None and spec.rank != observed_rank:
+            continue
+        if spec.step is not None and spec.step != observed_step:
+            continue
+        if spec.epoch is not None and spec.epoch != observed_epoch:
+            continue
+        if spec.name is not None and spec.name != name:
+            continue
+        spec.fired += 1
+        if spec.action == "exit":
+            # os._exit, not sys.exit: the injected death must look like a
+            # hard crash (no atexit, no finally blocks posting results).
+            os._exit(spec.code)
+        raise InjectedFault(point, spec.describe())

@@ -23,10 +23,17 @@ def load(paths):
     rows = []
     for path in paths:
         with open(path) as f:
-            for line in f:
+            for n, line in enumerate(f, 1):
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                try:
                     rows.append(json.loads(line))
+                except ValueError:
+                    # A truncated line (sweep killed mid-write) must not
+                    # take the whole summary down with it.
+                    rows.append({"config": f"{path}:{n}", "result": None,
+                                 "malformed": True})
     return rows
 
 
@@ -39,23 +46,34 @@ def main() -> int:
     reps = defaultdict(list)
     singles = []
     for row in rows:
-        r = row["result"]
-        if r is None:
-            singles.append((row["config"], None))
+        config = row.get("config", "(unnamed)")
+        r = row.get("result")
+        # A row whose result lacks value/unit (a bench that died after
+        # emitting a partial object) renders as one (malformed) line
+        # instead of KeyError-ing the whole summary.
+        if isinstance(r, dict) and r.get("value") is None:
+            singles.append((config, "malformed"))
             continue
-        m = re.fullmatch(r"(.*)_rep\d+", row["config"])
+        if r is None:
+            singles.append(
+                (config, "malformed" if row.get("malformed") else None)
+            )
+            continue
+        m = re.fullmatch(r"(.*)_rep\d+", config)
         if m:
             reps[m.group(1)].append(r)
         else:
-            singles.append((row["config"], r))
+            singles.append((config, r))
 
     print("| Config | value | unit | MFU |")
     print("|---|---|---|---|")
     for name, r in singles:
-        if r is None:
+        if r == "malformed":
+            print(f"| {name} | (malformed) | | |")
+        elif r is None:
             print(f"| {name} | (no result) | | |")
         else:
-            print(f"| {name} | {r['value']:,} | {r['unit']} "
+            print(f"| {name} | {r['value']:,} | {r.get('unit', '')} "
                   f"| {r.get('mfu')} |")
     medians = {}
     for name, results in sorted(reps.items()):
@@ -66,7 +84,7 @@ def main() -> int:
         mfus = [r["mfu"] for r in results if r.get("mfu") is not None]
         mfu = statistics.median(mfus) if mfus else ""
         print(f"| {name} (median of {len(vals)}) | {med:,} "
-              f"| {results[0]['unit']} ± {spread:.1f}% | {mfu} |")
+              f"| {results[0].get('unit', '')} ± {spread:.1f}% | {mfu} |")
 
     fp8 = next((v for k, v in medians.items() if "fp8" in k), None)
     bf16 = next((v for k, v in medians.items()

@@ -160,3 +160,46 @@ def test_async_save_failure_raises_at_wait(tmp_path):
         handle.wait()
     with pytest.raises(Exception):
         handle.wait()
+
+
+def _async_save_with_injected_fault(directory):
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.checkpoint import save_checkpoint_async
+
+    hvd.init()
+    state = {"w": np.zeros(3, np.float32)}
+    handle = save_checkpoint_async(directory, state, step=5)
+    try:
+        path = handle.wait()
+        out = {"raised": False, "msg": path}
+    except Exception as exc:  # noqa: BLE001 — the contract under test
+        out = {"raised": True, "msg": str(exc)}
+    out["rank"] = hvd.rank()
+    hvd.shutdown()
+    return out
+
+
+@pytest.mark.multiprocess
+def test_injected_ckpt_failure_raises_on_all_ranks(tmp_path):
+    """ISSUE 1 satellite (ADVICE r5 #2): a failed rank-0 save must raise
+    at wait() on EVERY rank — survivors may not silently return the step
+    path and train on believing the commit point exists.  The failure is
+    injected deterministically via HVDTPU_FAULT_SPEC."""
+    import horovod_tpu.run as hvdrun
+
+    results = hvdrun.run(
+        _async_save_with_injected_fault,
+        args=(str(tmp_path / "ckpt"),),
+        np=2, use_cpu=True, timeout=180,
+        env={"HVDTPU_FAULT_SPEC": "ckpt_write:step=5:rank=0"},
+    )
+    by_rank = {r["rank"]: r for r in results}
+    assert by_rank[0]["raised"], by_rank
+    assert "injected fault at 'ckpt_write'" in by_rank[0]["msg"]
+    assert by_rank[1]["raised"], (
+        "rank 1 silently blessed a save that failed on rank 0: "
+        f"{by_rank[1]['msg']}"
+    )
+    assert "failed on rank 0" in by_rank[1]["msg"]

@@ -1,0 +1,730 @@
+"""Elastic fault tolerance (horovod_tpu/elastic/ + run/blacklist.py +
+testing/faults.py): commit/rollback/sync semantics, the recover-and-resume
+loop, host blacklisting with backoff, and the end-to-end chaos acceptance
+from ISSUE 1 — a 4-process job losing a rank mid-training recovers via
+rollback + respawn to the same final state as a no-fault run, with a
+deterministic recovery trace."""
+
+import importlib
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu.elastic as elastic
+from horovod_tpu.elastic.context import ElasticContext
+from horovod_tpu.elastic.exceptions import (
+    HorovodShutdownError,
+    RankDroppedError,
+    WorkersAvailableException,
+)
+from horovod_tpu.run.blacklist import HostBlacklist
+from horovod_tpu.run.rendezvous import KVStoreClient, KVStoreServer
+from horovod_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts with an empty fault registry and no leaked
+    ambient context."""
+    monkeypatch.delenv(faults.SPEC_ENV, raising=False)
+    faults.reset()
+    elastic.reset_context()
+    yield
+    faults.reset()
+    elastic.reset_context()
+
+
+# ---------------------------------------------------------------------------
+# State: commit / restore / sync
+# ---------------------------------------------------------------------------
+
+
+def test_state_commit_restore_roundtrip():
+    state = elastic.State(w=np.arange(4.0), step=0, meta={"lr": 0.1})
+    state.w = state.w + 1
+    state.step = 3
+    state.commit()
+    state.w = state.w * 100
+    state.step = 9
+    state.meta["lr"] = 0.5
+    state.restore()
+    np.testing.assert_array_equal(state.w, np.arange(4.0) + 1)
+    assert state.step == 3
+    assert state.meta == {"lr": 0.1}
+    assert state.commits == 1
+
+
+def test_state_restore_without_commit_rewinds_to_init():
+    state = elastic.State(step=7)
+    state.step = 99
+    state.restore()
+    assert state.step == 7
+    assert state.commits == 0
+
+
+def test_state_snapshot_is_isolated_from_live_values():
+    """commit() must deep-copy: later in-place mutation of the live
+    arrays may not corrupt the rollback point."""
+    w = np.zeros(3)
+    state = elastic.State(w=w)
+    state.commit()
+    w += 5  # in-place on the live buffer
+    state.restore()
+    np.testing.assert_array_equal(state.w, np.zeros(3))
+
+
+def test_state_jax_arrays_snapshot_to_host():
+    import jax.numpy as jnp
+
+    state = elastic.State(w=jnp.ones(2))
+    state.commit()
+    state.w = jnp.zeros(2)
+    state.restore()
+    np.testing.assert_array_equal(np.asarray(state.w), np.ones(2))
+
+
+def test_state_register_and_unknown_attr():
+    state = elastic.State(a=1)
+    state.register(b=2)
+    assert state.b == 2
+    assert sorted(state.values()) == ["a", "b"]
+    with pytest.raises(AttributeError, match="no value 'missing'"):
+        state.missing
+
+
+def test_state_sync_is_identity_on_local_context():
+    state = elastic.State(w=np.ones(2), step=4)
+    state.commit()
+    state.sync()
+    np.testing.assert_array_equal(state.w, np.ones(2))
+    assert state.step == 4
+
+
+class _FakeCtx:
+    """Scripted context: fails the first ``fail_first`` rendezvous-cycle
+    executions of the wrapped fn with the given exception class."""
+
+    def __init__(self, fail_first=0, exc=HorovodShutdownError):
+        self.rank, self.size, self.epoch, self.world = 0, 1, 0, (0,)
+        self.rendezvous_calls = 0
+        self.sync_calls = 0
+        self._fail_first = fail_first
+        self._exc = exc
+
+    def rendezvous(self, timeout=None):
+        self.rendezvous_calls += 1
+        return self.epoch
+
+    def world_changed(self):
+        return False
+
+    def sync_state(self, blob, commit_count):
+        self.sync_calls += 1
+        return blob
+
+    def maybe_fail(self):
+        if self._fail_first > 0:
+            self._fail_first -= 1
+            raise self._exc("scripted failure")
+
+
+def test_run_rolls_back_and_resumes(monkeypatch):
+    run_mod = importlib.import_module("horovod_tpu.elastic.run")
+
+    ctx = _FakeCtx(fail_first=2)
+    monkeypatch.setattr(run_mod, "_ambient_context", lambda: ctx)
+
+    state = elastic.State(step=0, log=[])
+
+    @elastic.run
+    def loop(state):
+        while state.step < 4:
+            ctx.maybe_fail()  # dies twice, at step 0 of attempts 1 and 2
+            state.log = state.log + [state.step]
+            state.step += 1
+            state.commit()
+        return state.step
+
+    assert loop(state) == 4
+    # three attempts -> three rendezvous + sync cycles
+    assert ctx.rendezvous_calls == 3
+    assert ctx.sync_calls == 3
+    # rollback semantics: no step was double-applied after recovery
+    assert state.log == [0, 1, 2, 3]
+
+
+def test_run_exhausts_retry_budget(monkeypatch):
+    run_mod = importlib.import_module("horovod_tpu.elastic.run")
+
+    ctx = _FakeCtx(fail_first=99)
+    monkeypatch.setattr(run_mod, "_ambient_context", lambda: ctx)
+    monkeypatch.setenv(run_mod.MAX_RETRIES_ENV, "2")
+
+    @elastic.run
+    def loop(state):
+        ctx.maybe_fail()
+        return "unreachable"
+
+    with pytest.raises(HorovodShutdownError, match="retry budget"):
+        loop(elastic.State(step=0))
+
+
+def test_run_absorbs_workers_available(monkeypatch):
+    run_mod = importlib.import_module("horovod_tpu.elastic.run")
+
+    ctx = _FakeCtx(fail_first=1, exc=WorkersAvailableException)
+    monkeypatch.setattr(run_mod, "_ambient_context", lambda: ctx)
+
+    @elastic.run
+    def loop(state):
+        ctx.maybe_fail()
+        return state.step
+
+    assert loop(elastic.State(step=1)) == 1
+    assert ctx.rendezvous_calls == 2
+
+
+def test_run_propagates_user_errors(monkeypatch):
+    """Only world breakage is recoverable; user bugs surface unchanged."""
+    run_mod = importlib.import_module("horovod_tpu.elastic.run")
+
+    ctx = _FakeCtx()
+    monkeypatch.setattr(run_mod, "_ambient_context", lambda: ctx)
+
+    @elastic.run
+    def loop(state):
+        raise ValueError("user bug")
+
+    with pytest.raises(ValueError, match="user bug"):
+        loop(elastic.State())
+    assert ctx.rendezvous_calls == 1
+
+
+def test_run_recovers_from_sync_failure(monkeypatch):
+    """A peer dying while THIS rank is mid-sync (a cascading second
+    failure) retries like a failure inside fn, not a job abort."""
+    run_mod = importlib.import_module("horovod_tpu.elastic.run")
+
+    ctx = _FakeCtx()
+    fails = iter([HorovodShutdownError("peer died mid-sync")])
+    real_sync = ctx.sync_state
+
+    def flaky_sync(blob, commit_count):
+        exc = next(fails, None)
+        if exc is not None:
+            raise exc
+        return real_sync(blob, commit_count)
+
+    ctx.sync_state = flaky_sync
+    monkeypatch.setattr(run_mod, "_ambient_context", lambda: ctx)
+
+    @elastic.run
+    def loop(state):
+        return state.step
+
+    assert loop(elastic.State(step=7)) == 7
+    assert ctx.rendezvous_calls == 2
+
+
+def test_run_reraises_rank_dropped(monkeypatch):
+    """A rank the launcher shrank past cannot rejoin; elastic.run must
+    not burn the retry budget on a rendezvous that can never succeed."""
+    run_mod = importlib.import_module("horovod_tpu.elastic.run")
+
+    ctx = _FakeCtx()
+
+    def dropped(timeout=None):
+        ctx.rendezvous_calls += 1
+        raise RankDroppedError("rank 0 is not a member")
+
+    ctx.rendezvous = dropped
+    monkeypatch.setattr(run_mod, "_ambient_context", lambda: ctx)
+
+    @elastic.run
+    def loop(state):
+        return "unreachable"
+
+    with pytest.raises(RankDroppedError):
+        loop(elastic.State())
+    assert ctx.rendezvous_calls == 1
+
+
+def test_commit_raises_on_world_change_after_snapshot(monkeypatch):
+    ctx = _FakeCtx()
+    flags = iter([True])
+    ctx.world_changed = lambda: next(flags, False)
+    state = elastic.State(step=0)
+    state._ctx = ctx
+    state.step = 5
+    with pytest.raises(WorkersAvailableException):
+        state.commit()
+    # the commit itself is durable: restore rewinds to it, not past it
+    state.step = 99
+    state.restore()
+    assert state.step == 5
+    assert state.commits == 1
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection registry
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_grammar():
+    specs = faults.parse_spec(
+        "ckpt_write:step=3:rank=0,worker_exit:step=5:rank=2,"
+        "enqueue:name=g7:action=raise:count=2:epoch=any"
+    )
+    assert [s.point for s in specs] == ["ckpt_write", "worker_exit",
+                                       "enqueue"]
+    assert specs[0].action == "raise" and specs[0].step == 3
+    # worker_exit defaults to a hard exit (looks like a crash)
+    assert specs[1].action == "exit" and specs[1].code == 43
+    assert specs[2].name == "g7" and specs[2].count == 2
+    assert specs[2].epoch is None  # 'any' disables the filter
+
+
+@pytest.mark.parametrize("bad", [
+    "ckpt_write:step",          # not key=value
+    ":step=1",                  # no point name
+    "ckpt_write:wat=1",         # unknown key
+    "ckpt_write:action=explode",  # unknown action
+])
+def test_fault_spec_malformed_raises(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_maybe_fail_step_and_count(monkeypatch):
+    monkeypatch.setenv(faults.SPEC_ENV, "pt:step=2:action=raise:count=1")
+    faults.reset()
+    faults.maybe_fail("pt")  # visit 1: no fire
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("pt")  # visit 2: fires
+    faults.maybe_fail("pt")  # count exhausted
+
+
+def test_maybe_fail_explicit_step_beats_counter(monkeypatch):
+    monkeypatch.setenv(faults.SPEC_ENV, "pt:step=7:action=raise")
+    faults.reset()
+    faults.maybe_fail("pt", step=3)
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("pt", step=7)
+
+
+def test_maybe_fail_rank_filter(monkeypatch):
+    monkeypatch.setenv(faults.SPEC_ENV, "pt:rank=1:action=raise")
+    faults.reset()
+    faults.maybe_fail("pt", rank=0)
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("pt", rank=1)
+
+
+def test_maybe_fail_epoch_filter_suppresses_respawned_rank(monkeypatch):
+    """The default epoch=0 filter is what makes chaos runs convergent: a
+    respawned worker re-executes the same step at epoch 1 and must NOT
+    re-trigger the fault that killed its predecessor."""
+    monkeypatch.setenv(faults.SPEC_ENV, "pt:step=1:action=raise")
+    monkeypatch.setenv("HVDTPU_ELASTIC_EPOCH", "1")
+    faults.reset()
+    faults.maybe_fail("pt")  # respawn world: suppressed
+    monkeypatch.setenv("HVDTPU_ELASTIC_EPOCH", "0")
+    faults.reset()
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("pt")
+
+
+def test_maybe_fail_inactive_is_cheap_noop():
+    assert not faults.active()
+    faults.maybe_fail("anything")  # no spec, no error
+
+
+# ---------------------------------------------------------------------------
+# Host blacklist
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_blacklist_exponential_backoff():
+    clock = _Clock()
+    bl = HostBlacklist(cooldown_base=10.0, cooldown_cap=35.0, clock=clock)
+    assert bl.is_admissible("h")
+    bl.record_failure("h")
+    assert not bl.is_admissible("h")
+    assert bl.readmission_in("h") == 10.0
+    clock.now = 10.0
+    assert bl.is_admissible("h")  # implicit re-admission
+    bl.record_failure("h")
+    assert bl.readmission_in("h") == 20.0  # doubled
+    clock.now = 30.0
+    bl.record_failure("h")
+    assert bl.readmission_in("h") == 35.0  # capped, not 40
+    assert bl.failures("h") == 3
+    assert bl.blacklisted() == ["h"]
+
+
+def test_blacklist_select_prefers_original_then_clean_host():
+    clock = _Clock()
+    bl = HostBlacklist(cooldown_base=10.0, clock=clock)
+    hosts = ["a", "b", "c"]
+    assert bl.select(hosts, prefer="b") == "b"
+    bl.record_failure("b")
+    assert bl.select(hosts, prefer="b") == "a"  # first admissible
+    bl.record_failure("a")
+    assert bl.select(hosts, prefer="b") == "c"
+
+
+def test_blacklist_single_host_degenerate_mode():
+    """All-blacklisted must pick the soonest-readmitted host, never
+    deadlock — on localhost jobs the only host is the only option."""
+    clock = _Clock()
+    bl = HostBlacklist(cooldown_base=10.0, clock=clock)
+    bl.record_failure("only")
+    assert bl.select(["only"], prefer="only") == "only"
+    bl.record_failure("x")
+    bl.record_failure("x")  # x readmits at 30, y at 10
+    bl.record_failure("y")
+    assert bl.select(["x", "y"]) == "y"
+
+
+def test_cli_explicit_zero_knobs_not_coerced(monkeypatch):
+    """`--max-elastic-retries 0` / `--blacklist-cooldown-secs 0` must
+    reach the launcher as 0 (immediate-shrink mode), not be `or`-coerced
+    back to the defaults."""
+    from horovod_tpu.run import runner
+
+    seen = {}
+
+    def fake_launch(command, np, **kwargs):
+        seen.update(kwargs)
+        return runner.ElasticJobResult()
+
+    monkeypatch.setattr(runner, "launch_elastic_job", fake_launch)
+    rc = runner.main([
+        "-np", "2", "--elastic", "--max-elastic-retries", "0",
+        "--blacklist-cooldown-secs", "0", "--min-workers", "1",
+        "python", "-c", "pass",
+    ])
+    assert rc == 0
+    assert seen["max_retries"] == 0
+    assert seen["blacklist_cooldown"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# ElasticContext against a real KV store (threads as ranks)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def kv_world():
+    server = KVStoreServer()
+    server.start()
+    kv = KVStoreClient(f"127.0.0.1:{server.port}", server.secret)
+
+    def mint(epoch, world):
+        kv.put("elastic", f"world_{epoch}", pickle.dumps(sorted(world)))
+        kv.put("elastic", "epoch", str(epoch).encode())
+
+    def ctx(rank, epoch=0, timeout=20.0):
+        return ElasticContext(
+            rank, KVStoreClient(f"127.0.0.1:{server.port}", server.secret),
+            epoch=epoch, timeout=timeout,
+        )
+
+    try:
+        yield kv, mint, ctx
+    finally:
+        server.stop()
+
+
+def _in_threads(*fns):
+    out = [None] * len(fns)
+    errs = [None] * len(fns)
+
+    def call(i, fn):
+        try:
+            out[i] = fn()
+        except BaseException as e:  # noqa: BLE001
+            errs[i] = e
+
+    threads = [threading.Thread(target=call, args=(i, f), daemon=True)
+               for i, f in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    return out, errs
+
+
+def test_context_rendezvous_and_allreduce(kv_world):
+    kv, mint, make_ctx = kv_world
+    mint(0, [0, 1])
+    c0, c1 = make_ctx(0), make_ctx(1)
+
+    def member(ctx):
+        ctx.rendezvous()
+        return ctx.allreduce(np.full(3, float(ctx.rank + 1)),
+                             name="g0", average=False).tolist()
+
+    out, errs = _in_threads(lambda: member(c0), lambda: member(c1))
+    assert errs == [None, None]
+    assert out == [[3.0] * 3, [3.0] * 3]
+    assert c0.size == 2 and c0.world == [0, 1]
+
+
+def test_context_sync_elects_highest_commit_count(kv_world):
+    kv, mint, make_ctx = kv_world
+    mint(0, [0, 1])
+    c0, c1 = make_ctx(0), make_ctx(1)
+
+    def member(ctx, blob, commits):
+        ctx.rendezvous()
+        return ctx.sync_state(blob, commits)
+
+    out, errs = _in_threads(
+        lambda: member(c0, b"stale", 0),      # a respawned rank
+        lambda: member(c1, b"fresh", 5),      # the survivor
+    )
+    assert errs == [None, None]
+    assert out == [b"fresh", b"fresh"]
+
+
+def test_context_epoch_bump_interrupts_wait(kv_world):
+    """A survivor blocked on a dead peer notices the launcher's re-minted
+    epoch and raises the recoverable shutdown error."""
+    kv, mint, make_ctx = kv_world
+    mint(0, [0, 1])
+    c0 = make_ctx(0, timeout=30.0)
+
+    def blocked():
+        c0.rendezvous(timeout=5.0)
+        return c0.allreduce(np.ones(1), name="g0")
+
+    def bump():
+        # wait until rank 0 checked in, then re-form the world without
+        # rank 1 (it "died" before ever contributing)
+        while kv.get("elastic", "ready_0_0") is None:
+            time.sleep(0.01)
+        mint(1, [0])
+        return True
+
+    # rank 1 checks in for rendezvous but never calls allreduce
+    c1 = make_ctx(1)
+    kv.put("elastic", "ready_0_1", b"1")
+
+    out, errs = _in_threads(blocked, bump)
+    assert isinstance(errs[0], HorovodShutdownError)
+    assert "re-formed" in str(errs[0])
+
+
+def test_context_dropped_rank_refuses_to_rejoin(kv_world):
+    kv, mint, make_ctx = kv_world
+    mint(0, [0, 2])
+    c1 = make_ctx(1)
+    with pytest.raises(RankDroppedError, match="not a member"):
+        c1.rendezvous(timeout=2.0)
+
+
+def test_context_recovery_requires_fresh_epoch(kv_world):
+    """After a world failure, re-rendezvousing into the SAME epoch is
+    refused — its keys still hold pre-failure values (stale collective
+    contributions, the epoch-start sync blob), so replaying rolled-back
+    steps against it would silently diverge from peers."""
+    kv, mint, make_ctx = kv_world
+    mint(0, [0])
+    c0 = make_ctx(0)
+    c0.rendezvous()
+    c0.notify_world_broken()
+    with pytest.raises(HorovodShutdownError, match="fresh epoch"):
+        c0.rendezvous(timeout=0.3)
+    mint(1, [0])
+    assert c0.rendezvous(timeout=5.0) == 1
+
+
+def test_context_auto_names_agree_after_respawn(kv_world):
+    """Collective numbering is per-epoch: a survivor deep into its own
+    _seq and a freshly respawned rank (seq 0) must mint the same default
+    names after re-rendezvousing, or every unnamed collective deadlocks
+    on recovery."""
+    kv, mint, make_ctx = kv_world
+    mint(0, [0])
+    c0 = make_ctx(0)
+    c0.rendezvous()
+    for _ in range(5):  # survivor's counter runs ahead pre-failure
+        c0.allreduce(np.ones(1))
+    mint(1, [0, 1])
+    c1 = make_ctx(1, epoch=1)  # the replacement, fresh process
+
+    def member(ctx):
+        ctx.rendezvous()
+        return ctx.allreduce(np.full(2, float(ctx.rank + 1)),
+                             average=False).tolist()
+
+    out, errs = _in_threads(lambda: member(c0), lambda: member(c1))
+    assert errs == [None, None]
+    assert out == [[3.0, 3.0], [3.0, 3.0]]
+    assert c0._seq == c1._seq == 1
+
+
+def test_context_rendezvous_timeout_names_missing_rank(kv_world):
+    kv, mint, make_ctx = kv_world
+    mint(0, [0, 1])
+    c0 = make_ctx(0)
+    with pytest.raises(HorovodShutdownError, match="rank 1"):
+        c0.rendezvous(timeout=0.5)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end chaos (real processes through the elastic launcher)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_train(total_steps=8):
+    import numpy as np  # noqa: PLC0415
+
+    import horovod_tpu.elastic as elastic  # noqa: PLC0415
+
+    ctx = elastic.context()
+    state = elastic.State(w=np.zeros(4, dtype=np.float64), step=0)
+
+    @elastic.run
+    def loop(state):
+        while state.step < total_steps:
+            grad = np.full(4, float(state.step + 1) * (ctx.rank + 1))
+            state.w = state.w - 0.1 * ctx.allreduce(
+                grad, name=f"g{state.step}")
+            state.step += 1
+            state.commit()
+        return state.w.tolist(), state.step
+
+    return loop(state)
+
+
+def _raising_fn():
+    raise ValueError("deliberate user bug")
+
+
+@pytest.mark.multiprocess
+def test_elastic_e2e_recovery_matches_no_fault_run():
+    """ISSUE 1 acceptance: 4-process elastic.run job; the fault spec
+    kills rank 2 mid-training; the job recovers via rollback + respawn
+    and finishes with state equal to a no-fault run; a second faulted
+    run produces the identical recovery trace."""
+    fault_env = {"HVDTPU_FAULT_SPEC": "worker_exit:step=5:rank=2",
+                 "JAX_PLATFORMS": "cpu"}
+    clean_env = {"JAX_PLATFORMS": "cpu"}
+
+    clean, clean_job = elastic.launch(
+        _chaos_train, np=4, env=clean_env, timeout=120)
+    faulted, job = elastic.launch(
+        _chaos_train, np=4, env=fault_env, max_retries=3, timeout=120)
+    faulted2, job2 = elastic.launch(
+        _chaos_train, np=4, env=fault_env, max_retries=3, timeout=120)
+
+    # recovered state == no-fault state, on every rank
+    assert faulted == clean
+    assert sorted(faulted) == [0, 1, 2, 3]
+    # the failure was actually injected and recovered from
+    events = [e[0] for e in job.trace]
+    assert events.count("failure") == 1
+    assert events.count("respawn") == 1
+    assert ("blacklist", "localhost", 1) in job.trace
+    assert job.epoch == 1 and job.world == [0, 1, 2, 3]
+    # determinism: identical spec -> identical recovery trace
+    assert job2.trace == job.trace
+    # and the no-fault run never recovered anything
+    assert [e[0] for e in clean_job.trace] == ["spawn"] * 4
+
+
+@pytest.mark.multiprocess
+def test_elastic_shrink_when_budget_spent():
+    """With the respawn budget at 0 and min_workers below np, losing a
+    rank shrinks the world instead of failing the job."""
+    env = {"HVDTPU_FAULT_SPEC": "worker_exit:step=3:rank=1",
+           "JAX_PLATFORMS": "cpu"}
+    results, job = elastic.launch(
+        _chaos_train, np=3, env=env, min_workers=2, max_retries=0,
+        timeout=120)
+    assert job.world == [0, 2]
+    assert sorted(results) == [0, 2]
+    events = [e[0] for e in job.trace]
+    assert "shrink" in events and "respawn" not in events
+    # the survivors completed all steps in the reduced world
+    assert all(results[r][1] == 8 for r in results)
+
+
+def _staggered_finish_crash_run():
+    import os  # noqa: PLC0415
+    import time  # noqa: PLC0415
+
+    import horovod_tpu.elastic as elastic  # noqa: PLC0415
+
+    ctx = elastic.context()
+    if ctx.rank == 1:
+        time.sleep(3.0)
+        os._exit(9)
+    if ctx.rank == 2:
+        time.sleep(6.0)
+    return ctx.rank
+
+
+@pytest.mark.multiprocess
+def test_elastic_min_workers_counts_finished_ranks():
+    """min_workers counts CONTRIBUTING ranks (alive + already finished):
+    an early finisher must not make a later crash abort a job that will
+    still deliver min_workers results."""
+    results, job = elastic.launch(
+        _staggered_finish_crash_run, np=3, min_workers=2, max_retries=0,
+        env={"JAX_PLATFORMS": "cpu"}, timeout=60)
+    assert job.world == [0, 2]
+    assert sorted(results) == [0, 2]
+    events = [e[0] for e in job.trace]
+    assert "shrink" in events and "respawn" not in events
+
+
+def _peers_finish_then_rank0_dies():
+    import os  # noqa: PLC0415
+    import time  # noqa: PLC0415
+
+    import horovod_tpu.elastic as elastic  # noqa: PLC0415
+
+    ctx = elastic.context()
+    if ctx.rank == 0:
+        time.sleep(3.0)  # peers return (and exit 0) well before this
+        os._exit(9)
+    return ctx.rank
+
+
+@pytest.mark.multiprocess
+def test_elastic_no_solo_respawn_after_peers_finished():
+    """A rank dying after every peer already exited 0 must NOT be
+    respawned into a world of one — the replacement would have no
+    survivor to sync from and would retrain alone from initial state.
+    The job finishes with the finished ranks' results instead."""
+    results, job = elastic.launch(
+        _peers_finish_then_rank0_dies, np=3, min_workers=1,
+        max_retries=3, env={"JAX_PLATFORMS": "cpu"}, timeout=60)
+    assert job.world == [1, 2]
+    assert sorted(results) == [1, 2]
+    events = [e[0] for e in job.trace]
+    assert "respawn" not in events
+    assert "shrink" in events
+
+
+@pytest.mark.multiprocess
+def test_elastic_user_exception_aborts_not_respawns():
+    """A user exception is a correctness error: the launcher surfaces the
+    traceback instead of burning the respawn budget on it."""
+    with pytest.raises(RuntimeError, match="deliberate user bug"):
+        elastic.launch(_raising_fn, np=2,
+                       env={"JAX_PLATFORMS": "cpu"}, timeout=60)
