@@ -30,6 +30,12 @@ import threading
 import time
 
 
+# Which phase the bench is in, for watchdog messages and the failure
+# sidecar ("import" until the guarded jax import completes;
+# _touch_progress advances it at every phase boundary).
+_phase_name = "import"
+
+
 def _budget_left(args) -> float:
     """Seconds until the TOTAL wall-clock budget expires.  The deadline is
     an epoch timestamp minted by the first process and carried through
@@ -49,6 +55,34 @@ def _reexec_next_attempt(args) -> None:
              [sys.executable, os.path.abspath(__file__)] + argv)
 
 
+def _write_failure_sidecar(args, why: str, outcome: str) -> None:
+    """Persist the failure diagnosis (most importantly WHICH phase was
+    stuck) to a sidecar JSON next to the bench.  Three rc=86 rounds
+    (BENCH_r03–r05) and the GQA compile hang were never diagnosed
+    because the only evidence was an exit code; the next one names its
+    phase.  Best-effort: a sidecar write must never mask the exit."""
+    try:
+        path = os.environ.get("HVDTPU_BENCH_SIDECAR") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "bench_last_failure.json",
+        )
+        doc = {
+            "why": why,
+            "phase": _phase_name,
+            "outcome": outcome,
+            "attempt": args.retry_attempt + 1,
+            "attempts_allowed": args.attempts + 1,
+            "budget_left_secs": round(_budget_left(args), 1),
+            "argv": sys.argv[1:],
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    except Exception:
+        pass
+
+
 def _give_up_or_retry(args, why: str) -> None:
     """Common tail for watchdog fires and UNAVAILABLE exceptions: re-exec
     if both a retry and enough budget for a cache-warmed attempt (~3 min)
@@ -56,12 +90,14 @@ def _give_up_or_retry(args, why: str) -> None:
     of an outer-timeout rc=124."""
     left = _budget_left(args)
     if args.retry_attempt < args.attempts and left > 180:
+        _write_failure_sidecar(args, why, outcome="retry")
         print(f"# {why} (attempt {args.retry_attempt + 1} of "
               f"{args.attempts + 1}, {left:.0f}s budget left); re-execing",
               file=sys.stderr, flush=True)
         _reexec_next_attempt(args)  # never returns
-    print(f"# {why}; no retries or budget left — giving up",
-          file=sys.stderr, flush=True)
+    _write_failure_sidecar(args, why, outcome="gave_up")
+    print(f"# {why} [phase: {_phase_name}]; no retries or budget left "
+          f"— giving up", file=sys.stderr, flush=True)
     os._exit(86)
 
 
@@ -126,6 +162,7 @@ if __name__ == "__main__" and not _IMPORT_GUARD.cpu:
 import jax  # noqa: E402  (guarded: may hang on a dead tunnel)
 
 _import_ok.set()
+_phase_name = "init"
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -377,16 +414,24 @@ _last_progress = time.monotonic()
 _phase_window = 300.0  # init phase default; _touch_progress re-sets it
 
 
-def _touch_progress(next_window: float = 300.0) -> None:
+def _touch_progress(next_window: float = 300.0,
+                    phase: str = None) -> None:
     """Mark a phase boundary (build / compile / warmup done) and set the
     NEXT phase's hang window.  The watchdog only fires when the current
     phase exceeds its own window, so a long but progressing run is never
     killed; the compile phase gets a wider window than init/warmup
     because legitimately slow XLA:TPU compiles exist (>10 min observed)
-    while a healthy backend init never takes more than ~2 min."""
-    global _last_progress, _phase_window
+    while a healthy backend init never takes more than ~2 min.
+
+    ``phase`` names the phase being ENTERED; the watchdog's fire message
+    and the failure sidecar carry it, so an rc=86 names the phase that
+    hung instead of leaving the next GQA-style compile hang a mystery.
+    """
+    global _last_progress, _phase_window, _phase_name
     _last_progress = time.monotonic()
     _phase_window = next_window
+    if phase is not None:
+        _phase_name = phase
 
 
 def _retry_exec(args, exc: BaseException) -> None:
@@ -436,7 +481,8 @@ def _arm_watchdog(args) -> None:
                 continue
             _give_up_or_retry(
                 args,
-                f"watchdog: no phase progress in {_phase_window:.0f}s")
+                f"watchdog: no progress in phase '{_phase_name}' for "
+                f"{_phase_window:.0f}s")
 
     threading.Thread(target=_fire, daemon=True).start()
 
@@ -541,10 +587,11 @@ def main() -> int:
         n_chips = static["n_chips"]
         global_batch = static["global_batch"]
         # init+build done; compile gets its own (wide) window
-        _touch_progress(next_window=args.watchdog_secs)
+        _touch_progress(next_window=args.watchdog_secs, phase="compile")
 
         compiled = step.lower(*carry, *const).compile()
-        _touch_progress(next_window=300)  # compile done; warmup window
+        # compile done; warmup window
+        _touch_progress(next_window=300, phase="warmup")
         try:
             flops_per_step_per_chip = float(
                 compiled.cost_analysis()["flops"]

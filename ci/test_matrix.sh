@@ -21,11 +21,14 @@ if [ "${1:-full}" = "quick" ]; then
     python -m pytest tests/test_elastic.py \
         "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks" \
         -x -q
+    echo "== quick tier: observability plane =="
+    python -m pytest tests/test_obs.py -x -q
     echo "== quick tier: unit + multiprocess suite minus -m full =="
-    # test_elastic.py and the injection case already ran above — don't
-    # pay for the multiprocess chaos cases twice per commit.
+    # test_elastic.py / test_obs.py and the injection case already ran
+    # above — don't pay for the multiprocess chaos cases twice per commit.
     python -m pytest tests/ -x -q -m "not full" \
         --ignore=tests/test_elastic.py \
+        --ignore=tests/test_obs.py \
         --deselect "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks"
     exit 0
 fi
@@ -78,6 +81,46 @@ for argset in "--smoke --cpu" "--smoke --cpu --circles 2"; do
         python examples/pipeline_train.py $argset
 done
 
+# Observability gate: the obs unit suite plus a 2-process launcher
+# smoke — per-rank metrics dumps and the merged all-rank timeline must
+# both exist and parse as JSON (ISSUE 2: nothing quantitative survived
+# a job before this plane existed).
+echo "== obs gate: unit suite =="
+python -m pytest tests/test_obs.py -x -q
+echo "== obs gate: 2-process metrics dump + merged timeline smoke =="
+OBS_TMP=$(mktemp -d)
+cat > "$OBS_TMP/worker.py" <<'EOF'
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+for i in range(4):
+    hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name=f"t{i}")
+hvd.shutdown()
+EOF
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+HVDTPU_METRICS_DUMP="$OBS_TMP" \
+HVDTPU_TIMELINE="$OBS_TMP/trace.json" \
+HVDTPU_TIMELINE_MARK_CYCLES=1 \
+    python -m horovod_tpu.run -np 2 --stats-summary \
+    python "$OBS_TMP/worker.py"
+python - "$OBS_TMP" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+dumps = glob.glob(f"{d}/metrics.*rank*.json")
+assert len(dumps) == 2, f"expected 2 per-rank metrics dumps, got {dumps}"
+for p in dumps:
+    doc = json.load(open(p))
+    assert doc["metrics"], f"empty metrics dump {p}"
+merged = json.load(open(f"{d}/trace.json"))
+assert merged, "merged timeline is empty"
+pids = {e.get("pid") for e in merged if e.get("ph") != "M"}
+assert pids == {0, 1}, f"expected a lane per rank, got pids={pids}"
+print(f"obs gate OK: {len(dumps)} dumps, {len(merged)} timeline events")
+EOF
+rm -rf "$OBS_TMP"
+
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must
 # recover via rollback + respawn (the example asserts it did).
@@ -88,4 +131,8 @@ echo "== elastic chaos smoke: shrink when the respawn budget is spent =="
 JAX_PLATFORMS=cpu python examples/elastic_train.py \
     --np 3 --fault worker_exit:step=4:rank=1 \
     --max-retries 0 --min-workers 2
+echo "== elastic chaos smoke: deadlocked training thread caught by beat =="
+JAX_PLATFORMS=cpu python examples/elastic_train.py \
+    --np 3 --fault worker_exit:step=4:rank=1:action=hang \
+    --progress-timeout 2
 echo "matrix OK"

@@ -107,6 +107,10 @@ class Engine {
               s.reason.c_str());
       return -1;
     }
+    // Every rank records its own timeline (the python side hands each
+    // rank a distinct per-rank path; the launcher merges at job end).
+    // Negotiation events stay rank-0-only — the controller lives there.
+    timeline_.Initialize(timeline_path, rank_, timeline_cycles);
     if (rank_ == 0) {
       ControllerConfig cfg;
       cfg.world_size = size;
@@ -115,7 +119,6 @@ class Engine {
       cfg.stall_shutdown_secs = stall_shutdown;
       controller_ = std::make_unique<Controller>(cfg);
       controller_->SetCache(cache_.get());
-      timeline_.Initialize(timeline_path, rank_, timeline_cycles);
       controller_->SetTimeline(timeline_.enabled() ? &timeline_ : nullptr);
     }
     running_ = true;

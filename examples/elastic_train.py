@@ -58,14 +58,24 @@ def main() -> int:
                         "worker_exit:step=4:rank=1")
     p.add_argument("--max-retries", type=int, default=3)
     p.add_argument("--min-workers", type=int, default=None)
+    p.add_argument("--progress-timeout", type=float, default=300.0,
+                   help="steady-state progress-beat budget (secs); a "
+                        "rank whose training thread hangs (e.g. --fault "
+                        "...:action=hang) is killed and respawned after "
+                        "this long without a completed collective")
     args = p.parse_args()
 
     env = {"JAX_PLATFORMS": "cpu"}
     if args.fault:
         env["HVDTPU_FAULT_SPEC"] = args.fault
+        # A hang is only discovered by the progress beat; peer timeouts
+        # must not be the rescue path in the demo either.
+        if "action=hang" in args.fault:
+            env["HVDTPU_ELASTIC_TIMEOUT"] = "600"
     results, job = elastic.launch(
         train, args=(args.steps,), np=args.num_proc, env=env,
         max_retries=args.max_retries, min_workers=args.min_workers,
+        progress_timeout=args.progress_timeout,
         timeout=300,
     )
     print(f"final world: {job.world} (epoch {job.epoch})")

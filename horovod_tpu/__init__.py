@@ -131,4 +131,10 @@ def __getattr__(name):
         # `from . import callbacks` would re-enter this __getattr__ while
         # the submodule is mid-import (fromlist probing) and recurse.
         return importlib.import_module("horovod_tpu.callbacks")
+    if name == "obs":
+        import importlib  # noqa: PLC0415
+
+        # Observability plane (metrics registry, progress beat, timeline
+        # merge) — see docs/observability.md.
+        return importlib.import_module("horovod_tpu.obs")
     raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

@@ -199,6 +199,15 @@ def init(comm=None) -> Topology:
         )
         del local_devices
 
+    # Arm the observability plane: first registry use installs the
+    # HVDTPU_METRICS_DUMP exit hook, so every initialized rank leaves a
+    # metrics dump even on the jit-only path that never starts an engine.
+    from .obs import get_registry  # noqa: PLC0415
+
+    get_registry().gauge("process.rank").set(
+        _topology.process_rank
+    )
+
     # Start the native eager engine NOW in multi-process worlds (reference
     # behavior: InitializeHorovodOnce spawns the background thread at init,
     # operations.cc:604-650).  Every rank's engine must cycle for

@@ -22,12 +22,14 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
 from .basics import rank, size
+from .obs import get_registry
 
 __all__ = [
     "Store",
@@ -214,6 +216,7 @@ class AsyncSave:
             if self._error is not None:
                 raise self._error
             return self.path
+        t_wait = time.monotonic()
         try:
             if self._ckptr is not None:  # rank 0
                 try:
@@ -260,8 +263,14 @@ class AsyncSave:
                     f"({summary}); no rank may treat this step as "
                     f"committed"
                 )
+        metrics = get_registry()
+        metrics.histogram("checkpoint.commit_wait_ms").observe(
+            (time.monotonic() - t_wait) * 1e3
+        )
         if self._error is not None:
+            metrics.counter("checkpoint.save_errors").inc()
             raise self._error
+        metrics.counter("checkpoint.saves_committed").inc()
         return self.path
 
 
@@ -285,6 +294,7 @@ def save_checkpoint_async(
     if keep is not None and keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
     path = _step_dir(directory, step)
+    get_registry().counter("checkpoint.saves_started").inc()
     if rank() != 0:
         return AsyncSave(path)
     try:
@@ -345,6 +355,7 @@ def restore_checkpoint(
     horovod/torch/__init__.py:452-530), which also guarantees bit-identical
     resume across ranks on non-shared filesystems.
     """
+    t_restore = time.monotonic()
     needs_files = rank() == 0 or not broadcast or size() <= 1
     if step is None:
         # Resolve "latest" only where the files are required to exist; on a
@@ -374,4 +385,9 @@ def restore_checkpoint(
         from .optim import broadcast_object  # noqa: PLC0415
 
         state = broadcast_object(state, root_rank=0)
+    metrics = get_registry()
+    metrics.counter("checkpoint.restores").inc()
+    metrics.histogram("checkpoint.restore_ms").observe(
+        (time.monotonic() - t_restore) * 1e3
+    )
     return state

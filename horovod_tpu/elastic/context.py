@@ -44,6 +44,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import get_registry
+from ..obs import progress as obs_progress
 from ..run.rendezvous import KVStoreClient
 from ..testing.faults import maybe_fail
 from ..utils.env import env_float
@@ -109,21 +111,26 @@ class ElasticContext:
         can spot a *frozen process* — SIGSTOP, OOM-thrash, a wedged
         host (a crashed one is caught by its exit code first).
 
-        This deliberately does NOT detect a deadlocked training thread:
-        the beat thread keeps running through one, and beating from the
-        training path instead would false-positive on any legitimate
-        compute phase longer than the timeout.  A hung training thread
-        is surfaced by its PEERS — their collective waits time out
-        (``HVDTPU_ELASTIC_TIMEOUT``) and recovery re-forms the world."""
+        The beat body piggybacks the collective-path progress counter
+        and phase (obs/progress.py): the wall-clock field keeps proving
+        the *process* lives (the beat thread survives a training-thread
+        deadlock, so its mere arrival proves nothing more), while the
+        launcher's workload-aware progress policy watches the counter to
+        catch the deadlocked *training thread* directly — instead of
+        leaving the hang to peers' collective timeouts and their retry
+        budget."""
         if self._hb_thread is not None:
             return
 
         def _beat():
             while True:
                 try:
+                    # Epoch-stamped: the launcher must not attribute a
+                    # dead incarnation's last beat to the respawned
+                    # successor (hb_<rank> is not epoch-scoped).
                     self.kv.put(
                         _SCOPE, f"hb_{self.rank}",
-                        repr(time.time()).encode(),
+                        obs_progress.beat_payload(epoch=self.epoch),
                     )
                 except Exception:
                     pass  # launcher going down; the exit path handles it
@@ -164,6 +171,13 @@ class ElasticContext:
         wait for every member.  Restarts transparently if the epoch
         advances mid-wait; raises :class:`HorovodShutdownError` when the
         deadline passes with members still missing."""
+        # The whole join is a launcher/peer wait: the progress beat
+        # reports `waiting`, so the staleness policy never shoots a rank
+        # that is merely parked for a respawned peer to come up.
+        with obs_progress.waiting():
+            return self._rendezvous(timeout)
+
+    def _rendezvous(self, timeout: Optional[float] = None) -> int:
         deadline = time.monotonic() + (timeout or self.timeout)
         while True:
             e = self.current_epoch()
@@ -208,6 +222,7 @@ class ElasticContext:
             # _seq) and a respawned rank (fresh process, _seq 0) must
             # agree on auto-minted names like "op3" after recovery.
             self._seq = 0
+            get_registry().counter("elastic.rendezvous").inc()
             LOG.info("rank %d joined epoch %d world %s",
                      self.rank, e, world)
             return e
@@ -232,15 +247,23 @@ class ElasticContext:
         self.kv.put(scope, f"ar_{name}_{self.rank}", pickle.dumps(arr))
         deadline = time.monotonic() + self.timeout
         parts = []
-        for r in self.world:
-            raw = self._fetch(scope, f"ar_{name}_{r}", deadline,
-                              what=f"allreduce {name!r} from rank {r}")
-            parts.append(pickle.loads(raw))
+        # Contribution is in: from here this rank is blocked on PEERS,
+        # and the beat's waiting flag says so — a hung peer freezes this
+        # counter too, and the policy must kill the peer, not us.
+        with obs_progress.waiting():
+            for r in self.world:
+                raw = self._fetch(scope, f"ar_{name}_{r}", deadline,
+                                  what=f"allreduce {name!r} from rank {r}")
+                parts.append(pickle.loads(raw))
         total = parts[0].astype(np.float64) if average else parts[0]
         for p in parts[1:]:
             total = total + p
         if average:
             total = (total / len(parts)).astype(arr.dtype)
+        # Progress beat source for the elastic path: the collective
+        # completed with every member's contribution in hand.
+        obs_progress.tick()
+        get_registry().counter("elastic.kv_collectives").inc()
         return total
 
     def sync_state(self, blob: bytes, commit_count: int) -> bytes:
@@ -254,15 +277,22 @@ class ElasticContext:
                     pickle.dumps(int(commit_count)))
         deadline = time.monotonic() + self.timeout
         counts = {}
-        for r in self.world:
-            raw = self._fetch(scope, f"have_{r}", deadline,
-                              what=f"commit count from rank {r}")
-            counts[r] = pickle.loads(raw)
-        owner = max(self.world, key=lambda r: (counts[r], -r))
-        if owner == self.rank:
-            self.kv.put(scope, "state", blob)
-        return self._fetch(scope, "state", deadline,
-                           what=f"state from owner rank {owner}")
+        with obs_progress.waiting():  # checked in; blocked on peers
+            for r in self.world:
+                raw = self._fetch(scope, f"have_{r}", deadline,
+                                  what=f"commit count from rank {r}")
+                counts[r] = pickle.loads(raw)
+            owner = max(self.world, key=lambda r: (counts[r], -r))
+            if owner == self.rank:
+                self.kv.put(scope, "state", blob)
+            out = self._fetch(scope, "state", deadline,
+                              what=f"state from owner rank {owner}")
+        # Epoch-start sync is a completed collective (liveness), but NOT
+        # steady state: the user's first step — and its possibly very
+        # long jit compile — has not started yet, and snapping to steady
+        # here would hand the steady budget to that compile.
+        obs_progress.tick(to_steady=False)
+        return out
 
     # -- plumbing ---------------------------------------------------------
 
@@ -325,6 +355,7 @@ class LocalContext:
                   average: bool = True) -> np.ndarray:
         self._seq += 1
         maybe_fail("worker_exit", step=self._seq, rank=self.rank)
+        obs_progress.tick()
         return np.asarray(value)
 
     def sync_state(self, blob: bytes, commit_count: int) -> bytes:

@@ -40,6 +40,8 @@ def launch(
     min_workers: Optional[int] = None,
     max_retries: int = 3,
     heartbeat_timeout: float = 60.0,
+    progress_timeout: float = 300.0,
+    progress_grace: float = 0.0,
     blacklist_cooldown: float = 10.0,
     timeout: Optional[float] = None,
 ) -> Tuple[Dict[int, Any], ElasticJobResult]:
@@ -71,6 +73,8 @@ def launch(
             min_workers=min_workers,
             max_retries=max_retries,
             heartbeat_timeout=heartbeat_timeout,
+            progress_timeout=progress_timeout,
+            progress_grace=progress_grace,
             blacklist_cooldown=blacklist_cooldown,
             job_timeout=timeout,
             kv_server=server,

@@ -70,6 +70,9 @@ def run(fn):
             except HorovodShutdownError as exc:
                 reason = f"world failure: {exc}"
             recoveries += 1
+            from ..obs import get_registry  # noqa: PLC0415
+
+            get_registry().counter("elastic.recoveries").inc()
             if recoveries > max_retries:
                 raise HorovodShutdownError(
                     f"elastic retry budget exhausted after {max_retries} "

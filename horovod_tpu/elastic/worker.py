@@ -59,6 +59,14 @@ def main() -> int:
         return 1
     finally:
         ctx.stop_heartbeat()
+        # Explicit dump (atexit also fires on clean exits, but not after
+        # an os._exit-style death — dump what we can while we can).
+        from ..obs import dump_metrics
+
+        try:
+            dump_metrics()
+        except Exception:
+            pass
 
 
 if __name__ == "__main__":

@@ -1,0 +1,48 @@
+"""horovod_tpu.obs — the per-rank observability plane.
+
+One package for the three things a distributed job must be able to tell
+you after the fact (PAPER.md §5's debuggability pillars, made
+quantitative):
+
+* **metrics** (obs/registry.py) — Counter/Gauge/Histogram instruments
+  updated from the engine cycle loop, the stall inspector, checkpoint
+  save/restore and every elastic event; dumped per rank as JSON via
+  ``HVDTPU_METRICS_DUMP`` and aggregated by the launcher's
+  ``--stats-summary`` table (obs/summary.py).
+* **progress beat** (obs/progress.py) — a monotonic collectives-
+  completed counter piggybacked on the elastic KV heartbeat, plus the
+  launcher-side workload-aware staleness policy that kills a rank whose
+  beat thread lives but whose training thread is deadlocked.
+* **all-rank timeline merge** (obs/timeline_merge.py) — repairs and
+  merges the per-rank Chrome traces (runtime/timeline.py) into one
+  valid trace with a lane per rank.
+
+See docs/observability.md.
+"""
+
+from . import progress  # noqa: F401
+from .registry import (  # noqa: F401
+    METRICS_DUMP_ENV,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    dump_metrics,
+    get_registry,
+    reset_registry,
+)
+
+set_phase = progress.set_phase
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "METRICS_DUMP_ENV",
+    "get_registry",
+    "reset_registry",
+    "dump_metrics",
+    "progress",
+    "set_phase",
+]

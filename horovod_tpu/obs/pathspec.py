@@ -1,0 +1,78 @@
+"""Shared per-rank artifact path resolution.
+
+Both observability artifacts — metrics dumps (``HVDTPU_METRICS_DUMP``)
+and timelines (``HVDTPU_TIMELINE``) — accept the same value forms and
+must agree between the writers (one file per rank) and the launcher-side
+aggregators (glob them all back).  One implementation, parameterized by
+the filename stem, so the rules can never desynchronize:
+
+* ``{rank}`` template — substituted verbatim;
+* a directory (existing, or trailing separator) — ``<stem>.<tag>.json``
+  inside it;
+* plain path — the tag is inserted before the extension.
+
+The tag is ``rank.<k>``, epoch-qualified to ``e<E>.rank.<k>`` under the
+elastic launcher (``HVDTPU_ELASTIC_EPOCH``): a respawned incarnation
+must never overwrite the file its dead predecessor left — that file is
+the evidence of why it died.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+__all__ = ["resolve", "glob_pattern", "rank_of_path", "epoch_of_path"]
+
+_RANK_RE = re.compile(r"(?:^|[^0-9a-zA-Z])rank[._]?(\d+)")
+_EPOCH_RE = re.compile(r"\.e(\d+)\.")
+
+
+def resolve(raw: str, stem: str, rank, epoch: Optional[str] = None) -> str:
+    """This rank's file for the env value ``raw``.  ``epoch=None`` reads
+    ``HVDTPU_ELASTIC_EPOCH`` from the environment."""
+    rank = str(rank)
+    if epoch is None:
+        epoch = os.environ.get("HVDTPU_ELASTIC_EPOCH")
+    tag = (f"e{epoch}.rank.{rank}" if epoch not in (None, "")
+           else f"rank.{rank}")
+    if "{rank}" in raw:
+        # Template form keeps the user's exact shape; the epoch tag is
+        # still inserted (before the extension) — the
+        # never-overwrite-the-predecessor invariant holds for every form.
+        path = raw.replace("{rank}", rank)
+        if epoch not in (None, ""):
+            base, ext = os.path.splitext(path)
+            path = f"{base}.e{epoch}{ext}"
+        return path
+    if raw.endswith(os.sep) or os.path.isdir(raw):
+        return os.path.join(raw, f"{stem}.{tag}.json")
+    base, ext = os.path.splitext(raw)
+    return f"{base}.{tag}{ext or '.json'}"
+
+
+def glob_pattern(raw: str, stem: str) -> str:
+    """The glob matching every per-rank file :func:`resolve` can derive
+    from ``raw`` (all ranks, all epochs) — what the launcher aggregates.
+    Never matches the merged/summary output path itself."""
+    if "{rank}" in raw:
+        return raw.replace("{rank}", "*")
+    if raw.endswith(os.sep) or os.path.isdir(raw):
+        return os.path.join(raw, f"{stem}.*rank*.json")
+    base, ext = os.path.splitext(raw)
+    return f"{base}.*rank*{ext or '.json'}"
+
+
+def rank_of_path(path: str) -> Optional[int]:
+    """Best-effort rank extraction from a per-rank filename
+    (``trace.rank.3.json``, ``trace.e1.rank.3.json``, ``rank_3`` ...)."""
+    m = None
+    for m in _RANK_RE.finditer(os.path.basename(path)):
+        pass  # keep the last match: epoch tags come before the rank tag
+    return int(m.group(1)) if m else None
+
+
+def epoch_of_path(path: str) -> Optional[int]:
+    m = _EPOCH_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None

@@ -1,0 +1,107 @@
+"""End-of-job aggregation of per-rank metrics dumps.
+
+The launcher's ``--stats-summary`` flag reads every
+``HVDTPU_METRICS_DUMP`` file the job's ranks wrote (obs/registry.py dump
+schema) and renders one table — metrics as rows, ranks as columns — so
+cross-rank skew (one rank's cycle p99, one rank's cache hit rate) is
+visible without grepping per-rank logs.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+from . import pathspec
+
+__all__ = ["collect_dumps", "format_summary_table", "summarize"]
+
+
+def _dump_glob(raw: str) -> str:
+    return pathspec.glob_pattern(raw, "metrics")
+
+
+def collect_dumps(raw: str) -> Dict[str, dict]:
+    """Read every per-rank dump derived from the ``HVDTPU_METRICS_DUMP``
+    value; returns {column label -> dump document}.  Elastic epoch tags
+    become part of the label so incarnations stay distinguishable."""
+    out: Dict[str, dict] = {}
+    for path in sorted(glob.glob(_dump_glob(raw))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # a half-written dump must not sink the summary
+        if not isinstance(doc, dict) or "metrics" not in doc:
+            continue
+        label = str(doc.get("rank", "?"))
+        epoch = pathspec.epoch_of_path(path)
+        if epoch:
+            label = f"{label}@e{epoch}"
+        out[label] = doc
+    return out
+
+
+def _cell(metric: dict) -> str:
+    if metric["type"] in ("counter", "gauge"):
+        v = metric["value"]
+        if isinstance(v, float) and not v.is_integer():
+            return f"{v:.3g}"
+        return str(int(v))
+    # histogram: the three numbers that matter at a glance
+    if not metric["count"]:
+        return "-"
+    return (f"n={metric['count']} p50={metric['p50']:.3g} "
+            f"p99={metric['p99']:.3g}")
+
+
+def _metric_label(metric: dict) -> str:
+    tags = metric.get("tags") or {}
+    if not tags:
+        return metric["name"]
+    tag_s = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+    return f"{metric['name']}{{{tag_s}}}"
+
+
+def format_summary_table(dumps: Dict[str, dict]) -> str:
+    """Metrics as rows, ranks as columns, plain monospace table."""
+    if not dumps:
+        return "(no metrics dumps found)"
+
+    def col_key(label: str):
+        head = label.split("@")[0]
+        return (0, int(head)) if head.isdigit() else (1, label)
+
+    columns = sorted(dumps, key=col_key)
+    rows: Dict[str, Dict[str, str]] = {}
+    for label in columns:
+        for metric in dumps[label].get("metrics", []):
+            rows.setdefault(_metric_label(metric), {})[label] = _cell(metric)
+
+    name_w = max([len(r) for r in rows] + [len("metric")])
+    col_w = {
+        c: max([len(rows[r].get(c, "-")) for r in rows]
+               + [len(f"rank {c}")])
+        for c in columns
+    }
+    header = "metric".ljust(name_w) + "".join(
+        f"  {f'rank {c}':>{col_w[c]}}" for c in columns
+    )
+    sep = "-" * len(header)
+    lines = [header, sep]
+    for r in sorted(rows):
+        lines.append(
+            r.ljust(name_w)
+            + "".join(f"  {rows[r].get(c, '-'):>{col_w[c]}}" for c in columns)
+        )
+    return "\n".join(lines)
+
+
+def summarize(raw: str) -> Optional[str]:
+    """Collect + format in one call; None when nothing was dumped."""
+    dumps = collect_dumps(raw)
+    if not dumps:
+        return None
+    return format_summary_table(dumps)

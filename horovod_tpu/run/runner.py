@@ -18,6 +18,7 @@ import socket
 import sys
 from typing import Dict, List, Optional
 
+from ..utils import env as envmod
 from ..utils.logging import get_logger
 from . import config_parser
 from .allocate import (
@@ -119,6 +120,23 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="Base host-blacklist cooldown; doubles per repeat failure "
              "(default 10).",
     )
+    elastic.add_argument(
+        "--progress-timeout-secs", type=float,
+        action=_StoreOverrideAction,
+        dest="progress_timeout_secs", default=None,
+        help="Steady-state progress-beat budget: a rank whose process "
+             "heartbeat lives but whose collectives-completed counter "
+             "has not advanced for this long is declared deadlocked and "
+             "respawned (default 300; 0 disables).",
+    )
+    elastic.add_argument(
+        "--progress-grace-secs", type=float,
+        action=_StoreOverrideAction,
+        dest="progress_grace_secs", default=None,
+        help="The same budget while the worker reports an init/compile "
+             "phase (default 0 = never kill during those phases; long "
+             "XLA compiles are legitimate).",
+    )
     parser.add_argument(
         "--output-filename", action=_StoreOverrideAction,
         dest="output_filename", default=None,
@@ -149,10 +167,29 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     timeline.add_argument(
         "--timeline-filename", action=_StoreOverrideAction,
         dest="timeline_filename", default=None,
+        help="All-rank Chrome trace: each rank writes its own file "
+             "derived from this value (template with {rank}, directory, "
+             "or plain path getting a rank tag); the launcher merges "
+             "them here at job end, one lane per rank.",
     )
     timeline.add_argument(
         "--timeline-mark-cycles", action=_StoreTrueOverrideAction,
         dest="timeline_mark_cycles", default=None,
+    )
+
+    obs_group = parser.add_argument_group("observability")
+    obs_group.add_argument(
+        "--metrics-dump", action=_StoreOverrideAction,
+        dest="metrics_dump", default=None,
+        help="Per-rank metrics dump target (HVDTPU_METRICS_DUMP): a "
+             "directory, a {rank} template, or a plain path that gets a "
+             "rank tag inserted.",
+    )
+    obs_group.add_argument(
+        "--stats-summary", action="store_true", dest="stats_summary",
+        help="After the job ends, aggregate every rank's metrics dump "
+             "into one per-rank summary table on stdout (implies a "
+             "temporary --metrics-dump when none is given).",
     )
 
     stall = parser.add_argument_group("stall check")
@@ -426,6 +463,7 @@ def launch_job(
 
     procs = ProcessSet()
     procs.install_signal_handlers()
+    _clean_stale_obs_files(base_env)
     for slot in slots:
         slot_env = build_slot_env(slot, coordinator, base_env)
         _spawn_worker(
@@ -433,7 +471,63 @@ def launch_job(
             ssh_port=ssh_port, tag_output=tag_output,
             output_dir=output_filename, num_proc=np,
         )
-    return procs.wait(timeout=job_timeout)
+    try:
+        return procs.wait(timeout=job_timeout)
+    finally:
+        # Failed jobs merge too — a partial trace of a dead job is the
+        # most valuable trace there is.
+        _merge_rank_timelines(base_env)
+
+
+def _clean_stale_obs_files(env: Dict[str, str]) -> None:
+    """Remove LEFTOVER per-rank timeline/metrics files from a previous
+    job pointed at the same paths — the end-of-job merge and summary
+    glob everything matching, and a 2-rank run must not inherit phantom
+    lanes/columns from an earlier 4-rank run.  The merged/summary
+    outputs themselves never match the per-rank glob."""
+    import glob as _glob  # noqa: PLC0415
+
+    from ..obs import pathspec  # noqa: PLC0415
+
+    for var, stem in ((envmod.TIMELINE, "trace"),
+                      (envmod.METRICS_DUMP, "metrics")):
+        raw = env.get(var)
+        if not raw:
+            continue
+        if "{rank}" in raw:
+            # A user template has no rank/epoch token to anchor on —
+            # its glob would match arbitrary sibling files, and deleting
+            # those is worse than a phantom lane.  Template users own
+            # their files.
+            continue
+        try:
+            for path in _glob.glob(pathspec.glob_pattern(raw, stem)):
+                # Belt and braces: only files that carry our rank tag —
+                # never anything a user might have put next to them.
+                if pathspec.rank_of_path(path) is not None:
+                    os.remove(path)
+        except OSError:
+            pass
+
+
+def _merge_rank_timelines(env: Dict[str, str]) -> Optional[str]:
+    """Merge the job's per-rank Chrome traces (every rank records now;
+    HVDTPU_TIMELINE names the template/dir) into one valid trace with a
+    lane per rank.  Best-effort: remote ranks' files are not fetched,
+    and a merge failure must never turn a finished job into an error."""
+    raw = env.get(envmod.TIMELINE)
+    if not raw:
+        return None
+    try:
+        from ..obs import timeline_merge  # noqa: PLC0415
+
+        merged = timeline_merge.merge_glob(raw)
+        if merged:
+            LOG.info("merged all-rank timeline -> %s", merged)
+        return merged
+    except Exception as exc:  # pragma: no cover - defensive
+        LOG.warning("timeline merge failed: %s", exc)
+        return None
 
 
 def _spawn_worker(
@@ -491,6 +585,8 @@ def launch_elastic_job(
     min_workers: Optional[int] = None,
     max_retries: int = 3,
     heartbeat_timeout: float = 60.0,
+    progress_timeout: float = 300.0,
+    progress_grace: float = 0.0,
     blacklist_cooldown: float = 10.0,
     job_timeout: Optional[float] = None,
     kv_server=None,
@@ -498,9 +594,9 @@ def launch_elastic_job(
     output_filename: Optional[str] = None,
 ) -> ElasticJobResult:
     """Elastic counterpart of :func:`launch_job`: per-rank failure
-    detection (exit code + KV heartbeat), host blacklisting with
-    exponential-backoff re-admission, and bounded respawn of failed
-    ranks into a re-minted rendezvous epoch.
+    detection (exit code + KV heartbeat + collective-path progress
+    beat), host blacklisting with exponential-backoff re-admission, and
+    bounded respawn of failed ranks into a re-minted rendezvous epoch.
 
     Worker contract: each rank runs ``command`` with the
     ``HVDTPU_ELASTIC_*`` env (see elastic/context.py) and coordinates
@@ -511,6 +607,15 @@ def launch_elastic_job(
     continue with a SHRUNKEN world as long as at least this many ranks
     survive (default np — any unrecoverable failure aborts).
     ``max_retries`` bounds total respawns across the job.
+    ``progress_timeout`` / ``progress_grace``: the workload-aware
+    progress-beat policy (obs/progress.py ProgressPolicy).  Worker beats
+    piggyback the collectives-completed counter and phase; a rank whose
+    beat thread lives but whose counter is frozen in steady-state for
+    ``progress_timeout`` seconds has a deadlocked training thread and is
+    killed/respawned directly — before its peers burn their
+    collective-timeout retry budget discovering it.  ``progress_grace``
+    is the same window for init/compile phases (0 = never kill there: a
+    long XLA compile is legitimate).
     ``kv_server``: a caller-started rendezvous server already seeded
     with job payloads (the python API path); created/stopped internally
     when None.
@@ -561,9 +666,14 @@ def launch_elastic_job(
     if output_filename:
         os.makedirs(output_filename, exist_ok=True)
 
+    from ..obs import get_registry  # noqa: PLC0415
+    from ..obs.progress import ProgressPolicy  # noqa: PLC0415
+
+    metrics = get_registry()
     result = ElasticJobResult()
     trace = result.trace
     blacklist = HostBlacklist(cooldown_base=blacklist_cooldown)
+    progress_policy = ProgressPolicy(progress_timeout, progress_grace)
     procs = ProcessSet()
     procs.install_signal_handlers()
 
@@ -572,8 +682,14 @@ def launch_elastic_job(
         # must find its membership already published.
         kv.put("elastic", f"world_{epoch}", pickle.dumps(sorted(world)))
         kv.put("elastic", "epoch", str(epoch).encode())
+        metrics.counter("launcher.epochs_minted").inc()
+
+    # rank -> epoch its CURRENT incarnation was spawned into; beats
+    # stamped with an older epoch are a dead predecessor's leftovers.
+    spawn_epoch: Dict[int, int] = {}
 
     def spawn(rank: int, host: str, epoch: int) -> None:
+        spawn_epoch[rank] = epoch
         worker_env = dict(base_env)
         worker_env.update({
             "HVDTPU_ELASTIC_RANK": str(rank),
@@ -612,6 +728,7 @@ def launch_elastic_job(
     deadline = time.monotonic() + job_timeout if job_timeout else None
 
     try:
+        _clean_stale_obs_files(base_env)
         mint_epoch(epoch, world)
         for rank in world:
             spawn(rank, host_of[rank], epoch)
@@ -629,6 +746,8 @@ def launch_elastic_job(
                     )
                 host = host_of[rank]
                 count = blacklist.record_failure(host)
+                metrics.counter("launcher.rank_failures").inc()
+                metrics.counter("launcher.blacklists").inc()
                 trace.append(("failure", rank, rc, epoch))
                 trace.append(("blacklist", host, count))
                 LOG.warning(
@@ -668,7 +787,9 @@ def launch_elastic_job(
                     # The dead incarnation's last observed beat must not
                     # count against the successor's first-beat window.
                     hb_seen.pop(rank, None)
+                    progress_policy.forget(rank)
                     spawn(rank, new_host, epoch)
+                    metrics.counter("launcher.respawns").inc()
                     trace.append(("respawn", rank, epoch, new_host))
                 elif len(set(alive) | set(finished)) >= min_workers:
                     # Budget spent: continue with the shrunken world
@@ -691,13 +812,17 @@ def launch_elastic_job(
                         f"{len(set(alive) | set(finished))} workers "
                         f"contributing (< min_workers={min_workers})"
                     )
-            if (heartbeat_timeout and heartbeat_timeout > 0
+            hb_enabled = bool(heartbeat_timeout and heartbeat_timeout > 0)
+            if ((hb_enabled or progress_policy.enabled)
                     and time.monotonic() >= hb_next_scan):
                 # Beats only change once per worker heartbeat period, so
                 # scanning them on every 50 ms monitor tick is np wasted
-                # KV round-trips; exits stay on the fast tick.
+                # KV round-trips; exits stay on the fast tick.  The scan
+                # runs for EITHER rule: disabling the process-heartbeat
+                # rule must not silently disable deadlock detection.
                 hb_next_scan = time.monotonic() + min(
-                    1.0, heartbeat_timeout / 4
+                    1.0,
+                    heartbeat_timeout / 4 if hb_enabled else 1.0,
                 )
                 # Staleness is judged entirely on the launcher's clock —
                 # the window starts when the launcher OBSERVES a new beat
@@ -705,16 +830,27 @@ def launch_elastic_job(
                 # clock (cross-host skew > timeout would otherwise kill
                 # healthy remote workers in a loop).
                 now = time.monotonic()
+                from ..obs.progress import beat_epoch  # noqa: PLC0415
+
                 for rank in procs.alive_ranks():
                     raw = kv.get("elastic", f"hb_{rank}")
                     if raw is None:
                         continue  # not beating yet (still importing)
+                    be = beat_epoch(raw)
+                    if be is not None and be < spawn_epoch.get(rank, 0):
+                        # A dead incarnation's leftover beat: the
+                        # respawned successor has not beaten yet.
+                        # Judging it would kill a healthy successor
+                        # that is merely slow to import.
+                        continue
+                    # Rule 1 — process liveness: the beat body changing
+                    # at all proves the beat thread (and process) lives.
                     seen = hb_seen.get(rank)
                     if seen is None or seen[0] != raw:
                         hb_seen[rank] = (raw, now)
-                        continue
-                    if now - seen[1] > heartbeat_timeout:
+                    elif hb_enabled and now - seen[1] > heartbeat_timeout:
                         trace.append(("heartbeat_lost", rank, epoch))
+                        metrics.counter("launcher.heartbeat_lost").inc()
                         LOG.warning(
                             "elastic: rank %d heartbeat stale > %.0fs; "
                             "declaring it dead", rank, heartbeat_timeout,
@@ -722,6 +858,26 @@ def launch_elastic_job(
                         # Restart the window so the successor incarnation
                         # gets a full timeout before its first beat lands.
                         hb_seen.pop(rank, None)
+                        progress_policy.forget(rank)
+                        procs.terminate_rank(rank)
+                        continue
+                    # Rule 2 — training-thread liveness: the beat
+                    # piggybacks the collective-path progress counter;
+                    # a live beat with a frozen counter in steady state
+                    # is a deadlocked training thread.  Kill it NOW,
+                    # directly, instead of letting every peer discover
+                    # it through collective timeouts (retry-budget burn
+                    # — the ROADMAP open item this closes).
+                    reason = progress_policy.observe(rank, raw, now)
+                    if reason is not None:
+                        trace.append(("progress_lost", rank, epoch))
+                        metrics.counter("launcher.progress_lost").inc()
+                        LOG.warning(
+                            "elastic: rank %d training thread declared "
+                            "dead: %s", rank, reason,
+                        )
+                        hb_seen.pop(rank, None)
+                        progress_policy.forget(rank)
                         procs.terminate_rank(rank)
             if all(r in finished for r in world):
                 result.exit_codes = dict(finished)
@@ -743,6 +899,10 @@ def launch_elastic_job(
     finally:
         if owns_server:
             kv_server.stop()
+        # All-rank trace merge, dead incarnations included: the
+        # streaming writer format keeps a killed rank's file loadable,
+        # and its epoch-tagged lane is the story of why it died.
+        _merge_rank_timelines(base_env)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -783,6 +943,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     env: Dict[str, str] = {}
     config_parser.set_env_from_args(env, args)
+    summary_tmp = None
+    if getattr(args, "stats_summary", False) and not (
+        env.get(envmod.METRICS_DUMP) or os.environ.get(envmod.METRICS_DUMP)
+    ):
+        # --stats-summary without --metrics-dump: dump into a temp dir
+        # that lives exactly as long as the summary needs it.
+        import tempfile  # noqa: PLC0415
+
+        summary_tmp = tempfile.mkdtemp(prefix="hvdtpu_metrics_")
+        env[envmod.METRICS_DUMP] = summary_tmp
     try:
         LOG.info("launching %d processes: %s", args.np, " ".join(command))
         if getattr(args, "elastic", False):
@@ -805,6 +975,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     if getattr(args, "blacklist_cooldown_secs", None) is None
                     else args.blacklist_cooldown_secs
                 ),
+                progress_timeout=(
+                    300.0
+                    if getattr(args, "progress_timeout_secs", None) is None
+                    else args.progress_timeout_secs
+                ),
+                progress_grace=(
+                    0.0
+                    if getattr(args, "progress_grace_secs", None) is None
+                    else args.progress_grace_secs
+                ),
                 output_filename=args.output_filename,
             )
             return 0
@@ -823,3 +1003,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (RuntimeError, ValueError, TimeoutError, OSError) as exc:
         print(f"hvdrun: {exc}", file=sys.stderr)
         return 1
+    finally:
+        # Failed jobs summarize too — the metrics of a dead run are the
+        # ones someone is about to go digging for.
+        try:
+            _print_stats_summary(args, env)
+        finally:
+            if summary_tmp is not None:
+                import shutil  # noqa: PLC0415
+
+                shutil.rmtree(summary_tmp, ignore_errors=True)
+
+
+def _print_stats_summary(args, env: Dict[str, str]) -> None:
+    """End-of-job per-rank metrics table (--stats-summary)."""
+    if not getattr(args, "stats_summary", False):
+        return
+    raw = env.get(envmod.METRICS_DUMP) or os.environ.get(envmod.METRICS_DUMP)
+    if not raw:
+        return
+    from ..obs import summary as obs_summary  # noqa: PLC0415
+
+    table = obs_summary.summarize(raw)
+    if table is None:
+        print("hvdrun: --stats-summary: no metrics dumps found "
+              f"under {raw!r}", file=sys.stderr)
+        return
+    print("\n== per-rank metrics summary ==")
+    print(table)

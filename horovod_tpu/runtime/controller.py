@@ -325,6 +325,18 @@ def _check_stalls(
                 - set(entry.requests)
                 - state.joined_ranks
             )
+            # Aggregatable counterpart of the log line (the reference's
+            # stall inspector only logs): the per-tensor counter and the
+            # lagging-rank list survive the job via the metrics dump, so
+            # "which rank kept everyone waiting" is answerable after the
+            # fact instead of by grepping np log streams.
+            from ..obs import get_registry  # noqa: PLC0415
+
+            metrics = get_registry()
+            metrics.counter("controller.stall_warnings",
+                            tensor=name).inc()
+            metrics.gauge("controller.stall_lagging_ranks",
+                          tensor=name).set(len(missing))
             LOG.warning(
                 "One or more tensors were submitted to be reduced/gathered "
                 "but some ranks have not yet done so after %.0f s: tensor "

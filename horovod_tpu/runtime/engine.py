@@ -41,6 +41,8 @@ import numpy as np
 
 from ..basics import global_topology
 from ..exceptions import HorovodShutdownError
+from ..obs import get_registry
+from ..obs import progress as obs_progress
 from ..testing.faults import maybe_fail
 from ..utils import env as envmod
 from ..utils.logging import get_logger
@@ -191,6 +193,38 @@ class EagerEngine:
             "device_data_ops": 0,  # responses executed as XLA collectives
             "device_payload_bytes": 0,  # bytes that stayed device-resident
         }
+
+        # Observability plane (obs/registry.py): cycle-loop instruments
+        # resolved once here — updates on the handles are lock-free, so
+        # the per-cycle cost is a few float ops.  The stats dict above is
+        # published via a snapshot-time collector instead of mirrored
+        # increments on the hot path.
+        metrics = get_registry()
+        self._m_cycle_ms = metrics.histogram("engine.cycle_time_ms")
+        self._m_negotiate_ms = metrics.histogram("engine.negotiation_ms")
+        self._m_fusion_bytes = metrics.histogram("engine.fusion_bytes")
+        self._m_queue_depth = metrics.gauge("engine.tensor_queue_depth")
+        self._m_completed = metrics.counter("engine.collectives_completed")
+        self._m_cached_stalls = metrics.counter(
+            "engine.cached_stall_warnings"
+        )
+        # WeakMethod so the registry never pins a dead engine alive, and
+        # the closure signals CollectorRetired once the engine is gone
+        # so the registry prunes it (single deref — no GC race between
+        # the liveness check and the call).
+        import weakref  # noqa: PLC0415
+
+        _wm = weakref.WeakMethod(self._publish_stats)
+
+        def _collect(reg, _wm=_wm):
+            publish = _wm()
+            if publish is None:
+                from ..obs.registry import CollectorRetired  # noqa: PLC0415
+
+                raise CollectorRetired
+            publish(reg)
+
+        metrics.register_collector(_collect)
 
         # Device data plane (runtime/device_plane.py): fused payloads whose
         # tensors are jax.Arrays execute as compiled XLA collectives over a
@@ -347,6 +381,18 @@ class EagerEngine:
             self._thread.join(timeout=30)
         self.timeline.shutdown()
 
+    def _publish_stats(self, metrics) -> None:
+        """Snapshot-time collector: publish the stats dict (and derived
+        rates) as gauges.  Runs at dump/summary time, not per cycle."""
+        for key, value in self.stats.items():
+            metrics.gauge(f"engine.stats.{key}").set(value)
+        lookups = self.stats["cache_hits"] + self.stats["cache_misses"]
+        if lookups:
+            metrics.gauge("engine.cache_hit_rate").set(
+                self.stats["cache_hits"] / lookups
+            )
+        metrics.gauge("engine.fusion_threshold_bytes").set(self.fusion_bytes)
+
     # ------------------------------------------------------ background loop
 
     def _loop(self) -> None:
@@ -358,9 +404,10 @@ class EagerEngine:
                 LOG.error("background loop error: %s", exc)
                 self._fail_all(exc)
                 return
+            elapsed = time.monotonic() - start
+            self._m_cycle_ms.observe(elapsed * 1e3)
             if not again:
                 break
-            elapsed = time.monotonic() - start
             if elapsed < self.cycle_s:
                 time.sleep(self.cycle_s - elapsed)
         # Typed so elastic.run can classify engine teardown as recoverable
@@ -420,9 +467,12 @@ class EagerEngine:
                 requests=misses, tuned_params=params
             ).serialize()
 
+        t_neg = time.monotonic()
         shutdown_ranks, joined_ranks, bits, all_lists = self._exchange(
             payload, shutdown, joined
         )
+        self._m_negotiate_ms.observe((time.monotonic() - t_neg) * 1e3)
+        self._m_queue_depth.set(len(self._table))
         self.stats["cycles"] += 1
 
         state = self._controller
@@ -544,6 +594,7 @@ class EagerEngine:
         for slot, since in self._armed_since.items():
             age = now - since
             if age > self.stall_warn:
+                self._m_cached_stalls.inc()
                 LOG.warning(
                     "Cached tensor %s has been waiting on peer ranks for "
                     "%.0f s",
@@ -667,6 +718,14 @@ class EagerEngine:
                 if e is not None:
                     e.future.set_result(None)
             self.timeline.end(names, resp.response_type.name)
+            # Progress beat source: a performed response proves the
+            # collective path is moving (obs/progress.py); the count is
+            # per user-level collective, so fused responses tick once
+            # per member tensor.
+            done = len(resp.tensor_names)
+            self._m_completed.inc(done)
+            self._m_fusion_bytes.observe(_response_bytes(resp))
+            obs_progress.tick(done)
         except Exception as exc:
             for e in entries:
                 if e is not None and not e.future.done():
@@ -1111,6 +1170,11 @@ class EagerEngine:
             entry.future.set_result(None)
         else:
             entry.future.set_result(None)
+        # Count only actual completions (same placement discipline as
+        # _perform_operation: after success, never before).
+        if entry.future.done() and entry.future.exception() is None:
+            self._m_completed.inc()
+            obs_progress.tick()
 
     def _fail_all(self, exc: Exception) -> None:
         with self._lock:

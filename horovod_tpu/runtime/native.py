@@ -29,8 +29,11 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..basics import global_topology
+from ..obs import get_registry
+from ..obs import progress as obs_progress
 from ..utils import env as envmod
 from ..utils.logging import get_logger
+from . import timeline as timeline_mod
 from .messages import RequestType
 
 LOG = get_logger("native")
@@ -133,6 +136,13 @@ class NativeEngine:
         self.rank = topo.process_rank
         self.world = topo.process_count
         self.lib = _load()
+        # Observability plane: the engine-cycle internals live in C++,
+        # but completed collectives are resolved here — counting them
+        # here keeps the metrics dump and the progress beat engine-
+        # agnostic (and first registry use arms the exit dump).
+        self._m_completed = get_registry().counter(
+            "engine.collectives_completed"
+        )
 
         port = self.lib.hvdtpu_listen()
         if port < 0:
@@ -149,7 +159,14 @@ class NativeEngine:
         stall_shutdown = envmod.env_float(envmod.STALL_SHUTDOWN_TIME, 0.0)
         if envmod.env_bool(envmod.STALL_CHECK_DISABLE):
             stall_warn = 1e18
-        timeline_path = os.environ.get(envmod.TIMELINE, "") if self.rank == 0 else ""
+        # Every rank records its own per-rank file (the C++ writer stamps
+        # pid=rank); the launcher merges them at job end into one trace
+        # with a lane per rank (obs/timeline_merge.py).
+        raw_timeline = os.environ.get(envmod.TIMELINE, "")
+        timeline_path = (
+            timeline_mod.resolve_path(raw_timeline, self.rank)
+            if raw_timeline else ""
+        )
         mark_cycles = 1 if envmod.env_bool(envmod.TIMELINE_MARK_CYCLES) else 0
 
         rc = self.lib.hvdtpu_connect(
@@ -335,6 +352,10 @@ class NativeEngine:
                         fut.set_result(self.world - 1)
                     else:
                         fut.set_result(self._fetch_result(handle, dtype_name))
+                        # Progress-beat + metrics source, same semantics
+                        # as the python engine's _perform_operation.
+                        self._m_completed.inc()
+                        obs_progress.tick()
                 else:
                     msg = self.lib.hvdtpu_error(handle).decode()
                     exc: Exception

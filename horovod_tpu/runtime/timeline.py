@@ -5,12 +5,26 @@ trace-event JSON; a dedicated writer thread drains a lock-free queue so the
 hot loop never blocks on file IO; per-tensor state machine NEGOTIATING ->
 TOP_LEVEL -> ACTIVITY (timeline.h:77).
 
-Same design here: events go into a queue.SimpleQueue (single producer =
-engine thread, single consumer = writer thread), the writer streams JSON
-incrementally.  Device-level timing belongs to the XLA profiler
-(jax.profiler.trace) and is deliberately not duplicated — this timeline
-covers the host-side negotiation/queue phases the XLA profiler can't see
-(SURVEY.md §5.1).
+Same design here, with two deliberate departures:
+
+* **Every rank records** (the reference gates on rank 0).  Events are
+  stamped ``pid = rank``, each rank writes its own file — the
+  ``HVDTPU_TIMELINE`` value is a template (``{rank}``), a directory, or
+  a plain path that gets a rank tag inserted (:func:`resolve_path`) —
+  and the launcher merges them at job end into one trace with a lane
+  per rank (obs/timeline_merge.py).  Negotiation skew across ranks is
+  invisible in a rank-0-only trace; it is the whole point of this one.
+* **Crash-safe streaming format**: one comma-terminated event per line,
+  flushed per drained batch, no required ``]`` terminator (Chrome's
+  trace format explicitly allows the unclosed-array form for streaming).
+  A rank killed mid-job — the normal case under elastic respawn — leaves
+  a trace that still loads; clean shutdown appends a ``trace_complete``
+  metadata event plus the terminator so the file is also plain valid
+  JSON.
+
+Device-level timing belongs to the XLA profiler (jax.profiler.trace) and
+is deliberately not duplicated — this timeline covers the host-side
+negotiation/queue phases the XLA profiler can't see (SURVEY.md §5.1).
 
 Enable with HVDTPU_TIMELINE=/path/trace.json (reference: HOROVOD_TIMELINE,
 operations.cc:403-411); cycle markers via HVDTPU_TIMELINE_MARK_CYCLES.
@@ -32,18 +46,34 @@ EXECUTE = "EXECUTE"
 CYCLE = "CYCLE"
 
 
+def resolve_path(raw: str, rank: int) -> str:
+    """Map the ``HVDTPU_TIMELINE`` value to this rank's file — shared
+    template/dir/plain-path + epoch-tag rules in obs/pathspec.py, so
+    ``--timeline-filename t.json`` yields ``t.rank.<k>.json`` per rank
+    (``t.e<E>.rank.<k>.json`` under elastic) and the launcher's merge
+    — which globs with the same module — writes the original ``t.json``.
+    """
+    from ..obs import pathspec  # noqa: PLC0415
+
+    return pathspec.resolve(raw, "trace", rank)
+
+
 class Timeline:
     """Facade; no-ops unless enabled (so the engine can call it
     unconditionally, as the reference does via Initialized() checks)."""
 
     def __init__(self, path: Optional[str], rank: int, mark_cycles: bool = False):
-        self._enabled = bool(path) and rank == 0
+        self._enabled = bool(path)
+        self._rank = rank
         self._mark_cycles = mark_cycles
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._writer: Optional[threading.Thread] = None
         self._start = time.perf_counter()
         if self._enabled:
             self._path = path
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
             self._writer = threading.Thread(
                 target=self._write_loop, name="hvdtpu_timeline", daemon=True
             )
@@ -59,8 +89,8 @@ class Timeline:
     def _emit(self, ph: str, name: str, cat: str, tid: str = "ops", **extra):
         if self._enabled:
             self._queue.put(
-                {"ph": ph, "name": name, "cat": cat, "pid": 0, "tid": tid,
-                 "ts": self._ts(), **extra}
+                {"ph": ph, "name": name, "cat": cat, "pid": self._rank,
+                 "tid": tid, "ts": self._ts(), **extra}
             )
 
     # -- per-tensor state machine (reference timeline.h:77-126) ------------
@@ -93,9 +123,12 @@ class Timeline:
 
     # -- writer ------------------------------------------------------------
     def _write_loop(self):
+        """Streaming-tolerant writer: every event line ends with a comma
+        and the batch is flushed, so the on-disk trace is loadable at any
+        kill point (obs/timeline_merge.load_events repairs the tail; the
+        Chrome trace format accepts the unclosed array as-is)."""
         with open(self._path, "w") as f:
             f.write("[\n")
-            first = True
             while True:
                 try:
                     ev = self._queue.get(timeout=0.5)
@@ -104,11 +137,31 @@ class Timeline:
                     continue
                 if ev is None:
                     break
-                if not first:
-                    f.write(",\n")
                 f.write(json.dumps(ev))
-                first = False
-            f.write("\n]\n")
+                f.write(",\n")
+                # drain whatever else is queued before flushing once
+                while True:
+                    try:
+                        ev = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if ev is None:
+                        f.flush()
+                        self._terminate(f)
+                        return
+                    f.write(json.dumps(ev))
+                    f.write(",\n")
+                f.flush()
+            self._terminate(f)
+
+    def _terminate(self, f) -> None:
+        # Clean shutdown: the terminator event keeps the trailing comma
+        # legal, so a completed trace is ALSO plain valid JSON.
+        f.write(json.dumps(
+            {"ph": "M", "name": "trace_complete", "pid": self._rank,
+             "tid": "meta", "ts": self._ts()}
+        ))
+        f.write("\n]\n")
 
     def shutdown(self):
         if self._enabled:
@@ -118,8 +171,9 @@ class Timeline:
 
 
 def from_env(rank: int) -> Timeline:
+    raw = os.environ.get("HVDTPU_TIMELINE")
     return Timeline(
-        os.environ.get("HVDTPU_TIMELINE"),
+        resolve_path(raw, rank) if raw else None,
         rank,
         mark_cycles=os.environ.get("HVDTPU_TIMELINE_MARK_CYCLES", "0")
         in ("1", "true"),

@@ -33,8 +33,11 @@ Grammar::
   NOT re-trigger the fault that killed its predecessor.
 * ``count`` — times to fire (default 1).
 * ``action`` — ``raise`` (default) raises :class:`InjectedFault`;
-  ``exit`` calls ``os._exit(code)``.  ``worker_exit``/``task_fn`` points
-  default to ``exit``.
+  ``exit`` calls ``os._exit(code)``; ``hang`` blocks the calling thread
+  forever (daemon threads — heartbeats — keep running: the exact
+  signature of a deadlocked training thread, which is what the
+  progress-beat staleness policy exists to catch).
+  ``worker_exit``/``task_fn`` points default to ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
 * ``name`` — only fire when the call site passes a matching ``name=``
@@ -111,7 +114,7 @@ def parse_spec(raw: str) -> List[FaultSpec]:
             elif key == "epoch":
                 spec.epoch = None if value in ("any", "*") else int(value)
             elif key == "action":
-                if value not in ("raise", "exit"):
+                if value not in ("raise", "exit", "hang"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -159,11 +162,9 @@ def active() -> bool:
 def _resolve_rank(rank: Optional[int]) -> Optional[int]:
     if rank is not None:
         return rank
-    for env in ("HVDTPU_RANK", "HVDTPU_ELASTIC_RANK"):
-        value = os.environ.get(env)
-        if value not in (None, ""):
-            return int(value)
-    return None
+    from ..utils.env import resolve_rank  # noqa: PLC0415
+
+    return resolve_rank(None)
 
 
 def _resolve_epoch() -> int:
@@ -209,4 +210,14 @@ def maybe_fail(
             # os._exit, not sys.exit: the injected death must look like a
             # hard crash (no atexit, no finally blocks posting results).
             os._exit(spec.code)
+        if spec.action == "hang":
+            # Deadlock the CALLING thread only: daemon threads (the KV
+            # heartbeat) keep beating, so the process looks alive while
+            # its training thread is wedged — reproducing the failure
+            # mode the collective-path progress beat detects.  The
+            # process dies by external SIGTERM/SIGKILL.
+            import threading  # noqa: PLC0415
+
+            while True:
+                threading.Event().wait(3600)
         raise InjectedFault(point, spec.describe())

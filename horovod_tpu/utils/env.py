@@ -32,6 +32,21 @@ LOG_LEVEL = "HVDTPU_LOG_LEVEL"
 # Device-resident eager data plane (no reference analog by name: the
 # reference's equivalent switch is compile-time HOROVOD_GPU_ALLREDUCE).
 EAGER_DEVICE = "HVDTPU_EAGER_DEVICE"
+# Per-rank metrics dump target (obs/registry.py); a dir, a {rank}
+# template, or a plain path that gets a rank tag inserted.
+METRICS_DUMP = "HVDTPU_METRICS_DUMP"
+
+
+def resolve_rank(default=None):
+    """This process's rank per the launcher env contract: HVDTPU_RANK
+    (static jobs) first, then HVDTPU_ELASTIC_RANK (elastic workers).
+    The single definition both the fault injector and the metrics dump
+    use — the two must never disagree about which rank a process is."""
+    for name in ("HVDTPU_RANK", "HVDTPU_ELASTIC_RANK"):
+        value = os.environ.get(name)
+        if value not in (None, ""):
+            return int(value)
+    return default
 
 
 def env_int(name: str, default: int) -> int:

@@ -27,14 +27,18 @@ from horovod_tpu.testing import faults
 
 @pytest.fixture(autouse=True)
 def _clean_faults(monkeypatch):
-    """Every test starts with an empty fault registry and no leaked
-    ambient context."""
+    """Every test starts with an empty fault registry, no leaked
+    ambient context, and a zeroed progress beat."""
+    from horovod_tpu.obs import progress as obs_progress
+
     monkeypatch.delenv(faults.SPEC_ENV, raising=False)
     faults.reset()
     elastic.reset_context()
+    obs_progress.reset()
     yield
     faults.reset()
     elastic.reset_context()
+    obs_progress.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -728,3 +732,75 @@ def test_elastic_user_exception_aborts_not_respawns():
     with pytest.raises(RuntimeError, match="deliberate user bug"):
         elastic.launch(_raising_fn, np=2,
                        env={"JAX_PLATFORMS": "cpu"}, timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# Progress beat: deadlocked training threads vs. long compile phases
+# (ISSUE 2 acceptance; closes the ROADMAP heartbeat-scope open item)
+# ---------------------------------------------------------------------------
+
+
+def _compile_then_train():
+    import time  # noqa: PLC0415
+
+    import horovod_tpu.elastic as elastic  # noqa: PLC0415
+    import horovod_tpu.obs as obs  # noqa: PLC0415
+
+    ctx = elastic.context()
+    if ctx.rank == 1:
+        # A legitimately long non-collective phase, well past the steady
+        # budget the deadlock test kills with — the declared phase is
+        # what must keep this rank alive.
+        obs.set_phase("compile")
+        time.sleep(6.0)
+    return _chaos_train(total_steps=4)
+
+
+@pytest.mark.multiprocess
+def test_elastic_deadlock_detected_by_progress_beat():
+    """ISSUE 2 acceptance, part 1: a fault-injected training-thread
+    deadlock (action=hang — the KV heartbeat thread keeps beating, so
+    the process-liveness rule can never fire) is detected via
+    progress-beat staleness; the rank is killed and respawned and the
+    job converges to the no-fault result.  The peers' collective timeout
+    is set far above the job runtime, so recovery happening at all
+    proves the launcher acted on the beat — no peer burned its retry
+    budget discovering the hang."""
+    clean, _ = elastic.launch(
+        _chaos_train, np=4, env={"JAX_PLATFORMS": "cpu"}, timeout=120)
+    env = {
+        "HVDTPU_FAULT_SPEC": "worker_exit:step=5:rank=2:action=hang",
+        "JAX_PLATFORMS": "cpu",
+        # Peer collective waits massively outlive the test: timeouts
+        # CANNOT be what rescues the job.
+        "HVDTPU_ELASTIC_TIMEOUT": "600",
+    }
+    faulted, job = elastic.launch(
+        _chaos_train, np=4, env=env, max_retries=3,
+        progress_timeout=2.0, timeout=120)
+
+    assert faulted == clean
+    assert sorted(faulted) == [0, 1, 2, 3]
+    events = [e[0] for e in job.trace]
+    assert ("progress_lost", 2, 0) in job.trace
+    assert events.count("respawn") == 1
+    assert job.world == [0, 1, 2, 3]
+    # the beat thread never went stale — only the training thread did
+    assert "heartbeat_lost" not in events
+
+
+@pytest.mark.multiprocess
+def test_elastic_long_compile_phase_not_killed():
+    """ISSUE 2 acceptance, part 2 (the workload-aware half): a rank
+    sitting in a declared compile phase for 3x the steady budget is NOT
+    killed while under the grace window — long XLA compiles are
+    legitimate, and shooting them is how flapping starts."""
+    results, job = elastic.launch(
+        _compile_then_train, np=3, env={"JAX_PLATFORMS": "cpu"},
+        progress_timeout=2.0, progress_grace=60.0, timeout=120)
+    assert sorted(results) == [0, 1, 2]
+    events = [e[0] for e in job.trace]
+    assert "progress_lost" not in events
+    assert "respawn" not in events
+    assert "heartbeat_lost" not in events
+    assert all(results[r][1] == 4 for r in results)

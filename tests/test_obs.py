@@ -1,0 +1,581 @@
+"""Observability plane (horovod_tpu/obs/): metrics registry types/tags/
+dump schema, per-rank timeline merge (lanes, truncated-file tolerance),
+the progress beat + workload-aware staleness policy, the end-of-job
+summary table, and the engine/controller instrumentation seams."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import horovod_tpu.obs as obs
+from horovod_tpu.obs import progress as obs_progress
+from horovod_tpu.obs import summary as obs_summary
+from horovod_tpu.obs import timeline_merge
+from horovod_tpu.obs.progress import ProgressPolicy
+from horovod_tpu.obs.registry import resolve_dump_path
+from horovod_tpu.runtime.timeline import Timeline, resolve_path
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset_registry()
+    obs_progress.reset()
+    yield
+    obs.reset_registry()
+    obs_progress.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry: instrument types, tags, dump schema
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    reg = obs.get_registry()
+    c = reg.counter("ops.total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = reg.gauge("queue.depth")
+    g.set(7)
+    g.set(3)
+    assert g.value == 3.0
+    h = reg.histogram("lat.ms")
+    for v in (1.0, 2.0, 3.0, 100.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.min == 1.0 and h.max == 100.0
+    assert h.sum == pytest.approx(106.0)
+    # bucketed quantiles are approximate but ordered and bounded
+    assert h.quantile(0.5) <= h.quantile(0.99) <= 100.0
+
+
+def test_registry_same_name_same_instrument_and_tags_split():
+    reg = obs.get_registry()
+    assert reg.counter("x") is reg.counter("x")
+    a = reg.counter("x", rank="0")
+    b = reg.counter("x", rank="1")
+    assert a is not b
+    a.inc()
+    assert b.value == 0
+
+
+def test_registry_kind_conflict_raises():
+    reg = obs.get_registry()
+    reg.counter("same.name")
+    with pytest.raises(TypeError, match="already registered as counter"):
+        reg.gauge("same.name")
+
+
+def test_dump_schema(tmp_path):
+    reg = obs.get_registry()
+    reg.counter("a", k="v").inc(2)
+    reg.gauge("b").set(1.5)
+    reg.histogram("c").observe(10)
+    path = str(tmp_path / "m.json")
+    doc = reg.dump(path, rank="3")
+    on_disk = json.loads(open(path).read())
+    assert on_disk == json.loads(json.dumps(doc))
+    assert on_disk["schema"] == "hvdtpu-metrics-v1"
+    assert on_disk["rank"] == "3"
+    by_name = {m["name"]: m for m in on_disk["metrics"]}
+    assert by_name["a"]["type"] == "counter"
+    assert by_name["a"]["tags"] == {"k": "v"}
+    assert by_name["a"]["value"] == 2
+    assert by_name["b"]["type"] == "gauge"
+    assert by_name["c"]["type"] == "histogram"
+    for field in ("count", "sum", "min", "max", "mean", "p50", "p90", "p99"):
+        assert field in by_name["c"]
+
+
+def test_collector_runs_at_snapshot_only():
+    reg = obs.get_registry()
+    calls = []
+
+    def collect(r):
+        calls.append(1)
+        r.gauge("engine.stats.cycles").set(42)
+
+    reg.register_collector(collect)
+    assert calls == []
+    snap = {m["name"]: m for m in reg.snapshot()}
+    assert calls == [1]
+    assert snap["engine.stats.cycles"]["value"] == 42.0
+
+
+def test_broken_collector_does_not_lose_metrics():
+    reg = obs.get_registry()
+    reg.counter("survives").inc()
+    reg.register_collector(lambda r: 1 / 0)
+    names = [m["name"] for m in reg.snapshot()]
+    assert "survives" in names
+
+
+def test_resolve_dump_path_forms(tmp_path, monkeypatch):
+    monkeypatch.delenv("HVDTPU_ELASTIC_EPOCH", raising=False)
+    d = str(tmp_path)
+    assert resolve_dump_path(d, rank="2") == os.path.join(
+        d, "metrics.rank.2.json"
+    )
+    assert resolve_dump_path("/x/m-{rank}.json", rank="2") == "/x/m-2.json"
+    assert resolve_dump_path("/x/m.json", rank="2") == "/x/m.rank.2.json"
+    monkeypatch.setenv("HVDTPU_ELASTIC_EPOCH", "1")
+    assert resolve_dump_path("/x/m.json", rank="2") == "/x/m.e1.rank.2.json"
+
+
+def test_dump_metrics_env_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.METRICS_DUMP_ENV, str(tmp_path))
+    monkeypatch.setenv("HVDTPU_RANK", "5")
+    monkeypatch.delenv("HVDTPU_ELASTIC_EPOCH", raising=False)
+    obs.get_registry().counter("x").inc()
+    written = obs.dump_metrics()
+    assert written == os.path.join(str(tmp_path), "metrics.rank.5.json")
+    assert json.loads(open(written).read())["rank"] == "5"
+
+
+def test_dump_metrics_unconfigured_is_noop(monkeypatch):
+    monkeypatch.delenv(obs.METRICS_DUMP_ENV, raising=False)
+    assert obs.dump_metrics() is None
+
+
+# ---------------------------------------------------------------------------
+# progress beat: counter, phases, payload
+# ---------------------------------------------------------------------------
+
+
+def test_framework_tick_does_not_end_init_grace():
+    """The epoch-start state sync is a completed collective (liveness)
+    but NOT steady state: the user's first step — and its possibly very
+    long jit compile — has not run yet, and the init/compile grace must
+    survive until a USER-level collective completes."""
+    obs_progress.tick(to_steady=False)
+    assert obs_progress.value() == 1
+    assert obs_progress.phase() == "init"  # grace window still open
+    obs_progress.tick()
+    assert obs_progress.phase() == "steady"
+
+
+def test_progress_phases_and_ticks():
+    assert obs_progress.phase() == "init"
+    assert obs_progress.value() == 0
+    obs_progress.tick()
+    assert obs_progress.phase() == "steady"
+    assert obs_progress.value() == 1
+    obs.set_phase("compile")
+    assert obs_progress.phase() == "compile"
+    obs_progress.tick(3)  # any completed collective ends the phase
+    assert obs_progress.phase() == "steady"
+    assert obs_progress.value() == 4
+    with pytest.raises(ValueError, match="unknown phase"):
+        obs.set_phase("siesta")
+
+
+def test_beat_payload_roundtrip_and_legacy():
+    obs_progress.tick(7)
+    p, ph, w = obs_progress.parse_beat(obs_progress.beat_payload())
+    assert p == 7 and ph == "steady" and w is False
+    with obs_progress.waiting():
+        assert obs_progress.in_wait()
+        _, _, w = obs_progress.parse_beat(obs_progress.beat_payload())
+        assert w is True
+    assert not obs_progress.in_wait()
+    # legacy beats (plain repr(time.time())) parse to no-data
+    assert obs_progress.parse_beat(b"1714.23") == (None, None, False)
+    assert obs_progress.parse_beat(b"\xff\xfegarbage") == (None, None, False)
+
+
+def _beat(p, ph, w=False):
+    return json.dumps({"t": 0.0, "p": p, "ph": ph, "w": w}).encode()
+
+
+def test_policy_steady_deadlock_declared_dead():
+    pol = ProgressPolicy(steady_timeout=10.0)
+    assert pol.observe(0, _beat(5, "steady"), now=100.0) is None
+    assert pol.observe(0, _beat(5, "steady"), now=105.0) is None
+    reason = pol.observe(0, _beat(5, "steady"), now=111.0)
+    assert reason is not None and "steady" in reason
+
+
+def test_policy_advancing_counter_never_dies():
+    pol = ProgressPolicy(steady_timeout=10.0)
+    for i, t in enumerate((100.0, 150.0, 200.0)):
+        assert pol.observe(0, _beat(i, "steady"), now=t) is None
+
+
+def test_policy_compile_phase_exempt_by_default():
+    """grace_timeout=0: a long compile phase is never killed — that is
+    the workload-aware half of the policy (acceptance: long compile
+    under the grace window survives)."""
+    pol = ProgressPolicy(steady_timeout=5.0, grace_timeout=0.0)
+    assert pol.observe(0, _beat(3, "compile"), now=0.0) is None
+    assert pol.observe(0, _beat(3, "compile"), now=10_000.0) is None
+    # ... and init is covered by the same exemption
+    assert pol.observe(1, _beat(0, "init"), now=0.0) is None
+    assert pol.observe(1, _beat(0, "init"), now=10_000.0) is None
+
+
+def test_policy_grace_budget_applies_when_set():
+    pol = ProgressPolicy(steady_timeout=5.0, grace_timeout=60.0)
+    assert pol.observe(0, _beat(3, "compile"), now=0.0) is None
+    assert pol.observe(0, _beat(3, "compile"), now=30.0) is None  # under
+    reason = pol.observe(0, _beat(3, "compile"), now=61.0)
+    assert reason is not None and "compile" in reason
+
+
+def test_policy_waiting_rank_is_exempt():
+    """A rank blocked inside a collective wait froze because of someone
+    else — the policy must kill the hung peer, never the waiters (the
+    original all-peers-shot failure mode of a naive counter rule)."""
+    pol = ProgressPolicy(steady_timeout=5.0)
+    assert pol.observe(0, _beat(5, "steady", w=True), now=0.0) is None
+    assert pol.observe(0, _beat(5, "steady", w=True), now=1e6) is None
+    # the same counter freeze while NOT waiting is culpable
+    assert pol.observe(1, _beat(5, "steady", w=False), now=0.0) is None
+    assert pol.observe(1, _beat(5, "steady", w=False), now=10.0) is not None
+
+
+def test_policy_wait_transition_restarts_window():
+    pol = ProgressPolicy(steady_timeout=5.0)
+    assert pol.observe(0, _beat(5, "steady", w=True), now=0.0) is None
+    # unblocking (w flips) restarts the window even with a frozen counter
+    assert pol.observe(0, _beat(5, "steady", w=False), now=100.0) is None
+    assert pol.observe(0, _beat(5, "steady", w=False), now=104.0) is None
+    assert pol.observe(0, _beat(5, "steady", w=False), now=106.0) is not None
+
+
+def test_policy_phase_change_restarts_window():
+    pol = ProgressPolicy(steady_timeout=5.0, grace_timeout=100.0)
+    assert pol.observe(0, _beat(3, "steady"), now=0.0) is None
+    # dropping into compile re-arms the (grace) window even though the
+    # counter did not move
+    assert pol.observe(0, _beat(3, "compile"), now=4.0) is None
+    assert pol.observe(0, _beat(3, "compile"), now=50.0) is None
+
+
+def test_policy_disabled_and_legacy_beats_ignored():
+    assert ProgressPolicy(0.0, 0.0).observe(0, _beat(1, "steady"), 1e9) is None
+    pol = ProgressPolicy(steady_timeout=5.0)
+    assert pol.observe(0, b"1714.0", now=0.0) is None
+    assert pol.observe(0, b"1714.0", now=1e9) is None
+
+
+def test_policy_forget_gives_successor_fresh_window():
+    pol = ProgressPolicy(steady_timeout=10.0)
+    pol.observe(0, _beat(5, "steady"), now=0.0)
+    pol.forget(0)
+    assert pol.observe(0, _beat(5, "steady"), now=100.0) is None
+
+
+# ---------------------------------------------------------------------------
+# timeline: per-rank paths, streaming format, merge
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_resolve_path_forms(tmp_path, monkeypatch):
+    monkeypatch.delenv("HVDTPU_ELASTIC_EPOCH", raising=False)
+    assert resolve_path("/x/t.json", 1) == "/x/t.rank.1.json"
+    assert resolve_path("/x/t-{rank}.json", 1) == "/x/t-1.json"
+    d = str(tmp_path)
+    assert resolve_path(d, 1) == os.path.join(d, "trace.rank.1.json")
+    monkeypatch.setenv("HVDTPU_ELASTIC_EPOCH", "2")
+    assert resolve_path("/x/t.json", 1) == "/x/t.e2.rank.1.json"
+
+
+def test_timeline_clean_shutdown_is_valid_json_with_rank_pid(tmp_path):
+    path = str(tmp_path / "t.json")
+    tl = Timeline(path, rank=3)
+    tl.start("g0", "ALLREDUCE")
+    tl.end("g0", "ALLREDUCE")
+    tl.shutdown()
+    events = json.loads(open(path).read())
+    real = [e for e in events if e.get("name") != "trace_complete"]
+    assert {e["pid"] for e in real} == {3}
+    assert events[-1]["name"] == "trace_complete"
+
+
+def test_timeline_truncated_file_still_loads(tmp_path):
+    """Crash-safety: a rank killed mid-job leaves a trace with no
+    terminator (and possibly a half-written last line); load_events
+    recovers every complete event."""
+    path = str(tmp_path / "t.rank.0.json")
+    tl = Timeline(path, rank=0)
+    for i in range(5):
+        tl.start(f"g{i}", "ALLREDUCE")
+        tl.end(f"g{i}", "ALLREDUCE")
+    tl.shutdown()
+    text = open(path).read()
+    # simulate the kill: drop the terminator and cut the last event line
+    body = text[: text.rindex("{")]  # strip terminator event + "]"
+    cut = body.rstrip().rstrip(",")
+    cut = cut[: cut.rindex(",") + 1] + '{"ph": "B", "name": "half'
+    open(path, "w").write(cut)
+    with pytest.raises(ValueError):
+        json.loads(open(path).read())
+    events = timeline_merge.load_events(path)
+    assert len(events) >= 8  # 10 complete events minus the mangled tail
+    assert all(e.get("name") for e in events)
+
+
+def test_timeline_merge_lanes_and_validity(tmp_path):
+    for rank in (0, 1):
+        tl = Timeline(str(tmp_path / f"t.rank.{rank}.json"), rank=rank)
+        tl.start("g0", "ALLREDUCE")
+        tl.end("g0", "ALLREDUCE")
+        if rank == 0:
+            tl.shutdown()  # rank 1 "dies": no terminator flushes late
+        else:
+            tl._queue.put(None)
+            tl._writer.join(timeout=5)
+    out = str(tmp_path / "merged.json")
+    n = timeline_merge.merge(
+        [str(tmp_path / "t.rank.0.json"), str(tmp_path / "t.rank.1.json")],
+        out,
+    )
+    events = json.loads(open(out).read())  # MUST be valid JSON
+    assert n == len([e for e in events if e.get("ph") != "M"])
+    pids = {e["pid"] for e in events if e.get("ph") != "M"}
+    assert pids == {0, 1}
+    lane_names = {
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert lane_names == {"rank 0", "rank 1"}
+
+
+def test_timeline_merge_glob_plain_path(tmp_path, monkeypatch):
+    monkeypatch.delenv("HVDTPU_ELASTIC_EPOCH", raising=False)
+    raw = str(tmp_path / "trace.json")
+    for rank in (0, 1):
+        tl = Timeline(resolve_path(raw, rank), rank=rank)
+        tl.mark_cycle()
+        tl.start("g", "ALLREDUCE")
+        tl.end("g", "ALLREDUCE")
+        tl.shutdown()
+    merged = timeline_merge.merge_glob(raw)
+    assert merged == raw  # plain-path form merges back onto the raw path
+    events = json.loads(open(raw).read())
+    assert {e["pid"] for e in events} == {0, 1}
+    # re-running the merge must not ingest its own output
+    assert timeline_merge.merge_glob(raw) == raw
+    assert {e["pid"] for e in json.loads(open(raw).read())} == {0, 1}
+
+
+def test_timeline_merge_glob_nothing_to_merge(tmp_path):
+    assert timeline_merge.merge_glob(str(tmp_path / "none.json")) is None
+
+
+def test_rank_of_path_variants():
+    assert timeline_merge.rank_of_path("/a/t.rank.3.json") == 3
+    assert timeline_merge.rank_of_path("/a/t.e2.rank.11.json") == 11
+    assert timeline_merge.rank_of_path("/a/trace-7.json") is None
+
+
+def test_timeline_merge_epoch_incarnations_get_distinct_lanes(tmp_path):
+    """A dead incarnation and its respawned successor both have
+    perf_counter timestamps starting near zero — sharing a pid lane
+    would overlay their lifetimes, so each (rank, epoch) gets its own
+    lane, labelled with the epoch."""
+    for tag in ("e0.rank.1", "e1.rank.1"):
+        tl = Timeline(str(tmp_path / f"t.{tag}.json"), rank=1)
+        tl.start("g", "ALLREDUCE")
+        tl.end("g", "ALLREDUCE")
+        tl.shutdown()
+    out = str(tmp_path / "merged.json")
+    timeline_merge.merge(
+        [str(tmp_path / "t.e0.rank.1.json"),
+         str(tmp_path / "t.e1.rank.1.json")], out)
+    events = json.loads(open(out).read())
+    pids = {e["pid"] for e in events if e.get("ph") != "M"}
+    assert len(pids) == 2
+    labels = {
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert labels == {"rank 1", "rank 1 (epoch 1)"}
+
+
+def test_launcher_cleans_stale_per_rank_files(tmp_path, monkeypatch):
+    """A 2-rank run pointed at the same paths as an earlier 4-rank run
+    must not inherit phantom lanes/columns from the leftovers."""
+    from horovod_tpu.run.runner import _clean_stale_obs_files
+
+    raw = str(tmp_path / "trace.json")
+    for rank in range(4):
+        (tmp_path / f"trace.rank.{rank}.json").write_text("[\n")
+    (tmp_path / "trace.json").write_text("[]")  # merged output: kept
+    (tmp_path / "metrics.rank.0.json").write_text("{}")
+    _clean_stale_obs_files({
+        "HVDTPU_TIMELINE": raw,
+        "HVDTPU_METRICS_DUMP": str(tmp_path) + os.sep,
+    })
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["trace.json"]
+
+
+def test_pathspec_template_form_is_epoch_qualified(monkeypatch):
+    """The {rank} template must not let a respawned incarnation
+    overwrite its dead predecessor's file (the invariant holds for
+    every value form)."""
+    from horovod_tpu.obs import pathspec
+
+    monkeypatch.setenv("HVDTPU_ELASTIC_EPOCH", "2")
+    p = pathspec.resolve("/x/t-{rank}.json", "trace", 1)
+    assert p == "/x/t-1.e2.json"
+    assert pathspec.epoch_of_path(p) == 2
+    monkeypatch.delenv("HVDTPU_ELASTIC_EPOCH")
+    assert pathspec.resolve("/x/t-{rank}.json", "trace", 1) == "/x/t-1.json"
+
+
+def test_cleanup_never_touches_untagged_files(tmp_path):
+    """Deletion safety: cleanup only removes files carrying our rank
+    tag, and skips template-form values entirely (their glob has no
+    anchor and could match arbitrary user files)."""
+    from horovod_tpu.run.runner import _clean_stale_obs_files
+
+    (tmp_path / "m.notes.json").write_text("{}")  # user file
+    (tmp_path / "m.0.json").write_text("{}")  # template-form leftover
+    (tmp_path / "m.rank.1.json").write_text("{}")  # ours
+    _clean_stale_obs_files(
+        {"HVDTPU_METRICS_DUMP": str(tmp_path / "m.{rank}.json")}
+    )
+    assert (tmp_path / "m.notes.json").exists()  # template: no cleanup
+    assert (tmp_path / "m.0.json").exists()
+    _clean_stale_obs_files({"HVDTPU_METRICS_DUMP": str(tmp_path / "m.json")})
+    assert (tmp_path / "m.notes.json").exists()  # no rank tag: kept
+    assert not (tmp_path / "m.rank.1.json").exists()  # ours: removed
+
+
+def test_beat_epoch_stamp_roundtrip():
+    assert obs_progress.beat_epoch(obs_progress.beat_payload(epoch=3)) == 3
+    assert obs_progress.beat_epoch(obs_progress.beat_payload()) is None
+    assert obs_progress.beat_epoch(b"1714.0") is None
+
+
+# ---------------------------------------------------------------------------
+# summary table
+# ---------------------------------------------------------------------------
+
+
+def _write_dump(tmp_path, rank, metrics, epoch=None):
+    obs.reset_registry()
+    reg = obs.get_registry()
+    for name, v in metrics.items():
+        reg.counter(name).inc(v)
+    tag = f"e{epoch}.rank.{rank}" if epoch else f"rank.{rank}"
+    path = str(tmp_path / f"metrics.{tag}.json")
+    reg.dump(path, rank=str(rank))
+    return path
+
+
+def test_summary_collect_and_format(tmp_path):
+    _write_dump(tmp_path, 0, {"engine.collectives_completed": 10})
+    _write_dump(tmp_path, 1, {"engine.collectives_completed": 9,
+                              "elastic.recoveries": 1})
+    table = obs_summary.summarize(str(tmp_path))
+    assert table is not None
+    lines = table.splitlines()
+    assert "rank 0" in lines[0] and "rank 1" in lines[0]
+    row = next(l for l in lines if l.startswith("engine.collectives"))
+    assert "10" in row and "9" in row
+    # a metric only one rank reported renders "-" for the others
+    row = next(l for l in lines if l.startswith("elastic.recoveries"))
+    assert "-" in row
+
+
+def test_summary_tolerates_garbage_and_epoch_tags(tmp_path):
+    _write_dump(tmp_path, 0, {"x": 1})
+    _write_dump(tmp_path, 2, {"x": 3}, epoch=1)
+    (tmp_path / "metrics.rank.9.json").write_text("{not json")
+    dumps = obs_summary.collect_dumps(str(tmp_path))
+    assert set(dumps) == {"0", "2@e1"}
+    assert obs_summary.summarize(str(tmp_path / "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# instrumentation seams
+# ---------------------------------------------------------------------------
+
+
+def test_engine_single_process_registers_instruments():
+    from horovod_tpu.runtime.engine import EagerEngine
+
+    eng = EagerEngine()
+    snap = {m["name"] for m in obs.get_registry().snapshot()}
+    assert "engine.cycle_time_ms" in snap
+    assert "engine.collectives_completed" in snap
+    assert "engine.stats.cycles" in snap  # via the stats collector
+    eng.shutdown()
+
+
+def test_controller_stall_counter_increments(monkeypatch):
+    import horovod_tpu.runtime.controller as ctl
+
+    state = ctl.ControllerState(world_size=2)
+    req = ctl.Request(
+        request_rank=0,
+        request_type=ctl.RequestType.ALLREDUCE,
+        tensor_name="w",
+        dtype="float32",
+        shape=(2,),
+    )
+    state.message_table[req.key()] = ctl._TableEntry(requests={0: req})
+    state.message_table[req.key()].first_seen -= 100.0
+    state.last_stall_check -= 100.0
+    ctl._check_stalls(state, warn_secs=1.0, shutdown_secs=0.0)
+    snap = {
+        (m["name"], m["tags"].get("tensor")): m
+        for m in obs.get_registry().snapshot()
+    }
+    c = snap[("controller.stall_warnings", "w")]
+    assert c["value"] == 1
+    g = snap[("controller.stall_lagging_ranks", "w")]
+    assert g["value"] == 1.0  # rank 1 is lagging
+
+
+def test_checkpoint_metrics_single_process(tmp_path):
+    import horovod_tpu as hvd
+
+    hvd.init()
+    from horovod_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    state = {"w": np.arange(3.0)}
+    save_checkpoint(str(tmp_path / "ck"), state, step=1)
+    restore_checkpoint(str(tmp_path / "ck"), state)
+    snap = {m["name"]: m for m in obs.get_registry().snapshot()}
+    assert snap["checkpoint.saves_started"]["value"] == 1
+    assert snap["checkpoint.saves_committed"]["value"] == 1
+    assert snap["checkpoint.restores"]["value"] == 1
+    assert snap["checkpoint.commit_wait_ms"]["count"] == 1
+
+
+def test_hang_fault_action_parses_and_blocks_thread(monkeypatch):
+    """action=hang wedges only the calling thread — the signature the
+    progress policy exists to catch (the full 4-proc version lives in
+    test_elastic.py)."""
+    from horovod_tpu.testing import faults
+
+    monkeypatch.setenv(faults.SPEC_ENV, "spin:action=hang")
+    faults.reset()
+    started = threading.Event()
+
+    def victim():
+        started.set()
+        faults.maybe_fail("spin")
+
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    assert started.wait(5)
+    t.join(timeout=0.5)
+    assert t.is_alive()  # wedged, not raised/exited
+    faults.reset()
+
+
+def test_hang_fault_bad_action_rejected():
+    from horovod_tpu.testing import faults
+
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.parse_spec("x:action=explode")

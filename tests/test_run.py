@@ -117,6 +117,26 @@ def test_parse_args_knobs_to_env():
     assert env["HVDTPU_LOG_LEVEL"] == "debug"
 
 
+def test_parse_args_obs_knobs():
+    args = parse_args(
+        [
+            "-np", "2",
+            "--metrics-dump", "/tmp/metrics/",
+            "--stats-summary",
+            "--progress-timeout-secs", "120",
+            "--progress-grace-secs", "900",
+            "python", "train.py",
+        ]
+    )
+    env = {}
+    set_env_from_args(env, args)
+    assert env["HVDTPU_METRICS_DUMP"] == "/tmp/metrics/"
+    assert args.stats_summary is True
+    # launcher-local policy knobs (not worker env)
+    assert args.progress_timeout_secs == 120.0
+    assert args.progress_grace_secs == 900.0
+
+
 def test_parse_args_autotune_knobs_to_env():
     """The full autotune flag surface maps onto the engine env knobs
     (reference runner.py:318-347 autotune argument group)."""
