@@ -22,13 +22,14 @@ if [ "${1:-full}" = "quick" ]; then
         "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks" \
         -x -q
     echo "== quick tier: observability plane =="
-    python -m pytest tests/test_obs.py -x -q
+    python -m pytest tests/test_obs.py tests/test_obs_live.py -x -q
     echo "== quick tier: unit + multiprocess suite minus -m full =="
-    # test_elastic.py / test_obs.py and the injection case already ran
+    # test_elastic.py / test_obs*.py and the injection case already ran
     # above — don't pay for the multiprocess chaos cases twice per commit.
     python -m pytest tests/ -x -q -m "not full" \
         --ignore=tests/test_elastic.py \
         --ignore=tests/test_obs.py \
+        --ignore=tests/test_obs_live.py \
         --deselect "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks"
     exit 0
 fi
@@ -120,6 +121,78 @@ assert pids == {0, 1}, f"expected a lane per rank, got pids={pids}"
 print(f"obs gate OK: {len(dumps)} dumps, {len(merged)} timeline events")
 EOF
 rm -rf "$OBS_TMP"
+
+# Live telemetry gate (ISSUE 3): a 2-proc job streaming metrics to the
+# launcher; an external scraper attaches to GET /metrics MID-RUN and
+# must read non-empty, parseable Prometheus exposition with a sample
+# per rank, and live_history.jsonl must gain parseable rows.
+echo "== obs_live gate: mid-run /metrics scrape + live history =="
+LIVE_TMP=$(mktemp -d)
+cat > "$LIVE_TMP/worker.py" <<'EOF'
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+for i in range(16):
+    hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name=f"t{i}")
+    time.sleep(0.25)
+hvd.shutdown()
+EOF
+cat > "$LIVE_TMP/scrape.py" <<'EOF'
+import json, os, re, subprocess, sys, time, urllib.request
+
+tmp = sys.argv[1]
+hist = os.path.join(tmp, "live_history.jsonl")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+     "--live-stats-secs", "0.3", "--live-history-file", hist,
+     sys.executable, os.path.join(tmp, "worker.py")],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+)
+endpoint = None
+deadline = time.time() + 90
+while time.time() < deadline and endpoint is None:
+    line = proc.stdout.readline()
+    if not line:
+        break
+    sys.stdout.write(line)
+    m = re.search(r"scrape endpoint (http://\S+/metrics)", line)
+    if m:
+        endpoint = m.group(1)
+assert endpoint, "launcher never announced the scrape endpoint"
+
+# scrape MID-RUN until per-rank samples appear
+body = ""
+while time.time() < deadline:
+    body = urllib.request.urlopen(endpoint, timeout=5).read().decode()
+    if 'rank="0"' in body and 'rank="1"' in body:
+        break
+    time.sleep(0.3)
+assert proc.poll() is None, "job finished before the mid-run scrape"
+sample = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (NaN|[-+0-9.eE]+)$')
+lines = [l for l in body.rstrip().splitlines() if not l.startswith("#")]
+assert lines, "empty exposition"
+for l in lines:
+    assert sample.match(l), f"unparseable exposition line: {l!r}"
+assert "hvdtpu_engine_collectives_completed" in body
+
+proc.stdout.read()
+assert proc.wait(timeout=120) == 0
+rows = [json.loads(l) for l in open(hist)]
+assert rows, "live_history.jsonl gained no rows"
+assert rows[-1]["ranks_reporting"] >= 1
+print(f"obs_live gate OK: {len(lines)} exposition lines, "
+      f"{len(rows)} history rows")
+EOF
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python "$LIVE_TMP/scrape.py" "$LIVE_TMP"
+rm -rf "$LIVE_TMP"
 
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must

@@ -207,6 +207,11 @@ def init(comm=None) -> Topology:
     get_registry().gauge("process.rank").set(
         _topology.process_rank
     )
+    # Live telemetry streaming (obs/stream.py): a no-op unless the
+    # launcher exported HVDTPU_LIVE_STATS_SECS + a KV endpoint.
+    from .obs import stream as _obs_stream  # noqa: PLC0415
+
+    _obs_stream.maybe_start_from_env()
 
     # Start the native eager engine NOW in multi-process worlds (reference
     # behavior: InitializeHorovodOnce spawns the background thread at init,

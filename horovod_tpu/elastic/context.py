@@ -46,6 +46,7 @@ import numpy as np
 
 from ..obs import get_registry
 from ..obs import progress as obs_progress
+from ..obs import straggler as obs_straggler
 from ..run.rendezvous import KVStoreClient
 from ..testing.faults import maybe_fail
 from ..utils.env import env_float
@@ -121,6 +122,12 @@ class ElasticContext:
         budget."""
         if self._hb_thread is not None:
             return
+        # The live telemetry publisher rides the heartbeat lifecycle:
+        # same launcher KV endpoint, same signed PUT path, armed by the
+        # same spawn env (HVDTPU_LIVE_STATS_SECS).
+        from ..obs import stream as obs_stream  # noqa: PLC0415
+
+        obs_stream.maybe_start_from_env()
 
         def _beat():
             while True:
@@ -222,6 +229,10 @@ class ElasticContext:
             # _seq) and a respawned rank (fresh process, _seq 0) must
             # agree on auto-minted names like "op3" after recovery.
             self._seq = 0
+            # Straggler attribution starts clean per incarnation: the
+            # old world's blame (often the very rank that just died or
+            # was respawned) must not leak into the new epoch's verdict.
+            obs_straggler.reset()
             get_registry().counter("elastic.rendezvous").inc()
             LOG.info("rank %d joined epoch %d world %s",
                      self.rank, e, world)
@@ -247,14 +258,24 @@ class ElasticContext:
         self.kv.put(scope, f"ar_{name}_{self.rank}", pickle.dumps(arr))
         deadline = time.monotonic() + self.timeout
         parts = []
+        waits = {}
         # Contribution is in: from here this rank is blocked on PEERS,
         # and the beat's waiting flag says so — a hung peer freezes this
         # counter too, and the policy must kill the peer, not us.
         with obs_progress.waiting():
             for r in self.world:
+                t0 = time.monotonic()
                 raw = self._fetch(scope, f"ar_{name}_{r}", deadline,
                                   what=f"allreduce {name!r} from rank {r}")
+                waits[r] = time.monotonic() - t0
                 parts.append(pickle.loads(raw))
+        # Straggler attribution, KV-collective flavor: blame the peer
+        # this rank actually sat polling for (a delayed rank waits on
+        # nobody, so it never smears blame; see obs/straggler.py).
+        obs_straggler.record_waits(
+            waits, self.rank, tensor=name,
+            alert_ms=env_float("HVDTPU_ALERT_SKEW_MS", 0.0),
+        )
         total = parts[0].astype(np.float64) if average else parts[0]
         for p in parts[1:]:
             total = total + p
@@ -385,4 +406,7 @@ def reset_context() -> None:
     with _current_lock:
         if _current is not None:
             _current.stop_heartbeat()
+            from ..obs import stream as obs_stream  # noqa: PLC0415
+
+            obs_stream.stop_stream()
         _current = None

@@ -44,6 +44,8 @@ def launch(
     progress_grace: float = 0.0,
     blacklist_cooldown: float = 10.0,
     timeout: Optional[float] = None,
+    live_stats_secs: Optional[float] = None,
+    live_history: Optional[str] = None,
 ) -> Tuple[Dict[int, Any], ElasticJobResult]:
     """Run ``fn(*args, **kwargs)`` on ``np`` elastic workers.
 
@@ -78,6 +80,8 @@ def launch(
             blacklist_cooldown=blacklist_cooldown,
             job_timeout=timeout,
             kv_server=server,
+            live_stats_secs=live_stats_secs,
+            live_history=live_history,
         )
         results: Dict[int, Any] = {}
         for rank in job.world:

@@ -16,11 +16,21 @@ quantitative):
 * **all-rank timeline merge** (obs/timeline_merge.py) — repairs and
   merges the per-rank Chrome traces (runtime/timeline.py) into one
   valid trace with a lane per rank.
+* **live telemetry** (obs/stream.py worker side, obs/live.py launcher
+  side) — per-rank snapshot deltas streamed over the signed KV path
+  while the job runs: console digests, ``live_history.jsonl``, and a
+  Prometheus ``GET /metrics`` scrape endpoint on the launcher.
+* **straggler attribution** (obs/straggler.py) — which rank arrives
+  last at collectives, accumulated as ``engine.straggler.*`` metrics
+  from both collective paths, surfaced in the live digest and the
+  ``--stats-summary`` straggler section.
 
 See docs/observability.md.
 """
 
 from . import progress  # noqa: F401
+from . import straggler  # noqa: F401
+from . import stream  # noqa: F401
 from .registry import (  # noqa: F401
     METRICS_DUMP_ENV,
     Counter,
@@ -44,5 +54,7 @@ __all__ = [
     "reset_registry",
     "dump_metrics",
     "progress",
+    "straggler",
+    "stream",
     "set_phase",
 ]

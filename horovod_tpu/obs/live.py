@@ -1,0 +1,391 @@
+"""Launcher-side live telemetry: merged job view, digest, history,
+Prometheus exposition.
+
+The consumer half of the streaming plane (worker half: obs/stream.py).
+The launcher's aggregator thread scans its own KV store for per-rank
+snapshot deltas under ``obs/live/{epoch}/{rank}/{seq}``, applies them to
+a merged job-level view keyed by (rank, elastic incarnation), and every
+round:
+
+* prints a one-line console digest (ranks reporting, total collectives,
+  phase spread, and — the question this plane exists for — the current
+  straggler with evidence);
+* appends one JSON line to a crash-safe ``live_history.jsonl`` (append +
+  flush per round: a killed launcher leaves every completed round
+  parseable);
+* serves the merged view as Prometheus text exposition from the
+  read-only unauthenticated ``GET /metrics`` branch the aggregator
+  registers on the ``KVStoreServer`` — an external scraper can attach to
+  an in-flight job with nothing but the port (PUTs stay HMAC-gated; the
+  exposition leaks only metric values).
+
+Incarnation semantics: a rank respawned by the elastic launcher
+publishes under its new spawn epoch; :meth:`LiveAggregator.merged`
+surfaces each rank's *newest* incarnation while older incarnations stay
+queryable (label ``epoch`` in the exposition) — a dead incarnation's
+last snapshot is evidence, not noise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+from . import stream as obs_stream
+from . import straggler as obs_straggler
+
+LOG = get_logger("obs.live")
+
+__all__ = ["LiveAggregator", "LivePlane", "prometheus_escape"]
+
+
+class _RankView:
+    """One (rank, epoch) incarnation's latest state."""
+
+    def __init__(self, rank: int, epoch: int):
+        self.rank = rank
+        self.epoch = epoch
+        self.metrics: Dict[str, dict] = {}
+        self.seq = -1
+        self.phase: Optional[str] = None
+        self.progress = 0
+        self.wall_time = 0.0
+        self.seen_mono = 0.0
+
+
+def prometheus_escape(value: str) -> str:
+    return (
+        str(value).replace("\\", r"\\").replace('"', r'\"')
+        .replace("\n", r"\n")
+    )
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return "hvdtpu_" + out
+
+
+class LiveAggregator:
+    """Merged job-level view of every rank's streamed snapshots.
+    Thread-safe: the HTTP handler renders from scraper threads while the
+    aggregator thread ingests."""
+
+    def __init__(self):
+        # RLock: digest()/history_row() compose merged()+straggler(),
+        # and every reader holds the lock for its WHOLE traversal — the
+        # /metrics handler thread renders concurrently with ingest, and
+        # iterating a view dict mid-apply_delta would raise.
+        self._lock = threading.RLock()
+        self._views: Dict[Tuple[int, int], _RankView] = {}
+        self.rounds = 0
+
+    # ------------------------------------------------------------ ingest
+
+    def ingest(self, doc: dict) -> None:
+        """Apply one worker payload (obs/stream.py wire contract)."""
+        rank, epoch = int(doc["rank"]), int(doc.get("epoch", 0))
+        with self._lock:
+            view = self._views.get((rank, epoch))
+            if view is None:
+                view = self._views[(rank, epoch)] = _RankView(rank, epoch)
+            if doc.get("full"):
+                # A full snapshot is authoritative: a publisher restarted
+                # in-process (seq reset) must not leave phantom metrics.
+                view.metrics = {}
+            obs_stream.apply_delta(view.metrics, doc.get("metrics", []))
+            view.seq = max(view.seq, int(doc.get("seq", 0)))
+            view.phase = doc.get("phase") or view.phase
+            view.progress = int(doc.get("progress", view.progress))
+            view.wall_time = float(doc.get("t", view.wall_time))
+            view.seen_mono = time.monotonic()
+
+    # ------------------------------------------------------------ views
+
+    def merged(self) -> Dict[int, _RankView]:
+        """rank -> newest incarnation's view."""
+        with self._lock:
+            out: Dict[int, _RankView] = {}
+            for (rank, _), view in sorted(self._views.items()):
+                cur = out.get(rank)
+                if cur is None or view.epoch > cur.epoch:
+                    out[rank] = view
+            return out
+
+    def incarnations(self) -> List[_RankView]:
+        with self._lock:
+            return [self._views[k] for k in sorted(self._views)]
+
+    # -------------------------------------------------------- straggler
+
+    def straggler(self) -> Optional[dict]:
+        """Current top straggler from the merged incarnation views —
+        the SAME verdict ``--stats-summary`` computes over the exit
+        dumps (shared implementation: obs/straggler.py merge_blames)."""
+        with self._lock:
+            verdict = obs_straggler.merge_blames(
+                [list(v.metrics.values()) for v in self.merged().values()]
+            )
+        if verdict is None:
+            return None
+        return {
+            "rank": verdict["rank"],
+            "last_arrivals": verdict["last_arrivals"],
+            "share": verdict["share"],
+            "worst_skew_ms": verdict["worst_skew_ms"],
+            "ops_with_skew": int(verdict["skew"]["count"] or 0),
+        }
+
+    # ----------------------------------------------------------- digest
+
+    def digest(self, expected_ranks: Optional[int] = None) -> str:
+        with self._lock:
+            views = self.merged()
+            if not views:
+                return "live: no rank has reported yet"
+            total = "?" if expected_ranks is None else str(expected_ranks)
+            progress = {r: v.progress for r, v in views.items()}
+            lo_rank = min(progress, key=lambda r: (progress[r], r))
+            phases = sorted({v.phase or "?" for v in views.values()})
+            strag = self.straggler()
+        parts = [
+            f"ranks {len(views)}/{total}",
+            f"collectives min {progress[lo_rank]} (rank {lo_rank}) "
+            f"max {max(progress.values())}",
+            "phase " + "/".join(phases),
+        ]
+        if strag is not None:
+            parts.append(
+                f"straggler rank {strag['rank']} "
+                f"({strag['last_arrivals']} last-arrivals, "
+                f"{strag['share']:.0%}, worst skew "
+                f"{strag['worst_skew_ms']:.0f}ms)"
+            )
+        else:
+            parts.append("straggler none")
+        return "live[" + time.strftime("%H:%M:%S") + "] " + " | ".join(parts)
+
+    # ---------------------------------------------------------- history
+
+    def history_row(self, expected_ranks: Optional[int] = None) -> dict:
+        with self._lock:
+            views = self.merged()
+            return {
+                "t": time.time(),
+                "round": self.rounds,
+                "ranks_reporting": len(views),
+                "ranks_expected": expected_ranks,
+                "progress": {str(r): v.progress for r, v in views.items()},
+                "phases": {str(r): v.phase for r, v in views.items()},
+                "epochs": {str(r): v.epoch for r, v in views.items()},
+                "straggler": self.straggler(),
+            }
+
+    # ------------------------------------------------------- prometheus
+
+    def prometheus(self) -> str:
+        """Text exposition (format 0.0.4) of every incarnation's view,
+        labelled ``rank``/``epoch`` plus the instrument's own tags.
+        Histograms render as summaries (quantile label + _sum/_count).
+        An instrument tag that collides with a reserved exposition
+        label (``rank``, ``epoch``, ``quantile`` — e.g. the blamed-rank
+        tag on ``engine.straggler.last_arrivals``) is emitted as
+        ``tag_<name>``: duplicate label names are a hard parse error
+        for real scrapers."""
+        with self._lock:
+            incarnations = self.incarnations()
+            by_name: Dict[str, List[Tuple[dict, _RankView]]] = {}
+            for view in incarnations:
+                for m in view.metrics.values():
+                    by_name.setdefault(m["name"], []).append((m, view))
+            merged = self.merged()
+            strag = self.straggler()
+        lines: List[str] = []
+        _RESERVED = ("rank", "epoch", "quantile")
+
+        def labels(view: _RankView, tags: dict, extra: str = "") -> str:
+            items = [f'rank="{view.rank}"', f'epoch="{view.epoch}"']
+            for k, v in sorted(tags.items()):
+                key = _prom_name(k)[len("hvdtpu_"):]
+                if key in _RESERVED:
+                    key = "tag_" + key
+                items.append(f'{key}="{prometheus_escape(v)}"')
+            if extra:
+                items.append(extra)
+            return "{" + ",".join(items) + "}"
+
+        def num(v) -> str:
+            if v is None:
+                return "NaN"
+            return repr(float(v))
+
+        for name in sorted(by_name):
+            entries = by_name[name]
+            kind = entries[0][0]["type"]
+            prom = _prom_name(name)
+            lines.append(
+                f"# TYPE {prom} "
+                + {"counter": "counter", "gauge": "gauge",
+                   "histogram": "summary"}[kind]
+            )
+            for m, view in entries:
+                tags = m.get("tags") or {}
+                if kind == "histogram":
+                    for q, field in (("0.5", "p50"), ("0.9", "p90"),
+                                     ("0.99", "p99")):
+                        lines.append(
+                            prom + labels(view, tags, f'quantile="{q}"')
+                            + " " + num(m.get(field))
+                        )
+                    lines.append(
+                        f"{prom}_sum" + labels(view, tags)
+                        + " " + num(m.get("sum", 0.0))
+                    )
+                    lines.append(
+                        f"{prom}_count" + labels(view, tags)
+                        + " " + str(int(m.get("count") or 0))
+                    )
+                else:
+                    lines.append(
+                        prom + labels(view, tags) + " " + num(m["value"])
+                    )
+        # Aggregator-level meta series: scrapers get liveness and the
+        # straggler verdict without re-deriving them from raw counters.
+        lines.append("# TYPE hvdtpu_live_ranks_reporting gauge")
+        lines.append(f"hvdtpu_live_ranks_reporting {len(merged)}")
+        lines.append("# TYPE hvdtpu_live_straggler_rank gauge")
+        lines.append(
+            "hvdtpu_live_straggler_rank "
+            + (str(strag["rank"]) if strag else "-1")
+        )
+        now = time.monotonic()
+        lines.append("# TYPE hvdtpu_live_update_age_seconds gauge")
+        for rank, view in merged.items():
+            lines.append(
+                f'hvdtpu_live_update_age_seconds{{rank="{rank}"}} '
+                + repr(round(now - view.seen_mono, 3))
+            )
+        return "\n".join(lines) + "\n"
+
+
+class LivePlane:
+    """The launcher's live-telemetry driver: owns the aggregator thread,
+    consumes snapshot keys from the KV server, appends history, prints
+    the digest, and serves ``/metrics``.
+
+    ``server`` must be the in-process :class:`KVStoreServer` (the
+    aggregator reads and prunes its store directly — zero HTTP overhead
+    and listing for free, which the HTTP surface deliberately lacks)."""
+
+    def __init__(
+        self,
+        server,
+        *,
+        interval: float,
+        history_path: Optional[str] = None,
+        expected_ranks: Optional[int] = None,
+        print_digest: bool = True,
+        announce_host: Optional[str] = None,
+    ):
+        self.server = server
+        self.interval = max(float(interval), 0.05)
+        self.history_path = history_path
+        self.expected_ranks = expected_ranks
+        self.print_digest = print_digest
+        # The host scrapers should dial — the launcher's ROUTABLE
+        # address for multi-host jobs (the announced line is the only
+        # discoverable endpoint; 127.0.0.1 would be a lie off-box).
+        self.announce_host = announce_host or "127.0.0.1"
+        self.agg = LiveAggregator()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.server.set_metrics_render(self.agg.prometheus)
+        self._thread = threading.Thread(
+            target=self._loop, name="hvdtpu_live_agg", daemon=True
+        )
+        self._thread.start()
+        print(
+            f"[live] scrape endpoint "
+            f"http://{self.announce_host}:{self.server.port}/metrics "
+            f"(every {self.interval:g}s"
+            + (f", history -> {self.history_path}" if self.history_path
+               else "") + ")",
+            flush=True,
+        )
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.round()
+            except Exception as exc:  # pragma: no cover - defensive
+                LOG.warning("live aggregation round failed: %s", exc)
+
+    def round(self) -> int:
+        """One aggregation round: consume every pending snapshot key (in
+        (epoch, rank, seq) order), append history, print the digest.
+        Returns the number of documents ingested."""
+        pending = self.server.scan(obs_stream.LIVE_SCOPE + "/")
+        docs: List[Tuple[Tuple[int, int, int], str, dict]] = []
+        for key, raw in pending.items():
+            tail = key[len(obs_stream.LIVE_SCOPE) + 1:].split("/")
+            try:
+                epoch, rank, seq = (int(t) for t in tail)
+                doc = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                self.server.discard([key])  # junk key: drop, don't wedge
+                continue
+            docs.append(((epoch, rank, seq), key, doc))
+        docs.sort(key=lambda item: item[0])
+        for _, key, doc in docs:
+            try:
+                self.agg.ingest(doc)
+            except Exception as exc:
+                # JSON-valid but schema-invalid (a version-skewed
+                # worker): log and fall through to the discard — a
+                # poison doc must cost one warning, never wedge every
+                # subsequent round on the same key.
+                LOG.warning("unparseable live snapshot %s: %s", key, exc)
+            self.server.discard([key])
+        self.agg.rounds += 1
+        if self.agg.merged():
+            self._append_history()
+            if self.print_digest:
+                print("[live] " + self.agg.digest(self.expected_ranks),
+                      flush=True)
+        return len(docs)
+
+    def _append_history(self) -> None:
+        if not self.history_path:
+            return
+        row = self.agg.history_row(self.expected_ranks)
+        try:
+            d = os.path.dirname(self.history_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # Append + flush per round: every completed round survives a
+            # launcher kill; a torn final line is the reader's problem
+            # (one json.loads failure), never the writer's.
+            with open(self.history_path, "a") as f:
+                f.write(json.dumps(row, separators=(",", ":")) + "\n")
+                f.flush()
+        except OSError as exc:  # pragma: no cover - disk full etc.
+            LOG.warning("live history append failed: %s", exc)
+
+    def stop(self) -> None:
+        """Final round (drain what workers flushed at exit), then stop."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(2.0, self.interval * 2))
+            self._thread = None
+        try:
+            self.round()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        self.server.set_metrics_render(None)

@@ -201,6 +201,21 @@ class MetricsRegistry:
     def histogram(self, name: str, **tags: str) -> Histogram:
         return self._get(Histogram, name, tags)
 
+    def remove_matching(self, prefix: str) -> int:
+        """Drop every instrument whose name starts with ``prefix``
+        (elastic incarnation resets — e.g. straggler attribution must
+        start clean after a rendezvous).  Callers holding handles to a
+        removed instrument keep a detached object; the next registry
+        lookup under the same (name, tags) mints a fresh one."""
+        with self._lock:
+            doomed = [
+                key for key, inst in self._instruments.items()
+                if inst.name.startswith(prefix)
+            ]
+            for key in doomed:
+                del self._instruments[key]
+        return len(doomed)
+
     def register_collector(
         self, fn: Callable[["MetricsRegistry"], None]
     ) -> None:

@@ -16,7 +16,12 @@ from typing import Dict, List, Optional
 
 from . import pathspec
 
-__all__ = ["collect_dumps", "format_summary_table", "summarize"]
+__all__ = [
+    "collect_dumps",
+    "format_summary_table",
+    "straggler_section",
+    "summarize",
+]
 
 
 def _dump_glob(raw: str) -> str:
@@ -96,6 +101,41 @@ def format_summary_table(dumps: Dict[str, dict]) -> str:
             r.ljust(name_w)
             + "".join(f"  {rows[r].get(c, '-'):>{col_w[c]}}" for c in columns)
         )
+    return "\n".join(lines)
+
+
+def straggler_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """The end-of-job straggler verdict: per-rank last-arrival counts
+    (with shares), the skew distribution, and a one-line conclusion
+    naming the lagging rank.  None when no rank recorded attribution
+    (healthy jobs blame nobody).  The merge semantics are the live
+    digest's — one shared implementation, obs/straggler.py
+    merge_blames, so the two can never name different stragglers."""
+    from . import straggler as obs_straggler  # noqa: PLC0415
+
+    verdict = obs_straggler.merge_blames(
+        [doc.get("metrics", []) for doc in dumps.values()]
+    )
+    if verdict is None:
+        return None
+    blames = verdict["blames"]
+    skew = verdict["skew"]
+    total = sum(blames.values())
+    lines = []
+    for rank in sorted(blames, key=lambda r: (-blames[r], r)):
+        share = blames[rank] / total if total else 0.0
+        mark = "  <- likely straggler" if rank == verdict["rank"] else ""
+        lines.append(
+            f"rank {rank}: last to arrive in {blames[rank]} "
+            f"collectives ({share:.0%}){mark}"
+        )
+    if skew["count"]:
+        lines.append(
+            f"arrival skew: n={skew['count']} p50={skew['p50']:.3g}ms "
+            f"p99={skew['p99']:.3g}ms max={skew['max']:.3g}ms"
+        )
+    if verdict["alerts"]:
+        lines.append(f"alerts past --alert-skew-ms: {verdict['alerts']}")
     return "\n".join(lines)
 
 

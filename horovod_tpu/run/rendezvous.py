@@ -69,6 +69,38 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if self.path.rstrip("/") == "/metrics":
+            # Read-only, UNAUTHENTICATED Prometheus exposition of the
+            # live telemetry plane (obs/live.py registers the renderer).
+            # Deliberately outside the HMAC envelope: scrapers are
+            # commodity tools that cannot sign, and the exposition
+            # carries only metric values — never pickles, never the
+            # secret.  Every mutating verb stays signed.
+            render = getattr(self.server, "metrics_render", None)
+            if render is None:
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            try:
+                body = render().encode()
+            except Exception:
+                # A render bug must not kill the server, but it must be
+                # VISIBLE to scrapers: a 200 with an empty body would
+                # read as a healthy target with every series absent.
+                self.send_response(500)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             value = self.server.kv.get(self._key())  # type: ignore[attr-defined]
         if value is None:
@@ -106,11 +138,36 @@ class KVStoreServer:
         self._httpd.kv = {}  # type: ignore[attr-defined]
         self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.secret = self.secret  # type: ignore[attr-defined]
+        self._httpd.metrics_render = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    # -- in-process store access (the live telemetry aggregator) ----------
+    # The HTTP surface deliberately has no listing verb; the launcher-
+    # resident aggregator reads its own store directly instead.
+
+    def scan(self, prefix: str) -> dict:
+        """Snapshot of every key under ``prefix`` -> value."""
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            return {
+                k: v
+                for k, v in self._httpd.kv.items()  # type: ignore[attr-defined]
+                if k.startswith(prefix)
+            }
+
+    def discard(self, keys) -> None:
+        """Drop consumed keys (bounded memory for the streaming scopes)."""
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            for k in keys:
+                self._httpd.kv.pop(k, None)  # type: ignore[attr-defined]
+
+    def set_metrics_render(self, fn) -> None:
+        """Install (or clear, with None) the ``GET /metrics`` renderer —
+        a zero-arg callable returning Prometheus exposition text."""
+        self._httpd.metrics_render = fn  # type: ignore[attr-defined]
 
     def start(self) -> int:
         self._thread = threading.Thread(
@@ -175,9 +232,15 @@ class KVStoreClient:
     def wait(self, scope: str, key: str, timeout: float = 120.0) -> bytes:
         """Poll until published.  Transient transport errors are tolerated
         for a short grace window (server may still be starting), then
-        surfaced with the address."""
+        surfaced with the address.
+
+        Exponential backoff (50 ms doubling to a 1 s cap): long waits —
+        np ranks parked on rendezvous keys, plus the live-stats PUT
+        traffic — must not hammer the launcher's single HTTP server with
+        fixed-rate polls at np=64."""
         deadline = time.time() + timeout
         grace = time.time() + 5.0
+        delay = 0.05
         last_err: Optional[Exception] = None
         while time.time() < deadline:
             try:
@@ -189,7 +252,8 @@ class KVStoreClient:
                 value = None
             if value is not None:
                 return value
-            time.sleep(0.1)
+            time.sleep(min(delay, max(deadline - time.time(), 0.01)))
+            delay = min(delay * 2, 1.0)
         raise TimeoutError(
             f"KV key {scope}/{key} not published at {self._addr} within "
             f"{timeout}s" + (f" (last error: {last_err})" if last_err else "")

@@ -191,6 +191,36 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "into one per-rank summary table on stdout (implies a "
              "temporary --metrics-dump when none is given).",
     )
+    obs_group.add_argument(
+        "--live-stats-secs", type=float, action=_StoreOverrideAction,
+        dest="live_stats_secs", default=None,
+        help="Stream each rank's metrics to the launcher every N "
+             "seconds (default off): one-line console digests, a "
+             "crash-safe live_history.jsonl, and a read-only Prometheus "
+             "GET /metrics endpoint on the launcher's KV port.",
+    )
+    obs_group.add_argument(
+        "--live-port", type=int, action=_StoreOverrideAction,
+        dest="live_port", default=None,
+        help="Fixed port for the live telemetry KV/scrape server in "
+             "non-elastic jobs (default: ephemeral, announced on "
+             "stdout).  Elastic jobs serve /metrics from the existing "
+             "rendezvous port.",
+    )
+    obs_group.add_argument(
+        "--live-history-file", action=_StoreOverrideAction,
+        dest="live_history_file", default=None,
+        help="Where the launcher appends one JSON line per live "
+             "aggregation round (default: ./live_history.jsonl while "
+             "--live-stats-secs is on).",
+    )
+    obs_group.add_argument(
+        "--alert-skew-ms", type=float, action=_StoreOverrideAction,
+        dest="alert_skew_ms", default=None,
+        help="Warn (and count engine.straggler.alerts) when a "
+             "collective's first-to-last rank arrival skew exceeds this "
+             "many milliseconds (default 0 = accumulate silently).",
+    )
 
     stall = parser.add_argument_group("stall check")
     stall.add_argument(
@@ -418,6 +448,92 @@ def build_slot_env(
     return env
 
 
+def _maybe_start_live_plane(
+    base_env: Dict[str, str],
+    np: int,
+    *,
+    kv_server=None,
+    kv_addr: Optional[str] = None,
+    live_stats_secs: Optional[float] = None,
+    live_port: Optional[int] = None,
+    live_history: Optional[str] = None,
+    bind_all: bool = False,
+    announce_host: Optional[str] = None,
+):
+    """Start the launcher half of the live telemetry plane when
+    ``--live-stats-secs`` (or the env) enables it; returns
+    ``(LivePlane, owned_server)`` or ``(None, None)``.
+
+    The interval resolves from ``base_env`` — the SAME source the
+    spawned workers read — never from the launcher's own os.environ: an
+    env-dict override must arm both halves or neither (workers
+    streaming into a store nobody drains would grow launcher memory
+    unboundedly).
+
+    MUTATES ``base_env`` — the KV endpoint, interval and per-job secret
+    must be in place before any worker spawns.  Non-elastic jobs get a
+    dedicated KV server here (their only launcher-side socket); elastic
+    jobs pass their existing rendezvous server + already-routable
+    address, and /metrics shares its port.  ``announce_host``: the
+    launcher address remote scrapers (and remote workers) should dial;
+    default loopback for all-local jobs."""
+    try:
+        interval = (
+            float(live_stats_secs)
+            if live_stats_secs is not None
+            else float(base_env.get(envmod.LIVE_STATS) or 0.0)
+        )
+    except ValueError:
+        raise ValueError(
+            f"{envmod.LIVE_STATS} must be a number of seconds; got "
+            f"{base_env.get(envmod.LIVE_STATS)!r}"
+        )
+    if interval <= 0:
+        return None, None
+    from ..obs.live import LivePlane  # noqa: PLC0415
+    from .rendezvous import KVStoreServer, SECRET_ENV  # noqa: PLC0415
+
+    owned = None
+    if kv_server is None:
+        owned = kv_server = KVStoreServer(
+            port=int(live_port or 0),
+            secret=base_env.get(SECRET_ENV) or None,
+            bind_all=bind_all,
+        )
+        kv_server.start()
+    host = (announce_host
+            or (kv_addr.rsplit(":", 1)[0] if kv_addr else None)
+            or "127.0.0.1")
+    base_env[SECRET_ENV] = kv_server.secret
+    base_env[envmod.LIVE_KV] = kv_addr or f"{host}:{kv_server.port}"
+    base_env[envmod.LIVE_STATS] = str(interval)
+    plane = LivePlane(
+        kv_server,
+        interval=interval,
+        history_path=live_history or "live_history.jsonl",
+        expected_ranks=np,
+        announce_host=host,
+    )
+    plane.start()
+    return plane, owned
+
+
+def _stop_live_plane(plane, owned_server) -> None:
+    """Tear down best-effort: a telemetry failure must never turn a
+    finished job into an error."""
+    if plane is None:
+        return
+    try:
+        plane.stop()
+    except Exception:  # pragma: no cover - defensive
+        pass
+    if owned_server is not None:
+        try:
+            owned_server.stop()
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+
 def launch_job(
     command: List[str],
     np: int,
@@ -431,13 +547,20 @@ def launch_job(
     coordinator_port: Optional[int] = None,
     tag_output: bool = True,
     output_filename: Optional[str] = None,
+    live_stats_secs: Optional[float] = None,
+    live_port: Optional[int] = None,
+    live_history: Optional[str] = None,
 ) -> Dict[int, int]:
     """Allocate slots, spawn workers, wait for completion (reference
     gloo_run.launch_gloo, gloo_run.py:237-304).
 
     ``start_timeout`` bounds world formation (exported as
     HVDTPU_START_TIMEOUT, enforced by each rank's jax.distributed init);
-    ``job_timeout`` is a whole-job watchdog — unset means run forever."""
+    ``job_timeout`` is a whole-job watchdog — unset means run forever.
+    ``live_stats_secs`` (or ``HVDTPU_LIVE_STATS_SECS``) turns on the
+    live telemetry plane: per-rank metric streaming into a launcher KV
+    server, console digests, ``live_history.jsonl``, and a Prometheus
+    ``GET /metrics`` scrape endpoint."""
     host_slots = _resolve_host_slots(hosts, hostfile, f"localhost:{np}")
     slots = allocate(host_slots, np)
 
@@ -461,6 +584,30 @@ def launch_job(
     if output_filename:
         os.makedirs(output_filename, exist_ok=True)
 
+    # Live telemetry before any spawn: workers read the KV endpoint and
+    # interval from their spawn env.  The dedicated server binds beyond
+    # loopback only when some worker is remote, and both the worker env
+    # and the announced scrape endpoint then carry the launcher's
+    # routable address instead of loopback.
+    all_local = all(is_local_host(s.hostname) for s in slots)
+    live_announce = None
+    if not all_local and (
+        live_stats_secs or base_env.get(envmod.LIVE_STATS)
+    ):
+        from .allocate import routable_ip  # noqa: PLC0415
+
+        probe = next(
+            (s.hostname for s in slots if not is_local_host(s.hostname)),
+            "127.0.0.1",
+        )
+        live_announce = routable_ip(probe)
+    live_plane, live_server = _maybe_start_live_plane(
+        base_env, np,
+        live_stats_secs=live_stats_secs, live_port=live_port,
+        live_history=live_history, bind_all=not all_local,
+        announce_host=live_announce,
+    )
+
     procs = ProcessSet()
     procs.install_signal_handlers()
     _clean_stale_obs_files(base_env)
@@ -475,7 +622,9 @@ def launch_job(
         return procs.wait(timeout=job_timeout)
     finally:
         # Failed jobs merge too — a partial trace of a dead job is the
-        # most valuable trace there is.
+        # most valuable trace there is.  The live plane drains its final
+        # round (workers flush at exit) before the server goes away.
+        _stop_live_plane(live_plane, live_server)
         _merge_rank_timelines(base_env)
 
 
@@ -592,6 +741,8 @@ def launch_elastic_job(
     kv_server=None,
     tag_output: bool = True,
     output_filename: Optional[str] = None,
+    live_stats_secs: Optional[float] = None,
+    live_history: Optional[str] = None,
 ) -> ElasticJobResult:
     """Elastic counterpart of :func:`launch_job`: per-rank failure
     detection (exit code + KV heartbeat + collective-path progress
@@ -665,6 +816,13 @@ def launch_elastic_job(
     base_env["HVDTPU_ELASTIC_KV"] = kv_addr
     if output_filename:
         os.makedirs(output_filename, exist_ok=True)
+
+    # Live telemetry rides the rendezvous store: snapshots travel the
+    # same signed PUT path as heartbeats, and /metrics shares the port.
+    live_plane, _ = _maybe_start_live_plane(
+        base_env, np, kv_server=kv_server, kv_addr=kv_addr,
+        live_stats_secs=live_stats_secs, live_history=live_history,
+    )
 
     from ..obs import get_registry  # noqa: PLC0415
     from ..obs.progress import ProgressPolicy  # noqa: PLC0415
@@ -897,6 +1055,8 @@ def launch_elastic_job(
         procs.terminate()
         raise
     finally:
+        # Drain the final live round while the store is still up.
+        _stop_live_plane(live_plane, None)
         if owns_server:
             kv_server.stop()
         # All-rank trace merge, dead incarnations included: the
@@ -986,6 +1146,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     else args.progress_grace_secs
                 ),
                 output_filename=args.output_filename,
+                live_stats_secs=getattr(args, "live_stats_secs", None),
+                live_history=getattr(args, "live_history_file", None),
             )
             return 0
         launch_job(
@@ -998,6 +1160,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             start_timeout=args.start_timeout,
             coordinator_port=args.coordinator_port,
             output_filename=args.output_filename,
+            live_stats_secs=getattr(args, "live_stats_secs", None),
+            live_port=getattr(args, "live_port", None),
+            live_history=getattr(args, "live_history_file", None),
         )
         return 0
     except (RuntimeError, ValueError, TimeoutError, OSError) as exc:
@@ -1024,10 +1189,14 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
         return
     from ..obs import summary as obs_summary  # noqa: PLC0415
 
-    table = obs_summary.summarize(raw)
-    if table is None:
+    dumps = obs_summary.collect_dumps(raw)
+    if not dumps:
         print("hvdrun: --stats-summary: no metrics dumps found "
               f"under {raw!r}", file=sys.stderr)
         return
     print("\n== per-rank metrics summary ==")
-    print(table)
+    print(obs_summary.format_summary_table(dumps))
+    straggler = obs_summary.straggler_section(dumps)
+    if straggler is not None:
+        print("\n== straggler attribution ==")
+        print(straggler)

@@ -41,6 +41,9 @@ class _TableEntry:
     requests: Dict[int, Request] = field(default_factory=dict)
     first_seen: float = field(default_factory=time.monotonic)
     arrival_order: int = 0
+    # rank -> (negotiation cycle index, monotonic time) of its FIRST
+    # request for this entry — the raw data straggler attribution reads.
+    arrivals: Dict[int, Tuple[int, float]] = field(default_factory=dict)
 
 
 @dataclass
@@ -50,6 +53,10 @@ class ControllerState:
     joined_ranks: Set[int] = field(default_factory=set)
     shutdown_ranks: Set[int] = field(default_factory=set)
     arrival_counter: int = 0
+    # Monotonic negotiation-cycle counter: arrival skew is attributed in
+    # cycles (identical on every rank — wall clocks are not), and only
+    # ops spanning >1 cycle blame anyone.
+    cycle_index: int = 0
     # stall bookkeeping (reference stall_inspector.cc)
     last_stall_check: float = field(default_factory=time.monotonic)
 
@@ -124,6 +131,7 @@ def compute_responses(
     fusion_threshold_bytes: int,
     stall_warning_secs: float = 60.0,
     stall_shutdown_secs: float = 0.0,
+    alert_skew_ms: float = 0.0,
     timeline=None,
     cache=None,
 ) -> Tuple[List[Response], bool]:
@@ -135,6 +143,8 @@ def compute_responses(
     invariant the whole eager path rests on (the reference gets it by
     construction from the rank-0 broadcast; we get it from determinism).
     """
+    state.cycle_index += 1
+    cycle_now = time.monotonic()
     # Absorb joins & shutdowns first (reference controller.cc:219-221,256-259).
     for rank, rlist in enumerate(all_lists):
         if rlist.shutdown:
@@ -157,6 +167,9 @@ def compute_responses(
                     )
             if timeline is not None:
                 timeline.negotiate_rank_ready(req.tensor_name, req.request_rank)
+            entry.arrivals.setdefault(
+                req.request_rank, (state.cycle_index, cycle_now)
+            )
             entry.requests[req.request_rank] = req
 
     needed = state.world_size - len(state.joined_ranks)
@@ -173,6 +186,7 @@ def compute_responses(
     for key, entry in ready:
         del state.message_table[key]
         name, rtype = key
+        _attribute_straggler(entry, name, alert_skew_ms, timeline)
         err = _validate(entry.requests)
         if timeline is not None:
             timeline.negotiate_end(name, rtype.name)
@@ -254,6 +268,42 @@ def compute_responses(
 
     should_shutdown = len(state.shutdown_ranks) > 0
     return responses, should_shutdown
+
+
+def _attribute_straggler(
+    entry: _TableEntry, name: str, alert_skew_ms: float, timeline
+) -> None:
+    """Straggler attribution for one completed negotiation: the rank
+    whose request arrived LAST, and the first-to-last arrival skew.
+
+    Attribution fires only when the arrivals spanned more than one
+    negotiation cycle — within a single cycle, "last" is an artifact of
+    request-list ordering and every op would smear blame randomly.  The
+    inputs (cycle indices, absorption order) are identical on every
+    rank, so all ranks accumulate the identical attribution — the
+    ``--stats-summary`` straggler section and the live digest agree no
+    matter whose snapshot they read.  Wall-clock skew is this rank's
+    local measurement of the same cycles (sub-cycle noise, cross-rank
+    consistent to within a cycle time)."""
+    if len(entry.arrivals) < 2:
+        return
+    items = sorted(
+        enumerate(entry.arrivals.items()),
+        key=lambda pair: (pair[1][1][0], pair[0]),
+    )
+    _, (first_rank, (first_cycle, first_t)) = items[0]
+    _, (last_rank, (last_cycle, last_t)) = items[-1]
+    if last_cycle <= first_cycle:
+        return  # same-cycle completion: nobody kept anybody waiting
+    from ..obs import straggler as obs_straggler  # noqa: PLC0415
+
+    obs_straggler.record(
+        last_rank,
+        (last_t - first_t) * 1e3,
+        tensor=name,
+        timeline=timeline,
+        alert_ms=alert_skew_ms,
+    )
 
 
 def _fuse(

@@ -148,6 +148,9 @@ class EagerEngine:
         self.stall_shutdown = envmod.env_float(envmod.STALL_SHUTDOWN_TIME, 0.0)
         if envmod.env_bool(envmod.STALL_CHECK_DISABLE):
             self.stall_warn = float("inf")
+        # Straggler-attribution warning threshold (--alert-skew-ms);
+        # 0 accumulates engine.straggler.* silently.
+        self.alert_skew_ms = envmod.env_float(envmod.ALERT_SKEW, 0.0)
         self.timeline = timeline_mod.from_env(self.rank)
 
         self._lock = threading.Lock()
@@ -561,6 +564,7 @@ class EagerEngine:
             fusion_threshold_bytes=self.fusion_bytes,
             stall_warning_secs=self.stall_warn,
             stall_shutdown_secs=self.stall_shutdown,
+            alert_skew_ms=self.alert_skew_ms,
             timeline=self.timeline,
             cache=self._cache,
         )

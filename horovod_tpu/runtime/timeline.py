@@ -121,6 +121,12 @@ class Timeline:
         if self._mark_cycles:
             self._emit("i", "CYCLE_START", "cycle", s="g")
 
+    def counter(self, name: str, values: dict):
+        """Chrome-trace counter event ("ph": "C"): a numeric series over
+        time — used by straggler attribution to plot arrival skew per
+        collective alongside the op lanes."""
+        self._emit("C", name, "counter", tid="counters", args=dict(values))
+
     # -- writer ------------------------------------------------------------
     def _write_loop(self):
         """Streaming-tolerant writer: every event line ends with a comma

@@ -36,7 +36,10 @@ Grammar::
   ``exit`` calls ``os._exit(code)``; ``hang`` blocks the calling thread
   forever (daemon threads — heartbeats — keep running: the exact
   signature of a deadlocked training thread, which is what the
-  progress-beat staleness policy exists to catch).
+  progress-beat staleness policy exists to catch);
+  ``delay:<ms>`` sleeps the calling thread for that many milliseconds
+  and then CONTINUES (default 1000) — a deterministic straggler, the
+  chaos input the live telemetry plane's attribution is tested against.
   ``worker_exit``/``task_fn`` points default to ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
@@ -76,6 +79,7 @@ class FaultSpec:
     count: int = 1
     action: str = "raise"
     code: int = DEFAULT_EXIT_CODE
+    delay_ms: int = 1000
     name: Optional[str] = None
     fired: int = field(default=0, compare=False)
 
@@ -105,16 +109,22 @@ def parse_spec(raw: str) -> List[FaultSpec]:
             spec.action = "exit"
         for kv in fields[1:]:
             if "=" not in kv:
+                # ``action=delay:<ms>``: the milliseconds ride as a bare
+                # numeric field right after the action (the grammar's
+                # separator is ":", so they can't live in the value).
+                if spec.action == "delay" and kv.strip().isdigit():
+                    spec.delay_ms = int(kv.strip())
+                    continue
                 raise ValueError(
                     f"fault spec field {kv!r} in {chunk!r} is not key=value"
                 )
             key, value = (s.strip() for s in kv.split("=", 1))
-            if key in ("rank", "step", "count", "code"):
+            if key in ("rank", "step", "count", "code", "delay_ms"):
                 setattr(spec, key, int(value))
             elif key == "epoch":
                 spec.epoch = None if value in ("any", "*") else int(value)
             elif key == "action":
-                if value not in ("raise", "exit", "hang"):
+                if value not in ("raise", "exit", "hang", "delay"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -206,6 +216,14 @@ def maybe_fail(
         if spec.name is not None and spec.name != name:
             continue
         spec.fired += 1
+        if spec.action == "delay":
+            # A deterministic straggler: stall the calling thread, then
+            # proceed normally — the collective completes late, which is
+            # exactly the skew signature straggler attribution must name.
+            import time  # noqa: PLC0415
+
+            time.sleep(spec.delay_ms / 1000.0)
+            return
         if spec.action == "exit":
             # os._exit, not sys.exit: the injected death must look like a
             # hard crash (no atexit, no finally blocks posting results).

@@ -35,6 +35,17 @@ EAGER_DEVICE = "HVDTPU_EAGER_DEVICE"
 # Per-rank metrics dump target (obs/registry.py); a dir, a {rank}
 # template, or a plain path that gets a rank tag inserted.
 METRICS_DUMP = "HVDTPU_METRICS_DUMP"
+# Live telemetry plane (obs/stream.py + obs/live.py): per-rank metric
+# snapshot period in seconds (<= 0 or unset disables streaming) and the
+# launcher KV endpoint the snapshots are published to over the
+# HMAC-signed PUT path (falls back to HVDTPU_ELASTIC_KV under the
+# elastic launcher, which reuses its rendezvous store).
+LIVE_STATS = "HVDTPU_LIVE_STATS_SECS"
+LIVE_KV = "HVDTPU_LIVE_KV"
+# Straggler attribution alert threshold in milliseconds: a collective
+# whose first-to-last arrival skew exceeds this warns and counts an
+# engine.straggler.alerts event (0/unset = record silently).
+ALERT_SKEW = "HVDTPU_ALERT_SKEW_MS"
 
 
 def resolve_rank(default=None):
