@@ -22,7 +22,8 @@ if [ "${1:-full}" = "quick" ]; then
         "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks" \
         -x -q
     echo "== quick tier: observability plane =="
-    python -m pytest tests/test_obs.py tests/test_obs_live.py -x -q
+    python -m pytest tests/test_obs.py tests/test_obs_live.py \
+        tests/test_postmortem.py -x -q
     echo "== quick tier: unit + multiprocess suite minus -m full =="
     # test_elastic.py / test_obs*.py and the injection case already ran
     # above — don't pay for the multiprocess chaos cases twice per commit.
@@ -30,6 +31,7 @@ if [ "${1:-full}" = "quick" ]; then
         --ignore=tests/test_elastic.py \
         --ignore=tests/test_obs.py \
         --ignore=tests/test_obs_live.py \
+        --ignore=tests/test_postmortem.py \
         --deselect "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks"
     exit 0
 fi
@@ -193,6 +195,68 @@ JAX_PLATFORMS=cpu \
 PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
     python "$LIVE_TMP/scrape.py" "$LIVE_TMP"
 rm -rf "$LIVE_TMP"
+
+# Post-mortem gate (ISSUE 4): a 2-proc job crashed with action=abort on
+# rank 1 must leave per-rank flight-recorder dumps and a launcher-written
+# postmortem.json that is schema-valid and blames the injected rank; the
+# clean-run path must write NO postmortem.  /healthz is probed instead of
+# sleeping before the crash run starts (satellite: KVStoreServer liveness).
+echo "== postmortem gate: crashed job leaves a black box + verdict =="
+PM_TMP=$(mktemp -d)
+cat > "$PM_TMP/worker.py" <<'EOF'
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+for i in range(8):
+    hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name=f"t{i}")
+hvd.shutdown()
+EOF
+python - <<'EOF'
+# healthz probe: a fresh KV server must answer before any job leans on it
+import json, urllib.request
+from horovod_tpu.run.rendezvous import KVStoreServer
+s = KVStoreServer(); s.start()
+doc = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{s.port}/healthz", timeout=5).read())
+assert doc["status"] == "ok", doc
+s.stop()
+print("healthz OK")
+EOF
+mkdir -p "$PM_TMP/bb"
+if JAX_PLATFORMS=cpu \
+   PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+   HVDTPU_FAULT_SPEC="enqueue:rank=1:step=4:action=abort" \
+       python -m horovod_tpu.run -np 2 --flightrec-dump "$PM_TMP/bb" \
+       python "$PM_TMP/worker.py"; then
+    echo "postmortem gate FAILED: crashed job reported success" >&2
+    exit 1
+fi
+python - "$PM_TMP/bb" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+dumps = glob.glob(f"{d}/flightrec.*rank*.json")
+assert len(dumps) == 2, f"expected 2 per-rank black boxes, got {dumps}"
+report = json.load(open(f"{d}/postmortem.json"))
+assert report["schema"] == "hvdtpu-postmortem-v1", report["schema"]
+ff = report["first_failure"]
+assert ff["rank"] == 1, f"verdict blamed {ff['rank']}, injected rank 1"
+assert ff["trigger"] == "signal:SIGABRT", ff
+assert ff["last_collective"] == "t2", ff
+assert "ank 1" in report["verdict"], report["verdict"]
+print("postmortem gate OK:", report["verdict"])
+EOF
+echo "== postmortem gate: clean run writes no postmortem =="
+mkdir -p "$PM_TMP/clean"
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m horovod_tpu.run -np 2 --flightrec-dump "$PM_TMP/clean" \
+    python "$PM_TMP/worker.py"
+if [ -e "$PM_TMP/clean/postmortem.json" ]; then
+    echo "postmortem gate FAILED: clean run wrote a postmortem" >&2
+    exit 1
+fi
+rm -rf "$PM_TMP"
 
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must

@@ -207,6 +207,17 @@ def init(comm=None) -> Topology:
     get_registry().gauge("process.rank").set(
         _topology.process_rank
     )
+    # Black box: arm the flight recorder's death-path hooks (excepthook,
+    # threading.excepthook, SIGTERM/SIGABRT/SIGUSR1) so a rank killed by
+    # a signal — including the launcher's own escalation — still flushes
+    # its event ring, the metrics dump and the final live delta.
+    from .obs import flightrec as _flightrec  # noqa: PLC0415
+
+    _flightrec.install_death_hooks()
+    _flightrec.record(
+        "init", name=f"rank{_topology.process_rank}",
+        detail=f"world={_topology.process_count}",
+    )
     # Live telemetry streaming (obs/stream.py): a no-op unless the
     # launcher exported HVDTPU_LIVE_STATS_SECS + a KV endpoint.
     from .obs import stream as _obs_stream  # noqa: PLC0415

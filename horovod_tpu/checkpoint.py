@@ -267,10 +267,17 @@ class AsyncSave:
         metrics.histogram("checkpoint.commit_wait_ms").observe(
             (time.monotonic() - t_wait) * 1e3
         )
+        from .obs import flightrec as _flightrec  # noqa: PLC0415
+
         if self._error is not None:
             metrics.counter("checkpoint.save_errors").inc()
+            _flightrec.record(
+                "ckpt.error", name=os.path.basename(self.path),
+                detail=str(self._error)[:200],
+            )
             raise self._error
         metrics.counter("checkpoint.saves_committed").inc()
+        _flightrec.record("ckpt.commit", name=os.path.basename(self.path))
         return self.path
 
 
@@ -295,6 +302,12 @@ def save_checkpoint_async(
         raise ValueError(f"keep must be >= 1, got {keep}")
     path = _step_dir(directory, step)
     get_registry().counter("checkpoint.saves_started").inc()
+    # Flight recorder: a rank that dies between begin and commit leaves
+    # the half-open pair in its ring — the post-mortem's proof the death
+    # landed inside checkpoint I/O.
+    from .obs import flightrec as _flightrec  # noqa: PLC0415
+
+    _flightrec.record("ckpt.begin", name=f"step{step}", cycle=step)
     if rank() != 0:
         return AsyncSave(path)
     try:

@@ -45,6 +45,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..obs import get_registry
+from ..obs import flightrec as obs_flightrec
 from ..obs import progress as obs_progress
 from ..obs import straggler as obs_straggler
 from ..run.rendezvous import KVStoreClient
@@ -171,6 +172,7 @@ class ElasticContext:
         against them silently diverges from peers.  Recovery only
         proceeds once the launcher mints a fresh epoch; a rank that
         never sees one times out, exits, and is respawned into one."""
+        obs_flightrec.record("world_broken", cycle=self.epoch)
         self._min_epoch = self.epoch + 1
 
     def rendezvous(self, timeout: Optional[float] = None) -> int:
@@ -234,6 +236,10 @@ class ElasticContext:
             # was respawned) must not leak into the new epoch's verdict.
             obs_straggler.reset()
             get_registry().counter("elastic.rendezvous").inc()
+            obs_flightrec.record(
+                "rendezvous", name=f"epoch{e}", cycle=e,
+                detail=f"world={world}",
+            )
             LOG.info("rank %d joined epoch %d world %s",
                      self.rank, e, world)
             return e
@@ -253,6 +259,13 @@ class ElasticContext:
         # fires no peer can have completed the step (ISSUE acceptance:
         # recovery resumes from the last commit on every rank).
         maybe_fail("worker_exit", step=self._seq, rank=self.rank)
+        # Flight recorder, KV-collective flavor: the per-epoch sequence
+        # number is this path's "cycle" — identical on every member, so
+        # the post-mortem aligns elastic rings the same way it aligns
+        # engine rings.
+        obs_flightrec.record(
+            "enqueue", name=name, cycle=self._seq, detail="kv_allreduce",
+        )
         arr = np.asarray(value)
         scope = _epoch_scope(self.epoch)
         self.kv.put(scope, f"ar_{name}_{self.rank}", pickle.dumps(arr))
@@ -283,6 +296,9 @@ class ElasticContext:
             total = (total / len(parts)).astype(arr.dtype)
         # Progress beat source for the elastic path: the collective
         # completed with every member's contribution in hand.
+        obs_flightrec.record(
+            "complete", name=name, cycle=self._seq, detail="kv_allreduce",
+        )
         obs_progress.tick()
         get_registry().counter("elastic.kv_collectives").inc()
         return total
@@ -293,6 +309,10 @@ class ElasticContext:
         A freshly respawned rank (commit count 0) therefore always
         adopts a survivor's state, and a full fresh start converges on
         rank 0's initial values."""
+        obs_flightrec.record(
+            "sync_state", name=f"epoch{self.epoch}", cycle=self.epoch,
+            detail=f"commits={int(commit_count)}",
+        )
         scope = _epoch_scope(self.epoch)
         self.kv.put(scope, f"have_{self.rank}",
                     pickle.dumps(int(commit_count)))

@@ -43,6 +43,7 @@ def launch(
     progress_timeout: float = 300.0,
     progress_grace: float = 0.0,
     blacklist_cooldown: float = 10.0,
+    dump_grace_secs: float = 5.0,
     timeout: Optional[float] = None,
     live_stats_secs: Optional[float] = None,
     live_history: Optional[str] = None,
@@ -78,6 +79,7 @@ def launch(
             progress_timeout=progress_timeout,
             progress_grace=progress_grace,
             blacklist_cooldown=blacklist_cooldown,
+            dump_grace_secs=dump_grace_secs,
             job_timeout=timeout,
             kv_server=server,
             live_stats_secs=live_stats_secs,

@@ -25,6 +25,11 @@ _SCOPE = "elastic"
 
 
 def main() -> int:
+    # Death-path hooks FIRST (main thread — signal handlers need it):
+    # everything after this point leaves a black box if it dies.
+    from ..obs import flightrec
+
+    flightrec.install_death_hooks()
     ctx = _set_ambient()
     if not isinstance(ctx, ElasticContext):  # pragma: no cover - misuse
         raise RuntimeError(
@@ -35,22 +40,27 @@ def main() -> int:
     maybe_fail("task_fn", rank=ctx.rank)
     blob = ctx.kv.wait(_SCOPE, "func", timeout=60)
     func, args, kwargs = cloudpickle.loads(blob)
+    flush_trigger = "explicit"
     try:
         result = func(*args, **kwargs)
         ctx.kv.put(_SCOPE, f"result_{ctx.rank}",
                    cloudpickle.dumps((True, result)))
         return 0
-    except HorovodShutdownError:
+    except HorovodShutdownError as exc:
         # World breakage that outlived the elastic retry budget (or a
         # rank the launcher dropped) is an infrastructure failure, not a
         # user error: exit like a crash, WITHOUT posting a traceback, so
         # the launcher's monitor respawns/shrinks instead of aborting
         # the whole job on a "user error".
+        flightrec.record_exception(exc, where="elastic.worker")
+        flush_trigger = "exception"
         return 1
-    except BaseException:
+    except BaseException as exc:
         # Epoch-qualified so the launcher attributes the failure to THIS
         # incarnation of the rank, not a successor already respawned
         # into a later epoch.
+        flightrec.record_exception(exc, where="elastic.worker")
+        flush_trigger = "exception"
         ctx.kv.put(
             _SCOPE,
             f"error_{ctx.rank}_{ctx.epoch}",
@@ -59,12 +69,12 @@ def main() -> int:
         return 1
     finally:
         ctx.stop_heartbeat()
-        # Explicit dump (atexit also fires on clean exits, but not after
-        # an os._exit-style death — dump what we can while we can).
-        from ..obs import dump_metrics
-
+        # Explicit flush (atexit also fires on clean exits, but not
+        # after an os._exit-style death — dump what we can while we
+        # can): ring + metrics + final live delta through the one
+        # shared death path.
         try:
-            dump_metrics()
+            flightrec.flush(flush_trigger)
         except Exception:
             pass
 

@@ -24,10 +24,16 @@ quantitative):
   last at collectives, accumulated as ``engine.straggler.*`` metrics
   from both collective paths, surfaced in the live digest and the
   ``--stats-summary`` straggler section.
+* **flight recorder** (obs/flightrec.py) + **post-mortem**
+  (obs/postmortem.py) — an always-on bounded per-rank event ring
+  flushed on every death path (signals, excepthooks, exit), and the
+  launcher-side analyzer that correlates all ranks' rings into a
+  root-cause verdict when the job dies.
 
-See docs/observability.md.
+See docs/observability.md and docs/postmortem.md.
 """
 
+from . import flightrec  # noqa: F401
 from . import progress  # noqa: F401
 from . import straggler  # noqa: F401
 from . import stream  # noqa: F401
@@ -43,6 +49,8 @@ from .registry import (  # noqa: F401
 )
 
 set_phase = progress.set_phase
+dump_flight_recorder = flightrec.dump_flight_recorder
+install_death_hooks = flightrec.install_death_hooks
 
 __all__ = [
     "Counter",
@@ -53,6 +61,9 @@ __all__ = [
     "get_registry",
     "reset_registry",
     "dump_metrics",
+    "dump_flight_recorder",
+    "install_death_hooks",
+    "flightrec",
     "progress",
     "straggler",
     "stream",

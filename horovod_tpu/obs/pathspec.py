@@ -19,11 +19,43 @@ the evidence of why it died.
 
 from __future__ import annotations
 
+import itertools
+import json
 import os
 import re
 from typing import Optional
 
-__all__ = ["resolve", "glob_pattern", "rank_of_path", "epoch_of_path"]
+__all__ = ["resolve", "glob_pattern", "rank_of_path", "epoch_of_path",
+           "write_json_atomic"]
+
+# Per-call uniquifier for tmp names: pid alone is not enough — a
+# signal-handler flush may reentrantly interrupt an in-progress dump on
+# the SAME thread (the flight recorder's death path is built for
+# exactly that), and two writers sharing one tmp path would tear the
+# final document.  itertools.count().__next__ is atomic under the GIL.
+_tmp_seq = itertools.count()
+
+
+def write_json_atomic(path: str, doc, *, indent: int = 1) -> str:
+    """The one atomic JSON write every obs artifact uses (metrics dump,
+    flight-recorder dump, post-mortem report, merged timeline):
+    tmp-file + ``os.replace`` so a reader — or a crash mid-write —
+    never sees a torn document.  Returns ``path``."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_seq)}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=indent)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 _RANK_RE = re.compile(r"(?:^|[^0-9a-zA-Z])rank[._]?(\d+)")
 _EPOCH_RE = re.compile(r"\.e(\d+)\.")

@@ -82,11 +82,20 @@ def tick(n: int = 1, *, to_steady: bool = True) -> int:
     init/compile grace before the user's first step (whose jit compile
     may legitimately outlast the steady budget) has even started."""
     global _count, _phase
+    changed = None
     with _lock:
         _count += n
-        if to_steady:
+        if to_steady and _phase != PHASE_STEADY:
+            changed = _phase
             _phase = PHASE_STEADY
-        return _count
+        count = _count
+    if changed is not None:
+        # Phase transitions are rare (once per phase), so the flight-
+        # recorder event costs nothing on the per-tick hot path.
+        from . import flightrec  # noqa: PLC0415
+
+        flightrec.record("phase", name=PHASE_STEADY, detail=changed)
+    return count
 
 
 def value() -> int:
@@ -109,7 +118,11 @@ def set_phase(name: str) -> None:
             f"{(PHASE_INIT, PHASE_COMPILE, PHASE_STEADY)}"
         )
     with _lock:
-        _phase = name
+        prev, _phase = _phase, name
+    if prev != name:
+        from . import flightrec  # noqa: PLC0415
+
+        flightrec.record("phase", name=name, detail=prev)
 
 
 def reset() -> None:

@@ -21,8 +21,6 @@ is fine for monitoring data.
 
 from __future__ import annotations
 
-import atexit
-import json
 import os
 import threading
 import time
@@ -259,13 +257,9 @@ class MetricsRegistry:
             "wall_time": time.time(),
             "metrics": self.snapshot(),
         }
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-        os.replace(tmp, path)
+        from . import pathspec  # noqa: PLC0415
+
+        pathspec.write_json_atomic(path, doc)
         return doc
 
 
@@ -277,9 +271,9 @@ _atexit_installed = False
 
 
 def _resolve_rank() -> str:
-    from ..utils.env import resolve_rank  # noqa: PLC0415
+    from ..utils.env import artifact_rank  # noqa: PLC0415
 
-    return str(resolve_rank(0))
+    return artifact_rank()
 
 
 def resolve_dump_path(raw: str, rank: Optional[str] = None) -> str:
@@ -313,14 +307,20 @@ def _atexit_dump() -> None:
 
 def get_registry() -> MetricsRegistry:
     """The process-global registry.  First use arms the exit dump (a
-    no-op unless ``HVDTPU_METRICS_DUMP`` is set at exit time)."""
+    no-op unless ``HVDTPU_METRICS_DUMP`` is set at dump time) — routed
+    through the shared death-path flush (obs/flightrec.py), so it fires
+    not just at clean exit but on every catchable death: excepthooks
+    and fatal signals included.  A signal-killed rank leaves its
+    metrics dump alongside its flight-recorder ring."""
     global _registry, _atexit_installed
     if _registry is None:
         with _registry_lock:
             if _registry is None:
                 _registry = MetricsRegistry()
                 if not _atexit_installed:
-                    atexit.register(_atexit_dump)
+                    from .flightrec import on_death  # noqa: PLC0415
+
+                    on_death(_atexit_dump)
                     _atexit_installed = True
     return _registry
 

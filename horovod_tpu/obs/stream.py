@@ -209,7 +209,11 @@ class StreamPublisher:
 
 
 _current: Optional[StreamPublisher] = None
-_current_lock = threading.Lock()
+# Reentrant: _death_flush runs on the fatal-signal path, and a second
+# signal interrupting the owning thread inside this lock (the
+# launcher's SIGUSR1-then-SIGTERM escalation) must not self-deadlock
+# the dying rank — same rationale as flightrec.py's module locks.
+_current_lock = threading.RLock()
 _atexit_installed = False
 
 
@@ -246,17 +250,33 @@ def maybe_start_from_env() -> Optional[StreamPublisher]:
         )
         pub.start()
         if not _atexit_installed:
-            # Exit flush: stop_stream -> StreamPublisher.stop publishes
-            # the final partial interval.  Registered after the registry's
-            # dump hook, so (atexit LIFO) it runs BEFORE the process's
-            # metrics dump tears anything down.
-            import atexit  # noqa: PLC0415
+            # Exit flush: publish the final partial interval.  Routed
+            # through the shared death-path flush (obs/flightrec.py) so
+            # the final live delta also survives excepthook/signal
+            # deaths; registered after the registry's dump hook, so
+            # (LIFO) it runs BEFORE the process's metrics dump.
+            # Deliberately non-destructive — a dump-only flush (the
+            # SIGUSR1 black-box request) happens MID-RUN and must not
+            # stop the publisher; at real exit the daemon thread dies
+            # with the process and the delta published here is the
+            # flush that matters.
+            from .flightrec import on_death  # noqa: PLC0415
 
-            atexit.register(stop_stream)
+            on_death(_death_flush)
             _atexit_installed = True
         LOG.debug("live stats streaming to %s every %.2fs", addr, interval)
         _current = pub
         return pub
+
+
+def _death_flush() -> None:
+    """Publish the current delta without tearing the publisher down
+    (the shared death-path flush runs this on every flush trigger,
+    including mid-run dump-only ones)."""
+    with _current_lock:
+        pub = _current
+    if pub is not None:
+        pub.publish_once()
 
 
 def stop_stream() -> None:

@@ -104,13 +104,7 @@ def merge(paths: Sequence[str], out_path: str) -> int:
          "args": {"name": label}}
         for lane, label in sorted(set(lanes))
     ]
-    d = os.path.dirname(out_path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    tmp = f"{out_path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(meta + merged, f)
-    os.replace(tmp, out_path)
+    pathspec.write_json_atomic(out_path, meta + merged, indent=None)
     return len(merged)
 
 

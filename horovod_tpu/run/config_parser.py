@@ -27,6 +27,7 @@ _ARG_ENV_MAP = {
     "timeline_filename": (envmod.TIMELINE, "timeline.filename"),
     "timeline_mark_cycles": (envmod.TIMELINE_MARK_CYCLES, "timeline.mark-cycles"),
     "metrics_dump": (envmod.METRICS_DUMP, "metrics.dump"),
+    "flightrec_dump": (envmod.FLIGHTREC_DUMP, "metrics.flightrec-dump"),
     "live_stats_secs": (envmod.LIVE_STATS, "metrics.live-stats-secs"),
     "alert_skew_ms": (envmod.ALERT_SKEW, "metrics.alert-skew-ms"),
     "no_stall_check": (envmod.STALL_CHECK_DISABLE, "stall-check.disable"),

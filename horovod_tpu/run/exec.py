@@ -182,18 +182,52 @@ class ProcessSet:
                 p.rank for p in self._procs if p.popen.poll() is None
             )
 
-    def terminate_rank(self, rank: int) -> None:
-        """Tree-kill one worker (heartbeat-dead path: the process is
-        still alive as far as the OS knows, but the job has declared it
-        lost); its exit then surfaces through poll_exits()."""
+    def terminate_rank(self, rank: int, *, grace: float = 0.0) -> None:
+        """Tree-kill one worker (heartbeat/progress-dead path: the
+        process is still alive as far as the OS knows, but the job has
+        declared it lost); its exit then surfaces through poll_exits().
+
+        ``grace > 0`` escalates instead of executing: SIGUSR1 (the
+        flight recorder's dump-only signal — even a rank that somehow
+        survives SIGTERM leaves its black box), then SIGTERM (the
+        recorder's handler flushes and re-raises), then SIGKILL after
+        ``grace`` seconds on a watchdog thread — the monitor loop never
+        blocks.  A rank whose main thread is wedged inside a C call
+        can't run Python signal handlers; the SIGKILL backstop is what
+        bounds that case, at the cost of its dump (documented in
+        docs/postmortem.md).  ``grace=0`` is the old immediate
+        SIGKILL."""
         with self._lock:
             procs = [p for p in self._procs if p.rank == rank]
+
+        def _kill(pg_procs):
+            for p in pg_procs:
+                if p.popen.poll() is None:
+                    try:
+                        os.killpg(os.getpgid(p.popen.pid), signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+
+        if grace <= 0:
+            _kill(procs)
+            return
         for p in procs:
             if p.popen.poll() is None:
-                try:
-                    os.killpg(os.getpgid(p.popen.pid), signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
+                for sig in (signal.SIGUSR1, signal.SIGTERM):
+                    try:
+                        os.killpg(os.getpgid(p.popen.pid), sig)
+                    except (ProcessLookupError, PermissionError):
+                        break
+
+        def _watchdog():
+            deadline = time.time() + grace
+            for p in procs:
+                while p.popen.poll() is None and time.time() < deadline:
+                    time.sleep(0.05)
+            _kill(procs)
+
+        threading.Thread(target=_watchdog, daemon=True,
+                         name=f"hvdtpu_kill_rank{rank}").start()
 
     def wait(self, timeout: Optional[float] = None) -> Dict[int, int]:
         """Wait for all; on first non-zero exit, terminate the rest and

@@ -69,6 +69,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if self.path.rstrip("/") == "/healthz":
+            # Read-only, unauthenticated liveness probe: operators (and
+            # the CI gates) poll this instead of sleeping-and-hoping.
+            # Carries only the key count — no values, no pickles, no
+            # secret — so it shares /metrics' trust rationale.
+            with self.server.kv_lock:  # type: ignore[attr-defined]
+                n = len(self.server.kv)  # type: ignore[attr-defined]
+            body = (
+                '{"status": "ok", "keys": %d}' % n
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path.rstrip("/") == "/metrics":
             # Read-only, UNAUTHENTICATED Prometheus exposition of the
             # live telemetry plane (obs/live.py registers the renderer).

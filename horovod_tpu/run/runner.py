@@ -137,6 +137,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "phase (default 0 = never kill during those phases; long "
              "XLA compiles are legitimate).",
     )
+    elastic.add_argument(
+        "--dump-grace-secs", type=float,
+        action=_StoreOverrideAction,
+        dest="dump_grace_secs", default=None,
+        help="When the monitor kills a hung rank (heartbeat/progress "
+             "lost), send SIGUSR1+SIGTERM first so its flight recorder "
+             "can dump, and SIGKILL only after this many seconds "
+             "(default 5; 0 = immediate SIGKILL, no black box).",
+    )
     parser.add_argument(
         "--output-filename", action=_StoreOverrideAction,
         dest="output_filename", default=None,
@@ -184,6 +193,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="Per-rank metrics dump target (HVDTPU_METRICS_DUMP): a "
              "directory, a {rank} template, or a plain path that gets a "
              "rank tag inserted.",
+    )
+    obs_group.add_argument(
+        "--flightrec-dump", action=_StoreOverrideAction,
+        dest="flightrec_dump", default=None,
+        help="Per-rank flight-recorder dump target "
+             "(HVDTPU_FLIGHTREC_DUMP): same dir/{rank}/plain-path forms "
+             "as --metrics-dump.  Unset, the launcher still arms a "
+             "temporary black-box dir so a crashed job gets a "
+             "post-mortem; set it to keep the per-rank rings after "
+             "clean runs too.",
     )
     obs_group.add_argument(
         "--stats-summary", action="store_true", dest="stats_summary",
@@ -518,6 +537,72 @@ def _maybe_start_live_plane(
     return plane, owned
 
 
+def _ensure_black_box(base_env: Dict[str, str]):
+    """Every job gets a flight-recorder dump target before any rank
+    spawns: the black box only pays off if it was armed BEFORE the
+    crash.  A user-provided ``--flightrec-dump`` / env value is left
+    alone; otherwise the launcher mints a temp dir it owns (removed
+    after a clean run, kept — and named in the verdict — after a
+    failed one).  Returns ``(dump_spec, launcher_owned)``.
+
+    Also marks THIS process as a launcher: it inherits the job's dump
+    env but must not dump its own (empty) artifacts under rank 0's
+    filename — a launcher-process ring/metrics dump would clobber
+    worker rank 0's evidence."""
+    envmod.mark_launcher()
+    raw = base_env.get(envmod.FLIGHTREC_DUMP)
+    if raw:
+        return raw, False
+    import tempfile  # noqa: PLC0415
+
+    d = tempfile.mkdtemp(prefix="hvdtpu_blackbox_")
+    base_env[envmod.FLIGHTREC_DUMP] = d
+    return d, True
+
+
+def _finish_black_box(
+    dump_spec: str,
+    owned: bool,
+    *,
+    failed: bool,
+    np: int,
+    live_history: Optional[str] = None,
+    timeline_path: Optional[str] = None,
+) -> None:
+    """Job-end half of the flight recorder: on abnormal end, correlate
+    every rank's ring dump into ``postmortem.json`` and print the
+    verdict; on a clean end, remove a launcher-owned temp dir (the
+    clean path writes no post-mortem).  Best-effort throughout — a
+    post-mortem failure must never mask the job's real error."""
+    if not failed:
+        if owned:
+            import shutil  # noqa: PLC0415
+
+            shutil.rmtree(dump_spec, ignore_errors=True)
+        return
+    try:
+        from ..obs import postmortem  # noqa: PLC0415
+
+        out_dir = (dump_spec if os.path.isdir(dump_spec)
+                   else (os.path.dirname(dump_spec) or "."))
+        report = postmortem.generate(
+            dump_spec,
+            expected_ranks=np,
+            live_history=live_history,
+            timeline_path=timeline_path,
+            output=os.path.join(out_dir, "postmortem.json"),
+        )
+        if report is None:
+            return
+        print("\n== post-mortem ==")
+        print(report["verdict"])
+        if report.get("report_path"):
+            print(f"postmortem report: {report['report_path']}")
+        print(f"flight-recorder dumps: {dump_spec}")
+    except Exception as exc:  # pragma: no cover - defensive
+        LOG.warning("post-mortem failed: %s", exc)
+
+
 def _stop_live_plane(plane, owned_server) -> None:
     """Tear down best-effort: a telemetry failure must never turn a
     finished job into an error."""
@@ -608,6 +693,7 @@ def launch_job(
         announce_host=live_announce,
     )
 
+    black_box, owns_black_box = _ensure_black_box(base_env)
     procs = ProcessSet()
     procs.install_signal_handlers()
     _clean_stale_obs_files(base_env)
@@ -618,14 +704,28 @@ def launch_job(
             ssh_port=ssh_port, tag_output=tag_output,
             output_dir=output_filename, num_proc=np,
         )
+    failed = True
     try:
-        return procs.wait(timeout=job_timeout)
+        result = procs.wait(timeout=job_timeout)
+        failed = False
+        return result
     finally:
         # Failed jobs merge too — a partial trace of a dead job is the
         # most valuable trace there is.  The live plane drains its final
         # round (workers flush at exit) before the server goes away.
         _stop_live_plane(live_plane, live_server)
-        _merge_rank_timelines(base_env)
+        merged = _merge_rank_timelines(base_env)
+        # On abnormal end the dead ranks' flight recorders already
+        # flushed (signal handlers ran during wait()'s terminate);
+        # correlate them into postmortem.json and print the verdict.
+        _finish_black_box(
+            black_box, owns_black_box, failed=failed, np=np,
+            live_history=(
+                (live_history or "live_history.jsonl")
+                if live_plane is not None else None
+            ),
+            timeline_path=merged,
+        )
 
 
 def _clean_stale_obs_files(env: Dict[str, str]) -> None:
@@ -639,10 +739,32 @@ def _clean_stale_obs_files(env: Dict[str, str]) -> None:
     from ..obs import pathspec  # noqa: PLC0415
 
     for var, stem in ((envmod.TIMELINE, "trace"),
-                      (envmod.METRICS_DUMP, "metrics")):
+                      (envmod.METRICS_DUMP, "metrics"),
+                      (envmod.FLIGHTREC_DUMP, "flightrec")):
         raw = env.get(var)
         if not raw:
             continue
+        if var == envmod.FLIGHTREC_DUMP:
+            # A previous crashed run's verdict would read as THIS
+            # run's — it is ours by name, remove it from wherever
+            # _finish_black_box would write it (the dir itself, or the
+            # parent of a plain-path/template spec).  Ditto orphaned
+            # atomic-write tmp files: a rank killed mid-dump dies
+            # inside its signal handler and never unwinds to clean its
+            # own tmp.
+            out_dir = (raw if os.path.isdir(raw)
+                       else (os.path.dirname(raw) or "."))
+            try:
+                os.remove(os.path.join(out_dir, "postmortem.json"))
+            except OSError:
+                pass
+            for tmp in _glob.glob(
+                os.path.join(out_dir, "flightrec.*.tmp.*")
+            ):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
         if "{rank}" in raw:
             # A user template has no rank/epoch token to anchor on —
             # its glob would match arbitrary sibling files, and deleting
@@ -737,6 +859,7 @@ def launch_elastic_job(
     progress_timeout: float = 300.0,
     progress_grace: float = 0.0,
     blacklist_cooldown: float = 10.0,
+    dump_grace_secs: float = 5.0,
     job_timeout: Optional[float] = None,
     kv_server=None,
     tag_output: bool = True,
@@ -767,6 +890,10 @@ def launch_elastic_job(
     collective-timeout retry budget discovering it.  ``progress_grace``
     is the same window for init/compile phases (0 = never kill there: a
     long XLA compile is legitimate).
+    ``dump_grace_secs``: when the monitor declares a rank dead, it is
+    sent SIGUSR1+SIGTERM first — the flight recorder's handlers flush
+    its black box — and SIGKILLed only after this window (0 restores
+    the old immediate SIGKILL, losing the hung rank's evidence).
     ``kv_server``: a caller-started rendezvous server already seeded
     with job payloads (the python API path); created/stopped internally
     when None.
@@ -884,6 +1011,8 @@ def launch_elastic_job(
     hb_next_scan = 0.0
     respawns_used = 0
     deadline = time.monotonic() + job_timeout if job_timeout else None
+    black_box, owns_black_box = _ensure_black_box(base_env)
+    job_failed = False
 
     try:
         _clean_stale_obs_files(base_env)
@@ -1017,7 +1146,10 @@ def launch_elastic_job(
                         # gets a full timeout before its first beat lands.
                         hb_seen.pop(rank, None)
                         progress_policy.forget(rank)
-                        procs.terminate_rank(rank)
+                        # Dump-then-kill: SIGUSR1/SIGTERM first so the
+                        # declared-dead rank's flight recorder survives
+                        # its own execution; SIGKILL after the grace.
+                        procs.terminate_rank(rank, grace=dump_grace_secs)
                         continue
                     # Rule 2 — training-thread liveness: the beat
                     # piggybacks the collective-path progress counter;
@@ -1036,7 +1168,7 @@ def launch_elastic_job(
                         )
                         hb_seen.pop(rank, None)
                         progress_policy.forget(rank)
-                        procs.terminate_rank(rank)
+                        procs.terminate_rank(rank, grace=dump_grace_secs)
             if all(r in finished for r in world):
                 result.exit_codes = dict(finished)
                 result.epoch = epoch
@@ -1052,6 +1184,10 @@ def launch_elastic_job(
                 )
             time.sleep(0.05)
     except BaseException:
+        job_failed = True
+        # terminate() SIGTERMs the tree and waits up to its graceful
+        # window — the survivors' flight recorders flush inside it, so
+        # the post-mortem below reads complete rings.
         procs.terminate()
         raise
     finally:
@@ -1062,7 +1198,15 @@ def launch_elastic_job(
         # All-rank trace merge, dead incarnations included: the
         # streaming writer format keeps a killed rank's file loadable,
         # and its epoch-tagged lane is the story of why it died.
-        _merge_rank_timelines(base_env)
+        merged = _merge_rank_timelines(base_env)
+        _finish_black_box(
+            black_box, owns_black_box, failed=job_failed, np=np,
+            live_history=(
+                (live_history or "live_history.jsonl")
+                if live_plane is not None else None
+            ),
+            timeline_path=merged,
+        )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1144,6 +1288,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     0.0
                     if getattr(args, "progress_grace_secs", None) is None
                     else args.progress_grace_secs
+                ),
+                dump_grace_secs=(
+                    5.0
+                    if getattr(args, "dump_grace_secs", None) is None
+                    else args.dump_grace_secs
                 ),
                 output_filename=args.output_filename,
                 live_stats_secs=getattr(args, "live_stats_secs", None),

@@ -17,6 +17,11 @@ _SCOPE = "runfunc"
 
 
 def main() -> int:
+    # Black-box the run()-API worker too: signal/excepthook deaths
+    # flush the flight-recorder ring and the metrics dump.
+    from ..obs import flightrec
+
+    flightrec.install_death_hooks()
     addr = os.environ["HVDTPU_RUN_FUNC_ADDR"]
     rank = int(os.environ.get("HVDTPU_RANK", "0"))
     # Chaos point "task_fn": kill (or fail) a worker before the user

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..obs import flightrec as obs_flightrec
 from ..utils.logging import get_logger
 from .messages import Request, RequestList, RequestType, Response, ResponseType
 
@@ -186,6 +187,14 @@ def compute_responses(
     for key, entry in ready:
         del state.message_table[key]
         name, rtype = key
+        # Flight recorder: negotiation completed for this op on this
+        # cycle — (cycle, op) is the alignment key the cross-rank
+        # post-mortem uses (deterministic controller: identical streams
+        # on every rank up to the failure point).
+        obs_flightrec.record(
+            "negotiate", name=name, cycle=state.cycle_index,
+            detail=rtype.name,
+        )
         _attribute_straggler(entry, name, alert_skew_ms, timeline)
         err = _validate(entry.requests)
         if timeline is not None:

@@ -42,6 +42,7 @@ import numpy as np
 from ..basics import global_topology
 from ..exceptions import HorovodShutdownError
 from ..obs import get_registry
+from ..obs import flightrec as obs_flightrec
 from ..obs import progress as obs_progress
 from ..testing.faults import maybe_fail
 from ..utils import env as envmod
@@ -301,6 +302,14 @@ class EagerEngine:
         # submission before it reaches negotiation, the same surface an
         # OOM snapshotting the payload or a dead transport would present.
         maybe_fail("enqueue", name=name)
+        # Flight recorder: the submission is the first fact the
+        # post-mortem aligns on — a rank that enqueued an op its peers
+        # never did is the classic desync, and this event is how the
+        # analyzer proves it.  O(1), in-place slot write.
+        obs_flightrec.record(
+            "enqueue", name=name, cycle=self.stats["cycles"],
+            detail=op.name,
+        )
         shape = tuple(tensor.shape) if tensor is not None else ()
         dtype = str(tensor.dtype) if tensor is not None else "float32"
         req = Request(
@@ -405,6 +414,10 @@ class EagerEngine:
                 again = self._run_loop_once()
             except Exception as exc:  # transport/controller failure
                 LOG.error("background loop error: %s", exc)
+                # The loop swallows this (peers' futures get it), so the
+                # excepthook will never see it — record it here or the
+                # black box ends with an unexplained last cycle.
+                obs_flightrec.record_exception(exc, where="engine.loop")
                 self._fail_all(exc)
                 return
             elapsed = time.monotonic() - start
@@ -696,6 +709,11 @@ class EagerEngine:
                 entries.append(self._table.pop(name, None))
 
         if resp.response_type == ResponseType.ERROR:
+            obs_flightrec.record(
+                "error", name=",".join(resp.tensor_names),
+                cycle=self._controller.cycle_index,
+                detail=(resp.error_message or "")[:200],
+            )
             for e in entries:
                 if e is not None:
                     e.future.set_exception(RuntimeError(resp.error_message))
@@ -703,6 +721,11 @@ class EagerEngine:
 
         try:
             names = ",".join(resp.tensor_names)
+            obs_flightrec.record(
+                "execute", name=names,
+                cycle=self._controller.cycle_index,
+                detail=resp.response_type.name,
+            )
             self.timeline.start(names, resp.response_type.name)
             if resp.response_type in (
                 ResponseType.ALLREDUCE,
@@ -722,6 +745,11 @@ class EagerEngine:
                 if e is not None:
                     e.future.set_result(None)
             self.timeline.end(names, resp.response_type.name)
+            obs_flightrec.record(
+                "complete", name=names,
+                cycle=self._controller.cycle_index,
+                detail=resp.response_type.name,
+            )
             # Progress beat source: a performed response proves the
             # collective path is moving (obs/progress.py); the count is
             # per user-level collective, so fused responses tick once
@@ -1177,6 +1205,10 @@ class EagerEngine:
         # Count only actual completions (same placement discipline as
         # _perform_operation: after success, never before).
         if entry.future.done() and entry.future.exception() is None:
+            obs_flightrec.record(
+                "complete", name=req.tensor_name,
+                detail=req.request_type.name,
+            )
             self._m_completed.inc()
             obs_progress.tick()
 

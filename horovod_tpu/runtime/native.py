@@ -30,7 +30,9 @@ import numpy as np
 
 from ..basics import global_topology
 from ..obs import get_registry
+from ..obs import flightrec as obs_flightrec
 from ..obs import progress as obs_progress
+from ..testing.faults import maybe_fail
 from ..utils import env as envmod
 from ..utils.logging import get_logger
 from . import timeline as timeline_mod
@@ -178,7 +180,7 @@ class NativeEngine:
             raise RuntimeError(f"native engine: mesh connect failed (rc={rc})")
 
         self._lock = threading.Lock()
-        self._outstanding: Dict[int, tuple] = {}  # handle -> (future, dtype)
+        self._outstanding: Dict[int, tuple] = {}  # handle -> (future, dtype, name)
         self._pump_wake = threading.Event()
         self._stop = False
         self._barrier_seq = 0
@@ -246,6 +248,11 @@ class NativeEngine:
         prescale: float = 1.0,
         postscale: float = 1.0,
     ) -> concurrent.futures.Future:
+        # Same chaos point and black-box event as the python engine's
+        # enqueue — fault specs and post-mortems must not care which
+        # engine a job ran on.
+        maybe_fail("enqueue", name=name)
+        obs_flightrec.record("enqueue", name=name, detail=op.name)
         if tensor is not None:
             # np.ascontiguousarray silently promotes 0-d scalars to shape
             # (1,), which would bypass the controller's scalar validation;
@@ -274,7 +281,7 @@ class NativeEngine:
             int(reduce_op), int(root_rank), float(prescale), float(postscale),
         )
         with self._lock:
-            self._outstanding[handle] = (fut, dtype_name)
+            self._outstanding[handle] = (fut, dtype_name, name)
         self._pump_wake.set()
         return fut
 
@@ -282,7 +289,7 @@ class NativeEngine:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         handle = self.lib.hvdtpu_join()
         with self._lock:
-            self._outstanding[handle] = (fut, None)
+            self._outstanding[handle] = (fut, None, "join")
         self._pump_wake.set()
         return fut
 
@@ -340,7 +347,7 @@ class NativeEngine:
                 self._pump_wake.clear()
                 continue
             progressed = False
-            for handle, (fut, dtype_name) in items:
+            for handle, (fut, dtype_name, name) in items:
                 st = self.lib.hvdtpu_poll(handle)
                 if st == 0:
                     continue
@@ -352,12 +359,16 @@ class NativeEngine:
                         fut.set_result(self.world - 1)
                     else:
                         fut.set_result(self._fetch_result(handle, dtype_name))
-                        # Progress-beat + metrics source, same semantics
-                        # as the python engine's _perform_operation.
+                        # Progress-beat + metrics + black-box source,
+                        # same semantics as the python engine's
+                        # _perform_operation.
+                        obs_flightrec.record("complete", name=name)
                         self._m_completed.inc()
                         obs_progress.tick()
                 else:
                     msg = self.lib.hvdtpu_error(handle).decode()
+                    obs_flightrec.record("error", name=name,
+                                         detail=msg[:200])
                     exc: Exception
                     if "same name as another tensor" in msg:
                         exc = ValueError(msg)

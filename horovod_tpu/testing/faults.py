@@ -33,7 +33,13 @@ Grammar::
   NOT re-trigger the fault that killed its predecessor.
 * ``count`` — times to fire (default 1).
 * ``action`` — ``raise`` (default) raises :class:`InjectedFault`;
-  ``exit`` calls ``os._exit(code)``; ``hang`` blocks the calling thread
+  ``raise:<ExcName>`` raises that builtin exception instead (e.g.
+  ``raise:ValueError``) — the deterministic driver for the excepthook
+  dump path; ``exit`` calls ``os._exit(code)``; ``abort`` delivers
+  SIGABRT to this process via ``signal.raise_signal`` (no Python
+  cleanup, no atexit — but the flight recorder's fatal-signal handler
+  still runs, which is exactly the death the signal-dump path is
+  chaos-tested against); ``hang`` blocks the calling thread
   forever (daemon threads — heartbeats — keep running: the exact
   signature of a deadlocked training thread, which is what the
   progress-beat staleness policy exists to catch);
@@ -80,6 +86,7 @@ class FaultSpec:
     action: str = "raise"
     code: int = DEFAULT_EXIT_CODE
     delay_ms: int = 1000
+    exc_name: Optional[str] = None
     name: Optional[str] = None
     fired: int = field(default=0, compare=False)
 
@@ -109,11 +116,23 @@ def parse_spec(raw: str) -> List[FaultSpec]:
             spec.action = "exit"
         for kv in fields[1:]:
             if "=" not in kv:
-                # ``action=delay:<ms>``: the milliseconds ride as a bare
-                # numeric field right after the action (the grammar's
-                # separator is ":", so they can't live in the value).
+                # ``action=delay:<ms>`` / ``action=raise:<ExcName>``:
+                # the parameter rides as a bare field right after the
+                # action (the grammar's separator is ":", so it can't
+                # live in the value).
                 if spec.action == "delay" and kv.strip().isdigit():
                     spec.delay_ms = int(kv.strip())
+                    continue
+                if spec.action == "raise" and kv.strip().isidentifier():
+                    exc_name = kv.strip()
+                    cls = getattr(__import__("builtins"), exc_name, None)
+                    if not (isinstance(cls, type)
+                            and issubclass(cls, BaseException)):
+                        raise ValueError(
+                            f"action=raise:{exc_name}: {exc_name!r} is "
+                            f"not a builtin exception"
+                        )
+                    spec.exc_name = exc_name
                     continue
                 raise ValueError(
                     f"fault spec field {kv!r} in {chunk!r} is not key=value"
@@ -124,7 +143,7 @@ def parse_spec(raw: str) -> List[FaultSpec]:
             elif key == "epoch":
                 spec.epoch = None if value in ("any", "*") else int(value)
             elif key == "action":
-                if value not in ("raise", "exit", "hang", "delay"):
+                if value not in ("raise", "exit", "abort", "hang", "delay"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -216,6 +235,15 @@ def maybe_fail(
         if spec.name is not None and spec.name != name:
             continue
         spec.fired += 1
+        # Black-box the injection itself: a chaos run's post-mortem must
+        # show the fault firing as an event, not leave the analyzer to
+        # infer it from the wreckage.
+        from ..obs import flightrec  # noqa: PLC0415
+
+        flightrec.record(
+            "fault", name=point,
+            detail=f"{spec.action}:{spec.describe()}",
+        )
         if spec.action == "delay":
             # A deterministic straggler: stall the calling thread, then
             # proceed normally — the collective completes late, which is
@@ -228,6 +256,16 @@ def maybe_fail(
             # os._exit, not sys.exit: the injected death must look like a
             # hard crash (no atexit, no finally blocks posting results).
             os._exit(spec.code)
+        if spec.action == "abort":
+            # raise_signal (not os.abort): os.abort bypasses Python
+            # signal handlers, which would defeat the very dump path
+            # this action exists to chaos-test.  With the flight
+            # recorder's handler installed the rank dumps its ring,
+            # then dies by real SIGABRT (no atexit, no finally blocks);
+            # without it, it is a plain abort.
+            import signal  # noqa: PLC0415
+
+            signal.raise_signal(signal.SIGABRT)
         if spec.action == "hang":
             # Deadlock the CALLING thread only: daemon threads (the KV
             # heartbeat) keep beating, so the process looks alive while
@@ -238,4 +276,10 @@ def maybe_fail(
 
             while True:
                 threading.Event().wait(3600)
+        if spec.exc_name is not None:
+            cls = getattr(__import__("builtins"), spec.exc_name)
+            raise cls(
+                f"injected fault at {point!r} ({spec.describe()}) — "
+                f"HVDTPU_FAULT_SPEC"
+            )
         raise InjectedFault(point, spec.describe())

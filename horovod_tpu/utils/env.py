@@ -46,6 +46,14 @@ LIVE_KV = "HVDTPU_LIVE_KV"
 # whose first-to-last arrival skew exceeds this warns and counts an
 # engine.straggler.alerts event (0/unset = record silently).
 ALERT_SKEW = "HVDTPU_ALERT_SKEW_MS"
+# Flight recorder (obs/flightrec.py): where each rank dumps its
+# in-memory event ring on any death path (same dir/{rank}/plain-path
+# forms as METRICS_DUMP; unset = ring records but never dumps), and the
+# ring capacity in events (default 512).  The launcher sets the dump
+# target itself when the user did not, so crashed jobs always leave a
+# black box for obs/postmortem.py.
+FLIGHTREC_DUMP = "HVDTPU_FLIGHTREC_DUMP"
+FLIGHTREC_CAPACITY = "HVDTPU_FLIGHTREC_CAPACITY"
 
 
 def resolve_rank(default=None):
@@ -58,6 +66,31 @@ def resolve_rank(default=None):
         if value not in (None, ""):
             return int(value)
     return default
+
+
+# The launcher process inherits the job's dump env (METRICS_DUMP,
+# FLIGHTREC_DUMP from the user's shell) but has no HVDTPU_RANK, so an
+# env-driven artifact dump in the launcher would resolve to rank 0 and
+# CLOBBER worker rank 0's evidence.  Launchers self-identify here; their
+# artifacts get a distinct "launcher" tag the aggregators ignore.
+_is_launcher = False
+
+
+def mark_launcher() -> None:
+    global _is_launcher
+    _is_launcher = True
+
+
+def artifact_rank() -> str:
+    """The rank tag per-rank artifact dumps (metrics, flight recorder)
+    file under: the resolved rank for workers, ``launcher`` for a
+    marked launcher process.  An explicit rank env wins over the
+    launcher mark — a process that is both (in-process API tests, or a
+    worker driving a sub-job) is a worker first."""
+    rank = resolve_rank(None)
+    if rank is None and _is_launcher:
+        return "launcher"
+    return str(rank if rank is not None else 0)
 
 
 def env_int(name: str, default: int) -> int:
