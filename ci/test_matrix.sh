@@ -11,7 +11,79 @@ cd "$(dirname "$0")/.."
 echo "== build native engine =="
 make -C cpp
 
+# Static-analysis gate (ISSUE 5) — runs FIRST in every tier: it is the
+# cheapest check and rejects whole bug classes (rank-divergent collective
+# schedules, lock-order/signal-safety violations) no test below can see.
+analysis_gate() {
+    echo "== analysis gate: hvdtpu-lint over the full surface =="
+    # ONE full-surface run serves both checks: the committed tree must
+    # lint clean against the committed baseline (exit 0 + summary.new
+    # asserted below) and the JSON report must be schema-valid.  No
+    # explicit paths: the [tool.hvdtpu-lint] config supplies the same
+    # surface, AND a config-default run is the one that reports stale
+    # baseline entries (fixed findings whose entries should be removed).
+    LINT_TMP=$(mktemp -d)
+    if ! python -m horovod_tpu.analysis \
+        --baseline horovod_tpu/analysis/baseline.json \
+        --format json > "$LINT_TMP/report.json"; then
+        echo "analysis gate FAILED: new findings on the clean tree" >&2
+        python - "$LINT_TMP/report.json" <<'EOF' >&2 || cat "$LINT_TMP/report.json" >&2
+import json, sys
+for f in json.load(open(sys.argv[1]))["findings"]:
+    if f["status"] == "new":
+        print(f"{f['path']}:{f['line']}: {f['rule']} {f['message']}")
+EOF
+        rm -rf "$LINT_TMP"
+        exit 1
+    fi
+    python - "$LINT_TMP/report.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["schema"] == "hvdtpu-lint-v1", doc["schema"]
+assert isinstance(doc["rules"], dict) and len(doc["rules"]) >= 12
+for rid, r in doc["rules"].items():
+    assert {"name", "severity", "summary"} <= set(r), (rid, r)
+    assert r["severity"] in ("error", "warning"), (rid, r)
+for f in doc["findings"]:
+    assert {"rule", "severity", "path", "line", "col", "message",
+            "context", "status"} <= set(f), f
+    assert f["status"] in ("new", "baselined", "suppressed"), f
+    assert isinstance(f["line"], int) and f["line"] >= 1, f
+s = doc["summary"]
+assert s["new"] == 0, f"clean-tree run reported new findings: {s}"
+assert s["total"] == len(doc["findings"])
+print(f"analysis gate: schema OK ({len(doc['rules'])} rules, "
+      f"{s['baselined']} baselined, {s['suppressed']} suppressed)")
+EOF
+    # 3) the gate actually GATES: a seeded violation must fail the run
+    cat > "$LINT_TMP/seeded_bad.py" <<'EOF'
+import horovod_tpu as hvd
+
+def step(x):
+    if hvd.rank() == 0:          # rank-guarded collective: deadlock
+        return hvd.allreduce(x)
+    return x
+EOF
+    if python -m horovod_tpu.analysis "$LINT_TMP/seeded_bad.py" \
+        --baseline horovod_tpu/analysis/baseline.json \
+        > "$LINT_TMP/seeded.out" 2>&1; then
+        echo "analysis gate FAILED: seeded violation passed the linter" >&2
+        cat "$LINT_TMP/seeded.out" >&2
+        rm -rf "$LINT_TMP"
+        exit 1
+    fi
+    grep -q "HVD001" "$LINT_TMP/seeded.out" || {
+        echo "analysis gate FAILED: seeded violation not attributed to HVD001" >&2
+        cat "$LINT_TMP/seeded.out" >&2
+        rm -rf "$LINT_TMP"
+        exit 1
+    }
+    rm -rf "$LINT_TMP"
+    echo "analysis gate OK"
+}
+
 if [ "${1:-full}" = "quick" ]; then
+    analysis_gate
     # per-commit tier: everything except the long pole (soak, differential
     # fuzz, fp8 numerics contract, scaling gates) — see pytest.ini markers.
     # The elastic/fault-injection suite runs first and by name: recovery
@@ -35,6 +107,8 @@ if [ "${1:-full}" = "quick" ]; then
         --deselect "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks"
     exit 0
 fi
+
+analysis_gate
 
 echo "== unit + in-process multiprocess suite (builds cover both engines) =="
 # Parallel full tier (VERDICT r4 weak #6: 30 min single-threaded and

@@ -95,7 +95,9 @@ def main() -> int:
                     indices=rows,
                     dense_shape=leaf.shape,
                 )
-                dense = to_dense(allreduce_sparse(sparse))
+                dense = to_dense(
+                    allreduce_sparse(sparse, name="bert.embed.sparse")
+                )
                 del dense  # demonstration only: K-row traffic, and the
                 #            dense reduce below owns the real update
                 break

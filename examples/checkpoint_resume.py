@@ -45,7 +45,15 @@ def main():
     start_step = 0
 
     # Resume if a checkpoint exists (rank 0 reads, everyone receives).
-    if latest_checkpoint_step(ckpt_dir) is not None:
+    # The existence check is decided ON RANK 0 and broadcast: the
+    # filesystem is not guaranteed identical across hosts (local disks,
+    # half-synced NFS), and ranks disagreeing here would send one subset
+    # into restore_checkpoint and the rest into broadcast_parameters —
+    # two different collective schedules, i.e. a hang.
+    resume_step = hvd.broadcast_object(
+        latest_checkpoint_step(ckpt_dir), root_rank=0
+    )
+    if resume_step is not None:
         state = restore_checkpoint(
             ckpt_dir, {"params": params, "step": 0}
         )
@@ -53,7 +61,10 @@ def main():
         if hvd.rank() == 0:
             print(f"resumed from step {start_step}")
     else:
-        params = hvd.broadcast_parameters(params, root_rank=0)
+        # branch is rank-uniform: decided by the broadcast above
+        params = hvd.broadcast_parameters(  # hvdtpu: disable=HVD003
+            params, root_rank=0
+        )
 
     from jax.sharding import PartitionSpec as P
 

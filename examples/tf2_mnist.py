@@ -53,11 +53,15 @@ def main() -> int:
         grads = tape.gradient(loss, model.trainable_variables)
         opt.apply_gradients(zip(grads, model.trainable_variables))
         if step == 0:
-            # reference tensorflow2_mnist.py:first_batch broadcast
+            # reference tensorflow2_mnist.py:first_batch broadcast.
+            # `step == 0` is loop-uniform — every rank runs the first
+            # iteration — so the branch cannot diverge across ranks.
+            # hvdtpu: disable=HVD003
             hvd.broadcast_variables(model.variables, root_rank=0)
             opt_vars = opt.variables  # property in modern Keras,
             if callable(opt_vars):    # method on legacy optimizers
                 opt_vars = opt_vars()
+            # hvdtpu: disable=HVD003 — same loop-uniform branch
             hvd.broadcast_variables(opt_vars, root_rank=0)
         if step % 5 == 0 and hvd.rank() == 0:
             print(f"step {step:2d} loss {float(loss):.4f}")

@@ -69,7 +69,12 @@ def peek_engine() -> Optional[object]:
 
 def shutdown_engine() -> None:
     global _engine
+    # Swap the handle out under the lock, but run the (blocking) engine
+    # teardown OUTSIDE it: shutdown joins the background thread with a
+    # 30 s bound, and holding the registry lock across that would stall
+    # every concurrent get_engine()/enqueue for the whole wait
+    # (hvdtpu-lint HVDC102).
     with _lock:
-        if _engine is not None:
-            _engine.shutdown()
-            _engine = None
+        engine, _engine = _engine, None
+    if engine is not None:
+        engine.shutdown()

@@ -1,0 +1,39 @@
+"""hvdtpu-lint: SPMD-correctness and concurrency static analyzer.
+
+Run it::
+
+    python -m horovod_tpu.analysis horovod_tpu/ examples/
+    python -m horovod_tpu.analysis --changed        # fast local loop
+    python -m horovod_tpu.analysis --list-rules
+
+Two rule families (catalog: ``--list-rules`` / docs/analysis.md):
+
+* ``HVD0xx`` — SPMD schedule correctness: rank-guarded collectives,
+  unordered-container iteration, unnamed collectives in conditionals,
+  missing initial-state broadcast, import-time topology reads,
+  collectives in except handlers, rank-dependent names.
+* ``HVDC1xx`` — concurrency discipline: lock-order inversions,
+  blocking calls under locks, and the signal-path rules (non-reentrant
+  locks, logging, blocking calls, unbounded growth reachable from
+  death hooks), plus swallowed shutdown exceptions.
+
+Suppress one finding inline with ``# hvdtpu: disable=HVD001`` (same
+line or the line above); acknowledge known false positives in
+``analysis/baseline.json`` — every entry needs a ``reason``.
+
+This package is stdlib-only (no jax import), so it runs in bare CI
+images and pre-commit hooks.
+"""
+
+from .cli import analyze_paths, main  # noqa: F401
+from .core import SCHEMA, Finding, Rule  # noqa: F401
+from .registry import all_rules  # noqa: F401
+
+__all__ = [
+    "analyze_paths",
+    "main",
+    "all_rules",
+    "Finding",
+    "Rule",
+    "SCHEMA",
+]

@@ -1,0 +1,310 @@
+"""hvdtpu-lint CLI: ``python -m horovod_tpu.analysis [paths] ...``.
+
+Exit codes: 0 = clean (every finding suppressed or baselined),
+1 = new findings, 2 = usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Set
+
+from . import baseline as baseline_mod
+from . import registry
+from .config import LintConfig, load_config
+from .core import SCHEMA, Finding, ModuleModel, is_suppressed, load_module
+
+
+def _iter_py_files(paths: Sequence[str], exclude: Sequence[str],
+                   root: str) -> List[str]:
+    out: List[str] = []
+    seen: Set[str] = set()
+    excl = [os.path.normpath(os.path.join(root, e)) for e in exclude]
+
+    def excluded(p: str) -> bool:
+        np_ = os.path.normpath(p)
+        return any(np_ == e or np_.startswith(e + os.sep) for e in excl)
+
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            if not excluded(ap) and ap not in seen:
+                seen.add(ap)
+                out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [
+                    d for d in sorted(dirnames)
+                    if d != "__pycache__"
+                    and not excluded(os.path.join(dirpath, d))
+                ]
+                for fn in sorted(filenames):
+                    fp = os.path.join(dirpath, fn)
+                    if fn.endswith(".py") and not excluded(fp) \
+                            and fp not in seen:
+                        seen.add(fp)
+                        out.append(fp)
+    return out
+
+
+def _changed_files(root: str) -> List[str]:
+    """Working-tree changes vs HEAD plus untracked files — the local
+    pre-commit loop's file set."""
+    files: Set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                args, cwd=root, capture_output=True, text=True,
+                timeout=30, check=True,
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            # exit 2: environment/usage error — never 1, which the
+            # documented contract reserves for "new findings".
+            print(f"hvdtpu-lint: --changed needs git: {e}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        files.update(
+            line.strip() for line in res.stdout.splitlines()
+            if line.strip()
+        )
+    return sorted(f for f in files if f.endswith(".py"))
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    root: Optional[str] = None,
+    exclude: Sequence[str] = (),
+    rules: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Library entry point: lint ``paths`` (files or directories),
+    returning findings with suppression status applied (baseline is the
+    CLI's job)."""
+    root = os.path.abspath(root or os.getcwd())
+    files = _iter_py_files(paths, exclude, root)
+    models: List[ModuleModel] = []
+    findings: List[Finding] = []
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        model = load_module(path, rel)
+        if model is None:
+            findings.append(Finding(
+                rule="PARSE", severity="error", path=rel, line=1, col=0,
+                message="file does not parse; fix the syntax error "
+                        "first", context="<module>",
+            ))
+            continue
+        models.append(model)
+    for model in models:
+        findings.extend(registry.run_module_rules(model))
+    findings.extend(registry.run_project_rules(models))
+    if rules:
+        findings = [f for f in findings if f.rule in rules or
+                    f.rule == "PARSE"]
+    by_rel: Dict[str, ModuleModel] = {m.relpath: m for m in models}
+    for f in findings:
+        model = by_rel.get(f.path)
+        if model is not None and is_suppressed(f, model.suppressions):
+            f.status = "suppressed"
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _format_text(findings: List[Finding]) -> str:
+    lines = []
+    for f in findings:
+        if f.status != "new":
+            continue
+        lines.append(
+            f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.severity}] "
+            f"{f.message}"
+        )
+    counts = _counts(findings)
+    lines.append(
+        f"hvdtpu-lint: {counts['new']} new finding(s), "
+        f"{counts['baselined']} baselined, "
+        f"{counts['suppressed']} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def _counts(findings: List[Finding]) -> Dict[str, int]:
+    return {
+        "total": len(findings),
+        "new": sum(1 for f in findings if f.status == "new"),
+        "baselined": sum(1 for f in findings if f.status == "baselined"),
+        "suppressed": sum(
+            1 for f in findings if f.status == "suppressed"
+        ),
+    }
+
+
+def _format_json(findings: List[Finding]) -> str:
+    rules = registry.all_rules()
+    doc = {
+        "schema": SCHEMA,
+        "rules": {
+            rid: {
+                "name": r.name,
+                "severity": r.severity,
+                "summary": r.summary,
+            }
+            for rid, r in sorted(rules.items())
+        },
+        "findings": [f.as_dict() for f in findings],
+        "summary": _counts(findings),
+    }
+    return json.dumps(doc, indent=2)
+
+
+def _list_rules() -> str:
+    lines = []
+    for rid, r in sorted(registry.all_rules().items()):
+        lines.append(f"{rid}  {r.severity:<7}  {r.name}: {r.summary}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="hvdtpu-lint: SPMD-correctness and concurrency "
+                    "static analyzer for horovod_tpu code",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: [tool.hvdtpu-lint] "
+             "paths from pyproject.toml)",
+    )
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument(
+        "--baseline", metavar="FILE", default=None,
+        help="baseline JSON; known findings listed there (with a "
+             "reason) don't fail the run (default: from pyproject)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any configured baseline (report everything)",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs HEAD (plus untracked) — the "
+             "fast local pre-commit loop",
+    )
+    parser.add_argument(
+        "--rules", metavar="IDS", default=None,
+        help="comma-separated rule IDs to run (default: all)",
+    )
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument(
+        "--write-baseline", metavar="FILE", default=None,
+        help="write current findings as a baseline skeleton (reasons "
+             "must be filled in before the file loads)",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root for relative paths/config (default: cwd)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    try:
+        cfg: LintConfig = load_config(root)
+    except ValueError as e:
+        # Config errors are exit-code 2, same as every other usage
+        # error — never 1, which scripts read as "findings".
+        print(f"hvdtpu-lint: bad [tool.hvdtpu-lint] config: {e}",
+              file=sys.stderr)
+        return 2
+    paths = list(args.paths) or list(cfg.paths)
+    if args.changed:
+        changed = _changed_files(root)
+        # intersect with the configured lint surface
+        surface = [
+            os.path.normpath(p) for p in paths
+        ]
+
+        def in_surface(rel: str) -> bool:
+            np_ = os.path.normpath(rel)
+            return any(
+                np_ == s or np_.startswith(s + os.sep) for s in surface
+            ) or np_ in surface
+        paths = [f for f in changed if in_surface(f)]
+        if not paths:
+            print("hvdtpu-lint: no changed python files under the lint "
+                  "surface; nothing to do")
+            return 0
+
+    rules_filter: Optional[Set[str]] = None
+    if args.rules:
+        known = set(registry.all_rules())
+        rules_filter = {r.strip() for r in args.rules.split(",")
+                        if r.strip()}
+        unknown = rules_filter - known
+        if unknown:
+            print(f"hvdtpu-lint: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = analyze_paths(
+            paths, root=root, exclude=cfg.exclude, rules=rules_filter,
+        )
+    except ValueError as e:  # config errors
+        print(f"hvdtpu-lint: {e}", file=sys.stderr)
+        return 2
+
+    loaded_baseline: dict = {}
+    baseline_path = args.baseline or cfg.baseline
+    if baseline_path and not args.no_baseline:
+        bp = baseline_path if os.path.isabs(baseline_path) else \
+            os.path.join(root, baseline_path)
+        if os.path.isfile(bp):
+            try:
+                bl = baseline_mod.load_baseline(bp)
+            except (baseline_mod.BaselineError, OSError,
+                    json.JSONDecodeError) as e:
+                print(f"hvdtpu-lint: bad baseline: {e}", file=sys.stderr)
+                return 2
+            loaded_baseline = bl
+            findings, unused = baseline_mod.apply_baseline(findings, bl)
+            # Unused entries are only meaningful on a full-surface,
+            # all-rules run; a --changed run sees a file subset and a
+            # --rules run a rule subset — both would cry wolf.
+            if unused and not args.changed and not args.paths \
+                    and not args.rules:
+                for e in unused:
+                    print(
+                        f"hvdtpu-lint: note: baseline entry no longer "
+                        f"matches anything (fixed? remove it): "
+                        f"{e['rule']} {e['path']} {e['context']}",
+                        file=sys.stderr,
+                    )
+
+    if args.write_baseline:
+        n = baseline_mod.write_baseline(
+            args.write_baseline, findings,
+            reason="",  # intentionally invalid: forces a human reason
+            existing=loaded_baseline,  # keep curated reasons
+        )
+        print(f"hvdtpu-lint: wrote {n} baseline entr(y/ies) to "
+              f"{args.write_baseline}; fill in every NEW entry's "
+              f"'reason' before committing (empty reasons are rejected "
+              f"on load; existing entries kept theirs)")
+
+    out = _format_json(findings) if args.format == "json" else \
+        _format_text(findings)
+    print(out)
+    return 1 if any(f.status == "new" for f in findings) else 0

@@ -1,0 +1,534 @@
+"""SPMD-correctness rules (HVD0xx).
+
+The invariant behind every rule here is Horovod's core contract
+(Sergeev & Del Balso, 2018): **every rank must submit the same
+collective schedule in the same order.**  A collective some ranks skip,
+reorder, or name differently never completes — the job hangs with no
+exception anywhere, which is why these are worth rejecting at commit
+time rather than diagnosing from a post-mortem.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from . import astutil
+from .core import ModuleModel, SEV_ERROR, SEV_WARNING, Finding
+from .registry import make_finding, rule
+
+# ---------------------------------------------------------------------------
+# HVD001 — collective under rank-dependent control flow
+# ---------------------------------------------------------------------------
+
+
+class _RankGuardVisitor(ast.NodeVisitor):
+    """Finds collective calls lexically reachable only when a
+    rank-dependent condition holds: inside the body/orelse of a
+    rank-dependent ``if``/``while``, inside a rank-dependent ternary,
+    or after a rank-dependent early exit (``if rank()!=0: return``)."""
+
+    def __init__(self, model: ModuleModel):
+        self.model = model
+        self.findings: List[tuple] = []  # (node, guard_line)
+        self._guards: List[int] = []  # lines of active rank guards
+
+    # -- region tracking --
+
+    def _walk_body(self, stmts: List[ast.stmt]) -> None:
+        """Visit a statement list, activating a guard for statements
+        after a rank-dependent early exit."""
+        pushed = 0
+        for stmt in stmts:
+            if (
+                isinstance(stmt, ast.If)
+                and astutil.is_rank_dependent(stmt.test)
+                and _ends_in_exit(stmt.body)
+                and not stmt.orelse
+            ):
+                # `if rank() != 0: return` — everything after this
+                # statement runs on a rank-dependent subset.
+                self.visit(stmt.test)
+                self._guards.append(stmt.lineno)
+                pushed += 1
+                for s in stmt.body:
+                    self.visit(s)
+                continue
+            self.visit(stmt)
+        for _ in range(pushed):
+            self._guards.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A nested def's body executes when *called*, not where it is
+        # defined — guards at the definition site don't apply inside.
+        saved, self._guards = self._guards, []
+        self._walk_body(node.body)
+        self._guards = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Module(self, node: ast.Module) -> None:
+        self._walk_body(node.body)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.target)
+        self.visit(node.iter)
+        self._walk_body(node.body)
+        self._walk_body(node.orelse)
+
+    visit_AsyncFor = visit_For
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            self.visit(item)
+        self._walk_body(node.body)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Try(self, node: ast.Try) -> None:
+        self._walk_body(node.body)
+        for handler in node.handlers:
+            self._walk_body(handler.body)
+        self._walk_body(node.orelse)
+        self._walk_body(node.finalbody)
+
+    def visit_If(self, node: ast.If) -> None:
+        if astutil.is_rank_dependent(node.test):
+            self.visit(node.test)
+            self._guards.append(node.lineno)
+            for s in node.body + node.orelse:
+                self.visit(s)
+            self._guards.pop()
+        else:
+            self.visit(node.test)
+            self._walk_body(node.body)
+            self._walk_body(node.orelse)
+
+    def visit_While(self, node: ast.While) -> None:
+        if astutil.is_rank_dependent(node.test):
+            self.visit(node.test)
+            self._guards.append(node.lineno)
+            for s in node.body + node.orelse:
+                self.visit(s)
+            self._guards.pop()
+        else:
+            self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if astutil.is_rank_dependent(node.test):
+            self.visit(node.test)
+            self._guards.append(node.lineno)
+            self.visit(node.body)
+            self.visit(node.orelse)
+            self._guards.pop()
+        else:
+            self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._guards and astutil.is_collective_call(node, self.model):
+            self.findings.append((node, self._guards[-1]))
+        self.generic_visit(node)
+
+
+def _ends_in_exit(body: List[ast.stmt]) -> bool:
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Continue, ast.Break)):
+        return True
+    if isinstance(last, ast.Expr) and isinstance(last.value, ast.Call):
+        name = astutil.call_name(last.value)
+        return name in ("exit", "_exit", "abort")
+    return False
+
+
+@rule("HVD001", "rank-guarded-collective", SEV_ERROR,
+      "collective reachable only under rank-dependent control flow")
+def hvd001(model: ModuleModel) -> List[Finding]:
+    """A collective issued under a condition that reads the rank runs on
+    a strict subset of ranks; the others never submit it, and the subset
+    blocks forever waiting for them.
+
+    Minimal failing example::
+
+        if hvd.rank() == 0:
+            total = hvd.allreduce(x)   # ranks != 0 never arrive: hang
+
+    Fix: issue the collective unconditionally and branch on the rank
+    *around* it (e.g. only rank 0 *uses* the result), or use
+    ``broadcast`` from the deciding rank."""
+    v = _RankGuardVisitor(model)
+    v.visit(model.tree)
+    fmap = astutil.enclosing_function_map(model)
+    out = []
+    for node, guard_line in v.findings:
+        name = astutil.call_name(node)
+        out.append(make_finding(
+            "HVD001", model, node.lineno, node.col_offset,
+            f"collective '{name}' is only reached under the "
+            f"rank-dependent condition at line {guard_line}; ranks "
+            f"outside the branch never submit it and the world "
+            f"deadlocks",
+            astutil.context_for_line(model, node.lineno, fmap),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HVD002 — collective while iterating an unordered container
+# ---------------------------------------------------------------------------
+
+
+def _unordered_iter_reason(it: ast.expr) -> Optional[str]:
+    """Why this for-loop's iteration order may differ across ranks."""
+    if isinstance(it, ast.Set):
+        return "a set literal"
+    if isinstance(it, ast.Call):
+        name = astutil.call_name(it)
+        if name in ("set", "frozenset"):
+            return f"a {name}() value"
+        if name in ("keys", "values", "items") and isinstance(
+            it.func, ast.Attribute
+        ):
+            return f"dict .{name}() (insertion order is build-dependent)"
+        if name in ("vars", "globals", "locals"):
+            return f"{name}()"
+    if isinstance(it, ast.Attribute) and it.attr == "environ":
+        return "os.environ"
+    return None
+
+
+@rule("HVD002", "collective-in-unordered-iteration", SEV_WARNING,
+      "collective issued while iterating a set/dict view")
+def hvd002(model: ModuleModel) -> List[Finding]:
+    """Collectives inside a loop over a set (or a dict view whose build
+    order is data-dependent) are submitted in container order.  If that
+    order differs across ranks — sets hash-order differently under
+    ``PYTHONHASHSEED``, dicts follow their build history — unnamed
+    collectives pair by the auto ``_seq`` counter and ranks reduce
+    *different tensors against each other* (or deadlock).
+
+    Minimal failing example::
+
+        for name in {"w", "b"}:               # set order
+            grads[name] = hvd.allreduce(grads[name])
+
+    Fix: ``for name in sorted(...)`` — one deterministic order on every
+    rank.  ``sorted()`` wrapping the container is recognized."""
+    out = []
+    fmap = astutil.enclosing_function_map(model)
+    for node in ast.walk(model.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        reason = _unordered_iter_reason(node.iter)
+        if reason is None:
+            continue
+        for call in astutil.iter_calls(node):
+            if astutil.is_collective_call(call, model):
+                name = astutil.call_name(call)
+                out.append(make_finding(
+                    "HVD002", model, call.lineno, call.col_offset,
+                    f"collective '{name}' issued while iterating "
+                    f"{reason} (loop at line {node.lineno}); iteration "
+                    f"order can differ across ranks — wrap the "
+                    f"container in sorted()",
+                    astutil.context_for_line(model, call.lineno, fmap),
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HVD003 — unnamed collective inside a conditional
+# ---------------------------------------------------------------------------
+
+
+@rule("HVD003", "unnamed-collective-in-conditional", SEV_WARNING,
+      "collective without an explicit name inside a conditional branch")
+def hvd003(model: ModuleModel) -> List[Finding]:
+    """Unnamed collectives are paired across ranks by an automatic
+    per-epoch sequence counter.  Inside a data-dependent conditional the
+    counter diverges the first time ranks disagree about the branch:
+    every later unnamed collective then pairs tensor N on one rank with
+    tensor N+1 on another.  (A *rank*-dependent branch is the stronger
+    HVD001.)
+
+    Minimal failing example::
+
+        if loss_spiked:                  # can differ per rank
+            g = hvd.allreduce(g)         # unnamed: auto _seq diverges
+
+    Fix: pass ``name=`` so pairing is by name, not submission count —
+    or hoist the collective out of the branch.  Conditions that are
+    provably identical on every rank (``__name__`` guards,
+    ``hvd.size()`` probes, constants) are exempt."""
+    out: List[Finding] = []
+    fmap = astutil.enclosing_function_map(model)
+    seen: Set[int] = set()
+
+    def scan_branch(branch: List[ast.stmt], cond_line: int) -> None:
+        for stmt in branch:
+            for call in astutil.iter_calls(stmt):
+                if id(call) in seen:
+                    continue
+                if not astutil.is_collective_call(call, model):
+                    continue
+                seen.add(id(call))
+                if astutil.has_name_kwarg(call):
+                    continue
+                name = astutil.call_name(call)
+                out.append(make_finding(
+                    "HVD003", model, call.lineno, call.col_offset,
+                    f"unnamed collective '{name}' inside the "
+                    f"conditional at line {cond_line}: if ranks "
+                    f"disagree about the branch, auto-sequence names "
+                    f"diverge — pass name=",
+                    astutil.context_for_line(model, call.lineno, fmap),
+                ))
+
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.If):
+            if astutil.is_rank_dependent(node.test):
+                continue  # HVD001 territory
+            if astutil.is_rank_uniform_test(node.test):
+                continue
+            scan_branch(node.body, node.lineno)
+            scan_branch(node.orelse, node.lineno)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HVD004 — training entry point never syncs initial state
+# ---------------------------------------------------------------------------
+
+_SYNC_MARKERS = {
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_object", "broadcast_variables", "broadcast",
+    "broadcast_", "sync_state", "sync",
+    "BroadcastGlobalVariablesCallback", "BroadcastGlobalVariablesHook",
+}
+_TRAIN_MARKERS = {"DistributedOptimizer", "DistributedGradientTransform"}
+
+
+@rule("HVD004", "missing-initial-state-sync", SEV_WARNING,
+      "init()+DistributedOptimizer without broadcasting initial state")
+def hvd004(model: ModuleModel) -> List[Finding]:
+    """A training script that calls ``init()`` and wraps its optimizer
+    but never broadcasts/syncs initial state starts every rank from its
+    own random initialization: gradients get averaged across *different*
+    models, which converges worse or diverges silently — the classic
+    forgotten step 4 of the Horovod recipe.
+
+    Minimal failing example::
+
+        hvd.init()
+        tx = hvd.DistributedOptimizer(optax.adam(1e-3))
+        # ... training loop, no broadcast_parameters / state.sync
+
+    Fix: ``params = hvd.broadcast_parameters(params, root_rank=0)``
+    after ``init()`` (or adopt elastic ``state.sync()``)."""
+    init_call: Optional[ast.Call] = None
+    has_train = False
+    has_sync = False
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if name == "init":
+            recv = astutil.receiver_name(node)
+            if recv is None or recv in model.hvd_aliases:
+                if init_call is None:
+                    init_call = node
+        elif name in _TRAIN_MARKERS:
+            has_train = True
+        elif name in _SYNC_MARKERS:
+            has_sync = True
+    # Class references without a call (e.g. callbacks list) count too.
+    if not has_sync:
+        for node in ast.walk(model.tree):
+            if isinstance(node, ast.Attribute) and node.attr in _SYNC_MARKERS:
+                has_sync = True
+                break
+            if isinstance(node, ast.Name) and node.id in _SYNC_MARKERS:
+                has_sync = True
+                break
+    if init_call is None or not has_train or has_sync:
+        return []
+    fmap = astutil.enclosing_function_map(model)
+    return [make_finding(
+        "HVD004", model, init_call.lineno, init_call.col_offset,
+        "init() + DistributedOptimizer but no initial-state sync: add "
+        "broadcast_parameters(..., root_rank=0) (and "
+        "broadcast_optimizer_state for stateful optimizers) so every "
+        "rank starts from identical weights",
+        astutil.context_for_line(model, init_call.lineno, fmap),
+    )]
+
+
+# ---------------------------------------------------------------------------
+# HVD005 — rank()/size() at import time
+# ---------------------------------------------------------------------------
+
+
+@rule("HVD005", "topology-read-at-import", SEV_ERROR,
+      "rank()/size() called at module import time, before init()")
+def hvd005(model: ModuleModel) -> List[Finding]:
+    """Module-level ``rank()``/``size()`` runs at import time, before
+    any ``init()`` call — it raises ``NotInitializedError`` (or, in
+    lazy-init setups, silently captures a stale single-process
+    topology that never updates).
+
+    Minimal failing example::
+
+        import horovod_tpu as hvd
+        IS_CHIEF = hvd.rank() == 0     # import-time: init() not yet run
+
+    Fix: read the topology inside a function (or after the module-level
+    ``init()`` call, which is recognized)."""
+    out: List[Finding] = []
+    fmap = astutil.enclosing_function_map(model)
+    topo_names = astutil.RANK_CALL_NAMES | {
+        "size", "local_size", "cross_size", "num_devices",
+    }
+    init_seen_line: Optional[int] = None
+
+    def scan_stmts(stmts: List[ast.stmt]) -> None:
+        nonlocal init_seen_line
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # deferred execution: fine
+            if isinstance(stmt, (ast.If, ast.Try, ast.With, ast.For,
+                                 ast.While)):
+                scan_stmts(_stmt_children(stmt))  # still import-time
+                continue
+            for call in astutil.iter_calls(stmt):
+                name = astutil.call_name(call)
+                recv = astutil.receiver_name(call)
+                hvdish = (
+                    (recv is not None and recv in model.hvd_aliases)
+                    or (recv is None and name in model.from_imports)
+                )
+                if name == "init" and hvdish:
+                    if init_seen_line is None:
+                        init_seen_line = stmt.lineno
+                    continue
+                if name in topo_names and hvdish:
+                    if init_seen_line is not None:
+                        continue  # init() already ran at import time
+                    out.append(make_finding(
+                        "HVD005", model, call.lineno, call.col_offset,
+                        f"'{name}()' at module import time, before "
+                        f"init(): raises NotInitializedError (or "
+                        f"captures a stale topology) — move it inside "
+                        f"a function or after init()",
+                        astutil.context_for_line(model, call.lineno,
+                                                 fmap),
+                    ))
+
+    scan_stmts(model.tree.body)
+    return out
+
+
+def _stmt_children(stmt: ast.stmt) -> List[ast.stmt]:
+    out: List[ast.stmt] = []
+    for fld in ("body", "orelse", "finalbody"):
+        out.extend(getattr(stmt, fld, []) or [])
+    for handler in getattr(stmt, "handlers", []) or []:
+        out.extend(handler.body)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HVD006 — collective inside an except handler
+# ---------------------------------------------------------------------------
+
+
+@rule("HVD006", "collective-in-except-handler", SEV_ERROR,
+      "collective issued from an exception handler")
+def hvd006(model: ModuleModel) -> List[Finding]:
+    """An except block runs only on ranks where the try body raised —
+    a strict subset, chosen by runtime failure.  A collective there can
+    never complete: the healthy ranks are already past it (or parked in
+    the *next* collective, which now pairs with the wrong op).
+
+    Minimal failing example::
+
+        try:
+            step()
+        except Exception:
+            hvd.allreduce(loss)      # only failed ranks arrive
+
+    Fix: record the failure locally, exit the collective schedule
+    deterministically (e.g. ``hvd.join()`` outside the handler, or an
+    agreed sentinel allreduce issued by EVERY rank), then recover.
+    (Collectives in ``finally`` run on every path and are fine.)"""
+    out: List[Finding] = []
+    fmap = astutil.enclosing_function_map(model)
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        for stmt in node.body:
+            for call in astutil.iter_calls(stmt):
+                if astutil.is_collective_call(call, model):
+                    name = astutil.call_name(call)
+                    out.append(make_finding(
+                        "HVD006", model, call.lineno, call.col_offset,
+                        f"collective '{name}' inside an except handler "
+                        f"(line {node.lineno}): only ranks that raised "
+                        f"run it — the rest of the world never "
+                        f"arrives",
+                        astutil.context_for_line(model, call.lineno,
+                                                 fmap),
+                    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HVD007 — rank-dependent collective name
+# ---------------------------------------------------------------------------
+
+
+@rule("HVD007", "rank-dependent-collective-name", SEV_ERROR,
+      "collective name derived from the rank")
+def hvd007(model: ModuleModel) -> List[Finding]:
+    """Collectives pair across ranks BY NAME: a name containing the
+    rank gives every rank a different key, so nothing ever matches and
+    every rank hangs waiting for peers that are waiting right back.
+
+    Minimal failing example::
+
+        hvd.allreduce(g, name=f"grad_{hvd.rank()}")   # no two match
+
+    Fix: name by *tensor*, not by rank — the name must be identical on
+    every rank (``name="grad_w0"``)."""
+    out: List[Finding] = []
+    fmap = astutil.enclosing_function_map(model)
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not astutil.is_collective_call(node, model):
+            continue
+        expr = astutil.name_kwarg_expr(node)
+        if expr is None:
+            continue
+        if _mentions_rank(expr):
+            out.append(make_finding(
+                "HVD007", model, node.lineno, node.col_offset,
+                f"collective name {astutil.expr_text(expr)!r} depends "
+                f"on the rank: names must be identical on every rank "
+                f"or the collective never matches",
+                astutil.context_for_line(model, node.lineno, fmap),
+            ))
+    return out
+
+
+def _mentions_rank(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and \
+                astutil.call_name(node) in astutil.RANK_CALL_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "rank":
+            return True
+        if isinstance(node, ast.Name) and node.id == "rank":
+            return True
+    return False

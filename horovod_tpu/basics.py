@@ -260,10 +260,16 @@ def shutdown() -> None:
     client shutdown is owned by the process, as MPI_Finalize ownership is
     negotiated in the reference's MPIContextManager)."""
     global _topology
-    with _state_lock:
-        from . import _engine_registry  # noqa: PLC0415
+    from . import _engine_registry  # noqa: PLC0415
 
-        _engine_registry.shutdown_engine()
+    # Engine teardown happens OUTSIDE the state lock: it joins the
+    # background thread (bounded 30 s), and a wedged engine holding
+    # _state_lock that long would freeze every concurrent rank()/init()
+    # caller behind the teardown (hvdtpu-lint HVDC102).  Ordering is
+    # safe: the engine's own shutdown path never reads the topology
+    # state this lock guards.
+    _engine_registry.shutdown_engine()
+    with _state_lock:
         # The jax.distributed coordination service is deliberately left
         # running: rank 0 hosts it, and tearing it down here would kill
         # peers still mid-collective (uneven shutdown is normal — that's

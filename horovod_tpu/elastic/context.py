@@ -423,10 +423,14 @@ def context():
 def reset_context() -> None:
     """Drop the cached ambient context (tests, or re-launch in-process)."""
     global _current
+    # Detach under the lock, tear down outside it: stop_stream() joins
+    # the publisher thread and performs a final network publish, and a
+    # concurrent context() call would sit behind that for the whole
+    # join (hvdtpu-lint HVDC102).
     with _current_lock:
-        if _current is not None:
-            _current.stop_heartbeat()
-            from ..obs import stream as obs_stream  # noqa: PLC0415
+        ctx, _current = _current, None
+    if ctx is not None:
+        ctx.stop_heartbeat()
+        from ..obs import stream as obs_stream  # noqa: PLC0415
 
-            obs_stream.stop_stream()
-        _current = None
+        obs_stream.stop_stream()

@@ -170,7 +170,14 @@ class MetricsRegistry:
     (rare); updates on the returned instrument objects are lock-free."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # REENTRANT: snapshot()/instrument creation run on the signal
+        # death path (the registry dump is an on_death callback, and the
+        # live stream's final delta snapshots from inside the fatal-
+        # signal flush).  A signal landing while the owning thread is
+        # mid-_get would self-deadlock on a plain Lock — the same shape
+        # as PR-4's SIGTERM-inside-SIGUSR1 flush deadlock (hvdtpu-lint
+        # HVDC103).
+        self._lock = threading.RLock()
         self._instruments: Dict[Tuple, _Instrument] = {}
         self._collectors: List[Callable[["MetricsRegistry"], None]] = []
 
@@ -266,7 +273,10 @@ class MetricsRegistry:
 # -- process-global registry + env-driven exit dump -------------------------
 
 _registry: Optional[MetricsRegistry] = None
-_registry_lock = threading.Lock()
+# Reentrant for the same reason as flightrec's module locks: the death
+# flush calls get_registry()/dump_metrics() from signal context, and the
+# interrupted thread may be inside this very lock (hvdtpu-lint HVDC103).
+_registry_lock = threading.RLock()
 _atexit_installed = False
 
 

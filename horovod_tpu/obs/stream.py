@@ -282,7 +282,11 @@ def _death_flush() -> None:
 def stop_stream() -> None:
     """Stop the process publisher (tests, or in-process re-launch)."""
     global _current
+    # Detach under the lock, stop outside it: stop() joins the publisher
+    # thread and issues the final (network) publish — holding the lock
+    # through that would stall maybe_start_from_env()/_death_flush
+    # callers, including the fatal-signal flush (hvdtpu-lint HVDC102).
     with _current_lock:
-        if _current is not None:
-            _current.stop()
-            _current = None
+        pub, _current = _current, None
+    if pub is not None:
+        pub.stop()

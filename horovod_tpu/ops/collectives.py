@@ -448,7 +448,7 @@ def alltoall(tensor, *, axis_name: str = DP_AXIS,
         from . import eager  # noqa: PLC0415
 
         return _eager_tree(
-            tensor, name, lambda leaf, nm: eager.alltoall(leaf, nm)
+            tensor, name, lambda leaf, nm: eager.alltoall(leaf, name=nm)
         )
 
     def one(x):

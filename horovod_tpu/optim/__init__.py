@@ -280,12 +280,17 @@ def broadcast_parameters(params, root_rank: int = 0):
         # Leaves pass through as-is: jax.Array leaves ride the device data
         # plane (no host round-trip); scalars/lists are normalized here.
         leaves, treedef = jax.tree_util.tree_flatten(params)
+        # Explicit names: pairing by name (not the auto _seq counter)
+        # keeps the exchange robust if a caller wraps this in any
+        # conditional — flatten order is identical on every rank, so
+        # the index is a rank-stable key.
         handles = [
             eager.broadcast_async(
                 l if isinstance(l, (jax.Array, np.ndarray)) else np.asarray(l),
                 root_rank=root_rank,
+                name=f"hvd.bcast_param.{i}",
             )
-            for l in leaves
+            for i, l in enumerate(leaves)
         ]
         outs = [eager.synchronize(h) for h in handles]
         return jax.tree_util.tree_unflatten(treedef, outs)
@@ -336,15 +341,19 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
 
         # Two-phase: broadcast length, then the byte buffer (the reference
         # broadcasts a size tensor then the bytes, torch/__init__.py:627-641).
+        # Named so the two-phase exchange pairs by key on every rank
+        # even when a caller guards broadcast_object in a conditional.
         length = int(
             eager.broadcast(
-                np.asarray([len(payload)], np.int64), root_rank=root_rank
+                np.asarray([len(payload)], np.int64), root_rank=root_rank,
+                name="hvd.bcast_obj.len",
             )[0]
         )
         buf = np.zeros(length, np.uint8)
         if is_source:
             buf[:] = np.frombuffer(payload, np.uint8)
-        buf = eager.broadcast(buf, root_rank=root_rank)
+        buf = eager.broadcast(buf, root_rank=root_rank,
+                              name="hvd.bcast_obj.buf")
         return pickle.loads(np.asarray(buf).tobytes()) if length else None
     from jax.experimental import multihost_utils  # noqa: PLC0415
 

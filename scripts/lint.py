@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Dev-loop lint entry: the `make lint` equivalent.
+
+Runs hvdtpu-lint over the files changed vs HEAD (plus untracked) so the
+commit-time loop stays fast (<5 s on a typical diff); pass ``--all``
+for the full configured surface (what the CI gate runs), or forward any
+hvdtpu-lint flag verbatim (``--format json``, ``--rules HVD001``, ...).
+
+    python scripts/lint.py            # changed files only
+    python scripts/lint.py --all      # full surface, as CI runs it
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# Flags that consume the NEXT argument — their values must not be
+# mistaken for path arguments when deciding whether to default to
+# --changed ("--format json" carries no path).
+_VALUE_FLAGS = {"--format", "--baseline", "--rules", "--root",
+                "--write-baseline"}
+
+
+def _has_explicit_paths(args: list) -> bool:
+    skip_next = False
+    for a in args:
+        if skip_next:
+            skip_next = False
+            continue
+        if a in _VALUE_FLAGS:
+            skip_next = True
+            continue
+        if a.startswith("-"):
+            continue  # covers --flag=value spellings too
+        return True
+    return False
+
+
+def main(argv: list) -> int:
+    args = list(argv)
+    if "--all" in args:
+        args.remove("--all")
+    elif not _has_explicit_paths(args):
+        # no explicit paths: default to the fast changed-files mode
+        if "--changed" not in args:
+            args.append("--changed")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.call(
+        [sys.executable, "-m", "horovod_tpu.analysis", *args],
+        cwd=REPO, env=env,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,884 @@
+"""hvdtpu-lint test suite (ISSUE 5).
+
+Coverage contract (acceptance criteria):
+
+* every rule ID has at least one FIRING fixture and one NON-FIRING
+  fixture (parametrized below from ``FIXTURES`` — a new rule without
+  fixtures fails ``test_every_rule_has_fixtures``);
+* CLI behavior: exit codes, ``--format json`` schema, baseline
+  matching (reasoned entries only), inline suppression comments,
+  ``--rules`` filtering;
+* a regression case reproducing the PR-4 reentrant-flush deadlock
+  shape (SIGTERM-inside-SIGUSR1: a non-reentrant lock on the
+  signal-flush path), which HVDC103 must catch.
+
+Fixture sources live as string literals so the analyzer never sees
+them when linting tests/ itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import all_rules, analyze_paths
+from horovod_tpu.analysis.baseline import (
+    BASELINE_SCHEMA,
+    BaselineError,
+    load_baseline,
+)
+from horovod_tpu.analysis.config import load_config
+
+# ---------------------------------------------------------------------------
+# fixtures: rule id -> (firing source, clean source)
+# ---------------------------------------------------------------------------
+
+FIXTURES = {
+    "HVD001": (
+        """
+        import horovod_tpu as hvd
+
+        def step(x):
+            if hvd.rank() == 0:
+                return hvd.allreduce(x)
+            return x
+        """,
+        """
+        import horovod_tpu as hvd
+
+        def step(x):
+            total = hvd.allreduce(x)
+            if hvd.rank() == 0:
+                print(total)
+            return total
+        """,
+    ),
+    "HVD002": (
+        """
+        import horovod_tpu as hvd
+
+        def reduce_all(grads):
+            for k in {"w", "b"}:
+                grads[k] = hvd.allreduce(grads[k])
+        """,
+        """
+        import horovod_tpu as hvd
+
+        def reduce_all(grads):
+            for k in sorted({"w", "b"}):
+                grads[k] = hvd.allreduce(grads[k])
+        """,
+    ),
+    "HVD003": (
+        """
+        import horovod_tpu as hvd
+
+        def step(x, spiked):
+            if spiked:
+                x = hvd.allreduce(x)
+            return x
+        """,
+        """
+        import horovod_tpu as hvd
+
+        def step(x, spiked):
+            if spiked:
+                x = hvd.allreduce(x, name="spike_fix")
+            return x
+        """,
+    ),
+    "HVD004": (
+        """
+        import optax
+        import horovod_tpu as hvd
+
+        def main(params):
+            hvd.init()
+            tx = hvd.DistributedOptimizer(optax.adam(1e-3))
+            return tx.init(params)
+
+        if __name__ == "__main__":
+            main({})
+        """,
+        """
+        import optax
+        import horovod_tpu as hvd
+
+        def main(params):
+            hvd.init()
+            params = hvd.broadcast_parameters(params, root_rank=0)
+            tx = hvd.DistributedOptimizer(optax.adam(1e-3))
+            return tx.init(params)
+
+        if __name__ == "__main__":
+            main({})
+        """,
+    ),
+    "HVD005": (
+        """
+        import horovod_tpu as hvd
+
+        IS_CHIEF = hvd.rank() == 0
+        """,
+        """
+        import horovod_tpu as hvd
+
+        hvd.init()
+        IS_CHIEF = hvd.rank() == 0
+        """,
+    ),
+    "HVD006": (
+        """
+        import horovod_tpu as hvd
+
+        def step(x):
+            try:
+                return hvd.allreduce(x, name="g")
+            except Exception:
+                return hvd.allreduce(x, name="retry")
+        """,
+        """
+        import horovod_tpu as hvd
+
+        def step(x):
+            try:
+                return hvd.allreduce(x, name="g")
+            finally:
+                hvd.barrier()
+        """,
+    ),
+    "HVD007": (
+        """
+        import horovod_tpu as hvd
+
+        def step(x):
+            return hvd.allreduce(x, name=f"grad_{hvd.rank()}")
+        """,
+        """
+        import horovod_tpu as hvd
+
+        def step(x):
+            return hvd.allreduce(x, name="grad_w0")
+        """,
+    ),
+    "HVDC101": (
+        """
+        import threading
+
+        _table_lock = threading.Lock()
+        _stats_lock = threading.Lock()
+
+        def update_table():
+            with _table_lock:
+                with _stats_lock:
+                    pass
+
+        def update_stats():
+            with _stats_lock:
+                with _table_lock:
+                    pass
+        """,
+        """
+        import threading
+
+        _table_lock = threading.Lock()
+        _stats_lock = threading.Lock()
+
+        def update_table():
+            with _table_lock:
+                with _stats_lock:
+                    pass
+
+        def update_stats():
+            with _table_lock:
+                with _stats_lock:
+                    pass
+        """,
+    ),
+    "HVDC102": (
+        """
+        import threading
+        import time
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def cycle(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """,
+        """
+        import threading
+        import time
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def cycle(self):
+                with self._lock:
+                    pending = 1
+                time.sleep(1.0)
+                return pending
+        """,
+    ),
+    "HVDC103": (
+        """
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _flush():
+            with _lock:
+                pass
+
+        def _handler(signum, frame):
+            _flush()
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+        """,
+        """
+        import signal
+        import threading
+
+        _lock = threading.RLock()
+
+        def _flush():
+            with _lock:
+                pass
+
+        def _handler(signum, frame):
+            _flush()
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+        """,
+    ),
+    "HVDC104": (
+        """
+        import logging
+        import signal
+
+        LOG = logging.getLogger("x")
+
+        def _handler(signum, frame):
+            LOG.warning("dying")
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+        """,
+        """
+        import logging
+        import signal
+
+        LOG = logging.getLogger("x")
+
+        def _handler(signum, frame):
+            pass
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+            LOG.info("hooks installed")  # outside signal context
+        """,
+    ),
+    "HVDC105": (
+        """
+        import horovod_tpu as hvd
+
+        def step(g):
+            try:
+                return hvd.allreduce(g, name="g")
+            except Exception:
+                return g
+        """,
+        """
+        import horovod_tpu as hvd
+        from horovod_tpu.exceptions import HorovodShutdownError
+
+        def step(g):
+            try:
+                return hvd.allreduce(g, name="g")
+            except HorovodShutdownError:
+                raise
+            except Exception:
+                return g
+        """,
+    ),
+    "HVDC106": (
+        """
+        import time
+
+        from horovod_tpu.obs.flightrec import on_death
+
+        def _flush():
+            time.sleep(1.0)
+
+        def arm():
+            on_death(_flush)
+        """,
+        """
+        from horovod_tpu.obs.flightrec import on_death
+
+        def _flush():
+            pass
+
+        def arm():
+            on_death(_flush)
+        """,
+    ),
+    "HVDC107": (
+        """
+        import signal
+
+        def _handler(signum, frame):
+            events = []
+            while True:
+                events.append(frame)
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+        """,
+        """
+        import signal
+
+        def _handler(signum, frame):
+            events = []
+            while True:
+                events.append(frame)
+                if len(events) > 8:
+                    break
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+        """,
+    ),
+}
+
+
+def _lint_source(tmp_path, source, name="snippet.py", rules=None):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return analyze_paths([str(path)], root=str(tmp_path), rules=rules)
+
+
+def _new(findings, rule=None):
+    return [
+        f for f in findings
+        if f.status == "new" and (rule is None or f.rule == rule)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# per-rule firing / non-firing
+# ---------------------------------------------------------------------------
+
+
+def test_every_rule_has_fixtures():
+    missing = set(all_rules()) - set(FIXTURES)
+    assert not missing, f"rules without fixtures: {sorted(missing)}"
+    assert len(all_rules()) >= 12  # acceptance criterion
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_fires_on_bad_fixture(tmp_path, rule_id):
+    bad, _ = FIXTURES[rule_id]
+    findings = _lint_source(tmp_path, bad)
+    assert _new(findings, rule_id), (
+        f"{rule_id} did not fire; findings: "
+        f"{[(f.rule, f.message) for f in findings]}"
+    )
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_quiet_on_clean_fixture(tmp_path, rule_id):
+    _, good = FIXTURES[rule_id]
+    findings = _lint_source(tmp_path, good)
+    hits = _new(findings, rule_id)
+    assert not hits, (
+        f"{rule_id} fired on the clean fixture: "
+        f"{[f.message for f in hits]}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# rule-specific edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_hvd001_early_exit_guard(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        def save(x):
+            if hvd.rank() != 0:
+                return None
+            return hvd.allreduce(x)
+    """)
+    assert _new(findings, "HVD001")
+
+
+def test_hvd001_uniform_size_guard_ok(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        def maybe(x):
+            if hvd.size() > 1:
+                return hvd.allreduce(x)
+            return x
+    """)
+    assert not _new(findings, "HVD001")
+
+
+def test_hvd002_dict_items_fires_and_sorted_ok(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        def reduce_all(grads):
+            for k, v in grads.items():
+                grads[k] = hvd.allreduce(v)
+    """)
+    assert _new(findings, "HVD002")
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        def reduce_all(grads):
+            for k in sorted(grads.keys()):
+                grads[k] = hvd.allreduce(grads[k])
+    """)
+    assert not _new(findings, "HVD002")
+
+
+def test_hvd003_main_guard_exempt(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        if __name__ == "__main__":
+            hvd.init()
+            hvd.allreduce([1.0])
+    """)
+    assert not _new(findings, "HVD003")
+
+
+def test_hvd005_function_scope_ok(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        def who_am_i():
+            return hvd.rank()
+    """)
+    assert not _new(findings, "HVD005")
+
+
+def test_hvdc105_stored_exception_ok(tmp_path):
+    # checkpoint.py's deferred-error pattern: the handler KEEPS the
+    # exception (re-raised later) — not a swallow.
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        class Save:
+            def wait(self, g):
+                try:
+                    return hvd.allreduce(g, name="g")
+                except Exception as exc:
+                    self._error = exc
+                    return None
+    """)
+    assert not _new(findings, "HVDC105")
+
+
+def test_hvdc102_via_callee(tmp_path):
+    # The blocking call hides one call level down, same module.
+    findings = _lint_source(tmp_path, """
+        import threading
+
+        class Pub:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=lambda: None)
+
+            def _stop_worker(self):
+                self._thread.join(timeout=2)
+
+            def stop(self):
+                with self._lock:
+                    self._stop_worker()
+    """)
+    hits = _new(findings, "HVDC102")
+    assert hits and "join" in hits[0].message
+
+
+def test_thread_target_closure_not_signal_reachable(tmp_path):
+    # exec.py's mitigation pattern: the handler only SPAWNS a thread;
+    # the closure doing lock work runs outside signal context.
+    findings = _lint_source(tmp_path, """
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _handler(signum, frame):
+            def _work():
+                with _lock:
+                    pass
+            threading.Thread(target=_work, daemon=True).start()
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+    """)
+    assert not _new(findings, "HVDC103")
+
+
+# ---------------------------------------------------------------------------
+# PR-4 regression: the reentrant-flush deadlock shape
+# ---------------------------------------------------------------------------
+
+
+def test_pr4_reentrant_flush_deadlock_shape(tmp_path):
+    """The bug PR 4 fixed by hand: SIGUSR1's flush holds a module lock
+    when SIGTERM lands on the same thread; the SIGTERM handler re-enters
+    flush() and deadlocks on a non-reentrant Lock.  The signal pass must
+    flag the Lock (HVDC103) — and must go quiet once it is an RLock,
+    which is exactly the shipped fix in obs/flightrec.py."""
+    bad = """
+        import signal
+        import threading
+
+        _death_lock = threading.Lock()
+        _callbacks = []
+
+        def flush(trigger):
+            with _death_lock:
+                cbs = list(_callbacks)
+            for fn in cbs:
+                fn()
+
+        def _signal_handler(signum, frame):
+            flush(f"signal:{signum}")
+
+        def install_death_hooks():
+            for sig in (signal.SIGTERM, signal.SIGUSR1):
+                signal.signal(sig, _signal_handler)
+    """
+    findings = _lint_source(tmp_path, bad, name="flightrec_shape.py")
+    hits = _new(findings, "HVDC103")
+    assert hits, "the PR-4 deadlock shape must be rejected"
+    assert "_death_lock" in hits[0].message
+    fixed = bad.replace("threading.Lock()", "threading.RLock()")
+    findings = _lint_source(tmp_path, fixed, name="flightrec_shape.py")
+    assert not _new(findings, "HVDC103")
+
+
+def test_self_application_is_clean_against_baseline():
+    """The shipped tree lints clean: no new findings over horovod_tpu/
+    + examples/ + scripts/ once the committed baseline (reasoned false
+    positives only) is applied.  This is the acceptance criterion run
+    in-process."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = load_config(root)
+    findings = analyze_paths(cfg.paths, root=root, exclude=cfg.exclude)
+    baseline = load_baseline(os.path.join(root, cfg.baseline))
+    for f in findings:
+        if f.status == "new" and f.key() in baseline:
+            f.status = "baselined"
+    new = [f for f in findings if f.status == "new"]
+    assert not new, [
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in new
+    ]
+    # and the baseline itself carries a real reason per entry
+    for entry in baseline.values():
+        assert len(entry["reason"]) > 20
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_same_line_and_line_above(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        def a(x, cond):
+            if cond:
+                return hvd.allreduce(x)  # hvdtpu: disable=HVD003
+            return x
+
+        def b(x, cond):
+            if cond:
+                # hvdtpu: disable=HVD003
+                return hvd.allreduce(x)
+            return x
+    """)
+    assert not _new(findings, "HVD003")
+    assert sum(1 for f in findings if f.status == "suppressed") == 2
+
+
+def test_suppression_is_per_rule(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+
+        def a(x, cond):
+            if cond:
+                # hvdtpu: disable=HVD007
+                return hvd.allreduce(x)
+            return x
+    """)
+    assert _new(findings, "HVD003")  # wrong id: still fires
+
+
+def test_suppression_inside_string_literal_ignored(tmp_path):
+    findings = _lint_source(tmp_path, '''
+        import horovod_tpu as hvd
+
+        DOC = """example: # hvdtpu: disable=HVD003"""
+
+        def a(x, cond):
+            if cond:
+                return hvd.allreduce(x)
+            return x
+    ''')
+    assert _new(findings, "HVD003")
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, formats, baseline
+# ---------------------------------------------------------------------------
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+        env={**os.environ,
+             "PYTHONPATH": _REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+    )
+
+
+@pytest.fixture(scope="module")
+def cli_tmp(tmp_path_factory):
+    d = tmp_path_factory.mktemp("lint_cli")
+    (d / "bad.py").write_text(textwrap.dedent(FIXTURES["HVD001"][0]))
+    (d / "good.py").write_text(textwrap.dedent(FIXTURES["HVD001"][1]))
+    return d
+
+
+@pytest.mark.serial
+def test_cli_exit_codes(cli_tmp):
+    r = _run_cli(["good.py"], cwd=cli_tmp)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_cli(["bad.py"], cwd=cli_tmp)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "HVD001" in r.stdout
+
+
+@pytest.mark.serial
+def test_cli_json_schema(cli_tmp):
+    r = _run_cli(["bad.py", "--format", "json"], cwd=cli_tmp)
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["schema"] == "hvdtpu-lint-v1"
+    assert set(doc) >= {"schema", "rules", "findings", "summary"}
+    assert doc["summary"]["new"] >= 1
+    f = doc["findings"][0]
+    assert set(f) >= {"rule", "severity", "path", "line", "col",
+                      "message", "context", "status"}
+    assert doc["rules"]["HVD001"]["severity"] == "error"
+
+
+@pytest.mark.serial
+def test_cli_baseline_roundtrip(cli_tmp):
+    # findings baselined with a reason -> exit 0; reasonless -> exit 2
+    r = _run_cli(["bad.py", "--format", "json"], cwd=cli_tmp)
+    doc = json.loads(r.stdout)
+    entries = [
+        {"rule": f["rule"], "path": f["path"], "context": f["context"],
+         "reason": "test fixture: acknowledged on purpose"}
+        for f in doc["findings"]
+    ]
+    bl = cli_tmp / "bl.json"
+    bl.write_text(json.dumps(
+        {"schema": BASELINE_SCHEMA, "entries": entries}
+    ))
+    r = _run_cli(["bad.py", "--baseline", "bl.json"], cwd=cli_tmp)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "baselined" in r.stdout
+    # empty reason must be rejected (the "no unreasoned baseline" rule)
+    for e in entries:
+        e["reason"] = ""
+    bl.write_text(json.dumps(
+        {"schema": BASELINE_SCHEMA, "entries": entries}
+    ))
+    r = _run_cli(["bad.py", "--baseline", "bl.json"], cwd=cli_tmp)
+    assert r.returncode == 2
+    assert "reason" in r.stderr
+
+
+@pytest.mark.serial
+def test_cli_rules_filter_and_list(cli_tmp):
+    r = _run_cli(["bad.py", "--rules", "HVD005"], cwd=cli_tmp)
+    assert r.returncode == 0  # HVD001 finding filtered out
+    r = _run_cli(["--list-rules"], cwd=cli_tmp)
+    assert r.returncode == 0
+    for rid in FIXTURES:
+        assert rid in r.stdout
+    r = _run_cli(["bad.py", "--rules", "NOPE001"], cwd=cli_tmp)
+    assert r.returncode == 2
+
+
+def test_baseline_loader_rejects_missing_reason(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({
+        "schema": BASELINE_SCHEMA,
+        "entries": [{"rule": "HVD001", "path": "x.py",
+                     "context": "f"}],
+    }))
+    with pytest.raises(BaselineError):
+        load_baseline(str(p))
+
+
+def test_baseline_loader_rejects_wrong_schema(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"schema": "nope", "entries": []}))
+    with pytest.raises(BaselineError):
+        load_baseline(str(p))
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    findings = _lint_source(tmp_path, "def broken(:\n")
+    assert any(f.rule == "PARSE" for f in findings)
+
+
+def test_pyproject_config_is_read():
+    cfg = load_config(_REPO)
+    assert cfg.paths == ["horovod_tpu", "examples", "scripts"]
+    assert cfg.baseline == "horovod_tpu/analysis/baseline.json"
+
+
+def test_config_fallback_parser(tmp_path):
+    # the 3.10 path: no tomllib — the subset parser must read our block
+    from horovod_tpu.analysis.config import _read_table_fallback
+
+    p = tmp_path / "pyproject.toml"
+    p.write_text(textwrap.dedent("""
+        [project]
+        name = "x"
+
+        [tool.hvdtpu-lint]
+        paths = ["a", "b"]  # trailing comments are legal TOML
+        baseline = "bl.json"
+        exclude = [
+            "a/skip",  # and on list continuation lines too
+        ]
+    """))
+    table = _read_table_fallback(str(p), "tool.hvdtpu-lint")
+    assert table == {
+        "paths": ["a", "b"], "baseline": "bl.json",
+        "exclude": ["a/skip"],
+    }
+
+
+@pytest.mark.serial
+def test_cli_config_error_is_exit_2(tmp_path):
+    # A broken [tool.hvdtpu-lint] block must exit 2 (usage error), not
+    # crash with a traceback that exits 1 and reads as "findings".
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.hvdtpu-lint]
+        paths = [unquoted]
+    """))
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    r = _run_cli(["--root", str(tmp_path)], cwd=tmp_path)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "config" in r.stderr.lower()
+
+
+def test_suppression_scanner_survives_tokenize_divergence(tmp_path):
+    # ast.parse accepts some inputs the pure-Python tokenizer rejects
+    # with TokenError (e.g. an unterminated trailing line continuation);
+    # parse_suppressions must degrade to "no suppressions", not raise.
+    from horovod_tpu.analysis.core import parse_suppressions
+
+    assert parse_suppressions("x = 1\\") == {}
+
+
+@pytest.mark.serial
+def test_cli_rules_filter_does_not_report_stale_baseline(cli_tmp):
+    # A --rules run sees a rule subset; baseline entries for other
+    # rules must not be reported as stale ("fixed? remove it").
+    r = _run_cli(["bad.py", "--format", "json"], cwd=cli_tmp)
+    doc = json.loads(r.stdout)
+    entries = [
+        {"rule": f["rule"], "path": f["path"], "context": f["context"],
+         "reason": "test fixture: acknowledged on purpose"}
+        for f in doc["findings"]
+    ]
+    bl = cli_tmp / "bl_rules.json"
+    bl.write_text(json.dumps(
+        {"schema": BASELINE_SCHEMA, "entries": entries}
+    ))
+    r = _run_cli(
+        ["--rules", "HVD005", "--baseline", "bl_rules.json", "bad.py"],
+        cwd=cli_tmp,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no longer matches" not in r.stderr
+
+
+@pytest.mark.serial
+def test_cli_changed_without_git_is_exit_2(tmp_path):
+    (tmp_path / "x.py").write_text("x = 1\n")
+    r = _run_cli(["--changed", "--root", str(tmp_path)], cwd=tmp_path)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "git" in r.stderr
+
+
+def test_write_baseline_preserves_curated_reasons(tmp_path):
+    from horovod_tpu.analysis.baseline import (
+        load_baseline, write_baseline,
+    )
+    from horovod_tpu.analysis.core import Finding
+
+    f1 = Finding(rule="HVD001", severity="error", path="a.py", line=3,
+                 col=0, message="m1", context="f")
+    f2 = Finding(rule="HVD002", severity="warning", path="b.py", line=7,
+                 col=0, message="m2", context="g")
+    existing = {
+        f1.key(): {"rule": "HVD001", "path": "a.py", "context": "f",
+                   "reason": "curated justification, hand-written"},
+    }
+    out = tmp_path / "bl.json"
+    write_baseline(str(out), [f1, f2], reason="", existing=existing)
+    doc = json.loads(out.read_text())
+    by_rule = {e["rule"]: e for e in doc["entries"]}
+    # the pre-existing entry keeps its human reason...
+    assert by_rule["HVD001"]["reason"] == \
+        "curated justification, hand-written"
+    # ...and the new entry's empty reason still fails the loader
+    assert by_rule["HVD002"]["reason"] == ""
+    with pytest.raises(Exception):
+        load_baseline(str(out))
+
+
+def test_lint_script_flag_values_not_paths():
+    # "--format json" must NOT read 'json' as an explicit path (which
+    # would silently disable the default --changed fast mode).
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    try:
+        import lint as lint_script
+    finally:
+        sys.path.pop(0)
+    assert not lint_script._has_explicit_paths(["--format", "json"])
+    assert not lint_script._has_explicit_paths(
+        ["--rules", "HVD001", "--format=json"])
+    assert lint_script._has_explicit_paths(["horovod_tpu"])
+    assert lint_script._has_explicit_paths(["--format", "json", "a.py"])
