@@ -332,6 +332,104 @@ if [ -e "$PM_TMP/clean/postmortem.json" ]; then
 fi
 rm -rf "$PM_TMP"
 
+# Fastpath gate (ISSUE 6): on a stable 2-proc schedule the replay epoch
+# must make ≥95% of steady-state cycles skip negotiation entirely —
+# counter-based (engine.stats deltas after warmup), no timing flake —
+# and a seeded fault-registry delay mid-replay must break the epoch on
+# every rank instead of hanging.
+echo "== fastpath gate: steady-state negotiation skip + chaos break =="
+FP_TMP=$(mktemp -d)
+cat > "$FP_TMP/worker.py" <<'EOF'
+import json, os, sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import _engine_registry
+
+hvd.init()
+for i in range(30):  # warmup: negotiate, converge, enter replay
+    hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name="grad")
+eng = _engine_registry.get_engine()
+warm = dict(eng.stats)
+for i in range(200):  # steady state: must be negotiation-free
+    hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name="grad")
+steady = dict(eng.stats)
+doc = {"warm": warm, "steady": steady, "rank": hvd.rank()}
+with open(os.path.join(sys.argv[1], f"stats.rank{hvd.rank()}.json"), "w") as f:
+    json.dump(doc, f)
+hvd.shutdown()
+EOF
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+HVDTPU_EAGER_ENGINE=python \
+HVDTPU_EAGER_DEVICE=0 \
+HVDTPU_SCHEDULE_REPLAY_CYCLES=5 \
+HVDTPU_CYCLE_TIME=2 \
+    timeout 180 python -m horovod_tpu.run -np 2 python "$FP_TMP/worker.py" "$FP_TMP"
+python - "$FP_TMP" <<'EOF'
+import glob, json, sys
+
+dumps = sorted(glob.glob(f"{sys.argv[1]}/stats.rank*.json"))
+assert len(dumps) == 2, dumps
+for p in dumps:
+    doc = json.load(open(p))
+    warm, steady = doc["warm"], doc["steady"]
+    assert steady["replay_epochs"] >= 1, steady
+    d_cycles = steady["cycles"] - warm["cycles"]
+    d_neg = steady["negotiated_cycles"] - warm["negotiated_cycles"]
+    assert d_cycles > 0, (warm, steady)
+    ratio = d_neg / d_cycles
+    assert ratio <= 0.05, (
+        f"rank {doc['rank']}: {d_neg}/{d_cycles} steady-state cycles "
+        f"negotiated ({ratio:.1%} > 5%)")
+    print(f"fastpath gate rank {doc['rank']}: {d_neg}/{d_cycles} "
+          f"steady-state cycles negotiated ({ratio:.1%})")
+EOF
+echo "== fastpath gate: seeded delay breaks the epoch on every rank =="
+cat > "$FP_TMP/chaos.py" <<'EOF'
+import json, os, sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import _engine_registry
+
+hvd.init()
+for i in range(60):
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="grad")
+    assert float(out[0]) == 2.0
+eng = _engine_registry.get_engine()
+doc = {"stats": dict(eng.stats), "rank": hvd.rank()}
+with open(os.path.join(sys.argv[1], f"chaos.rank{hvd.rank()}.json"), "w") as f:
+    json.dump(doc, f)
+hvd.shutdown()
+EOF
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+HVDTPU_EAGER_ENGINE=python \
+HVDTPU_EAGER_DEVICE=0 \
+HVDTPU_SCHEDULE_REPLAY_CYCLES=5 \
+HVDTPU_CYCLE_TIME=2 \
+HVDTPU_STALL_CHECK_TIME_SECONDS=1 \
+HVDTPU_FAULT_SPEC="enqueue:rank=1:step=30:action=delay:2500" \
+    timeout 120 python -m horovod_tpu.run -np 2 python "$FP_TMP/chaos.py" "$FP_TMP"
+python - "$FP_TMP" <<'EOF'
+import glob, json, sys
+
+dumps = sorted(glob.glob(f"{sys.argv[1]}/chaos.rank*.json"))
+assert len(dumps) == 2, dumps
+for p in dumps:
+    doc = json.load(open(p))
+    s = doc["stats"]
+    assert s["replay_epochs"] >= 1, s
+    assert s["replay_breaks"] >= 1, (
+        f"rank {doc['rank']} never broke its replay epoch: {s}")
+    print(f"fastpath chaos rank {doc['rank']}: {s['replay_breaks']} "
+          f"break(s), {s['replay_cycles']} replay cycles — no hang")
+EOF
+rm -rf "$FP_TMP"
+
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must
 # recover via rollback + respawn (the example asserts it did).

@@ -522,6 +522,91 @@ def hvd007(model: ModuleModel) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# HVD008 — direct collective bypasses the replay-epoch deviation check
+# ---------------------------------------------------------------------------
+
+# Coordination-service collectives that reach the wire without passing
+# the eager engine's lookup()/deviation check.
+_DIRECT_COLLECTIVE_NAMES = {
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+}
+_MULTIHOST_MODULE = "jax.experimental.multihost_utils"
+# The engine's own negotiation/data transport: these ARE the sanctioned
+# submission path (the deviation check lives upstream of them).
+_HVD008_SANCTIONED = {
+    ("horovod_tpu/runtime/engine.py", "EagerEngine._exchange"),
+    ("horovod_tpu/runtime/engine.py", "EagerEngine._data_allgather"),
+}
+
+
+def _is_direct_collective(call: ast.Call, model: ModuleModel) -> bool:
+    name = astutil.call_name(call)
+    if name not in _DIRECT_COLLECTIVE_NAMES:
+        return False
+    recv = astutil.receiver_name(call)
+    if recv is not None:
+        target = model.module_aliases.get(recv, recv)
+        return (
+            target == _MULTIHOST_MODULE
+            or target.endswith("multihost_utils")
+        )
+    imported = model.from_imports.get(name)
+    return imported is not None and imported[0] == _MULTIHOST_MODULE
+
+
+@rule("HVD008", "replay-bypassing-collective", SEV_ERROR,
+      "direct coordination-service collective bypasses the engine's "
+      "replay deviation check")
+def hvd008(model: ModuleModel) -> List[Finding]:
+    """During a schedule-replay epoch the eager engine exchanges no
+    control vectors: correctness rests on every collective submission
+    flowing through the engine's ``lookup()``/deviation check, which
+    breaks the epoch *before* an unexpected collective reaches the
+    wire.  A direct coordination-service collective
+    (``multihost_utils.process_allgather`` / ``sync_global_devices`` /
+    ``broadcast_one_to_all``) issued from library code while an epoch
+    is open interleaves an unscheduled global exchange between the
+    memorized replay collectives — if any rank is meanwhile inside a
+    replay buffer, submission orders diverge and the job deadlocks
+    (HVD001's failure shape, hidden inside the library).
+
+    Minimal failing example::
+
+        from jax.experimental import multihost_utils
+
+        def checkpoint_barrier():
+            multihost_utils.sync_global_devices("ckpt")  # bypasses lookup()
+
+    Fix: route through the engine (``hvd.barrier()`` / ``hvd.*``
+    collectives) so the submission is negotiated — a cache MISS there
+    breaks the replay epoch deterministically — or baseline the site
+    with a written justification for why it can never overlap an open
+    epoch (engine transport itself, init/teardown-time only, or an
+    engine-absent fallback path)."""
+    out: List[Finding] = []
+    fmap = astutil.enclosing_function_map(model)
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_direct_collective(node, model):
+            continue
+        context = astutil.context_for_line(model, node.lineno, fmap)
+        if (model.relpath, context) in _HVD008_SANCTIONED:
+            continue
+        name = astutil.call_name(node)
+        out.append(make_finding(
+            "HVD008", model, node.lineno, node.col_offset,
+            f"direct coordination-service collective '{name}' is not "
+            f"routed through the engine's lookup()/deviation check: "
+            f"issued while a replay epoch is open, it interleaves an "
+            f"unscheduled exchange with the memorized schedule and can "
+            f"deadlock the world",
+            context,
+        ))
+    return out
+
+
 def _mentions_rank(expr: ast.expr) -> bool:
     for node in ast.walk(expr):
         if isinstance(node, ast.Call) and \

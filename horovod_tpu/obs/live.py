@@ -168,7 +168,48 @@ class LiveAggregator:
             )
         else:
             parts.append("straggler none")
+        tuner = self._tuner_part(views)
+        if tuner:
+            parts.append(tuner)
         return "live[" + time.strftime("%H:%M:%S") + "] " + " | ".join(parts)
+
+    @staticmethod
+    def _tuner_part(views) -> Optional[str]:
+        """One digest token for the rank-0 autotuner + replay fast path
+        (runtime/autotune.py gauges; absent when tuning is off), so an
+        operator watching the console sees what the tuner is doing and
+        how much negotiation the engine is skipping."""
+        from ..runtime.autotune import STATE_NAMES  # noqa: PLC0415
+
+        def metric(view, name):
+            for m in view.metrics.values():
+                if m.get("name") == name and not m.get("tags"):
+                    return m.get("value")
+            return None
+
+        for view in views.values():
+            state = metric(view, "autotune.state")
+            if state is None:
+                continue
+            bits = [
+                "tuner "
+                + STATE_NAMES.get(int(state), str(int(state)))
+                + f" f={metric(view, 'autotune.fusion_mb') or 0:.0f}MB"
+                + f" c={metric(view, 'autotune.cycle_ms') or 0:.1f}ms"
+            ]
+            reopens = metric(view, "autotune.reopens")
+            if reopens:
+                bits.append(f"reopens {int(reopens)}")
+            skip = metric(view, "engine.negotiation_skip_rate")
+            if skip is not None:
+                bits.append(f"neg-skip {skip:.0%}")
+            return " ".join(bits)
+        # no tuner: still surface the replay skip rate when present
+        for view in views.values():
+            skip = metric(view, "engine.negotiation_skip_rate")
+            if skip is not None:
+                return f"neg-skip {skip:.0%}"
+        return None
 
     # ---------------------------------------------------------- history
 

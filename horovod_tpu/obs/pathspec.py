@@ -26,7 +26,7 @@ import re
 from typing import Optional
 
 __all__ = ["resolve", "glob_pattern", "rank_of_path", "epoch_of_path",
-           "write_json_atomic"]
+           "epoch_tag", "write_json_atomic"]
 
 # Per-call uniquifier for tmp names: pid alone is not enough — a
 # signal-handler flush may reentrantly interrupt an in-progress dump on
@@ -82,6 +82,20 @@ def resolve(raw: str, stem: str, rank, epoch: Optional[str] = None) -> str:
         return os.path.join(raw, f"{stem}.{tag}.json")
     base, ext = os.path.splitext(raw)
     return f"{base}.{tag}{ext or '.json'}"
+
+
+def epoch_tag(path: str, epoch: Optional[str] = None) -> str:
+    """Insert the elastic-epoch tag (``.e<E>`` before the extension)
+    into ``path`` when an epoch is active; identity otherwise.  For
+    artifacts that are per-job rather than per-rank (the autotune CSV —
+    only rank 0 tunes): a respawned incarnation must append to its own
+    epoch's file, never clobber its dead predecessor's history."""
+    if epoch is None:
+        epoch = os.environ.get("HVDTPU_ELASTIC_EPOCH")
+    if epoch in (None, ""):
+        return path
+    base, ext = os.path.splitext(path)
+    return f"{base}.e{epoch}{ext}"
 
 
 def glob_pattern(raw: str, stem: str) -> str:

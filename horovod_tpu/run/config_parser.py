@@ -24,6 +24,13 @@ _ARG_ENV_MAP = {
         envmod.HIERARCHICAL_ALLREDUCE,
         "params.hierarchical-allreduce",
     ),
+    # --no-schedule-replay writes "0" into the positive env knob (see
+    # the inversion in set_env_from_args): one env var, default-on.
+    "no_schedule_replay": (envmod.SCHEDULE_REPLAY, "params.no-schedule-replay"),
+    "schedule_replay_cycles": (
+        envmod.SCHEDULE_REPLAY_CYCLES,
+        "params.schedule-replay-cycles",
+    ),
     "timeline_filename": (envmod.TIMELINE, "timeline.filename"),
     "timeline_mark_cycles": (envmod.TIMELINE_MARK_CYCLES, "timeline.mark-cycles"),
     "metrics_dump": (envmod.METRICS_DUMP, "metrics.dump"),
@@ -57,6 +64,14 @@ _ARG_ENV_MAP = {
         envmod.AUTOTUNE_GP_NOISE,
         "autotune.gaussian-process-noise",
     ),
+    "autotune_drift_threshold": (
+        envmod.AUTOTUNE_DRIFT_THRESHOLD,
+        "autotune.drift-threshold",
+    ),
+    "autotune_drift_samples": (
+        envmod.AUTOTUNE_DRIFT_SAMPLES,
+        "autotune.drift-samples",
+    ),
     "log_level": (envmod.LOG_LEVEL, "logging.level"),
 }
 
@@ -72,6 +87,9 @@ def set_env_from_args(env: Dict[str, str], args: argparse.Namespace) -> Dict[str
             continue
         if attr == "fusion_threshold_mb":
             value = int(value) * 1024 * 1024
+        if attr == "no_schedule_replay":
+            # negative flag onto the positive default-on env knob
+            value = "0"
         if value is True:
             value = "1"
         env[env_name] = str(value)

@@ -171,6 +171,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         "--hierarchical-allreduce", action=_StoreTrueOverrideAction,
         dest="hierarchical_allreduce", default=None,
     )
+    params.add_argument(
+        "--no-schedule-replay", action=_StoreTrueOverrideAction,
+        dest="no_schedule_replay", default=None,
+        help="Disable the steady-state schedule-replay fast path (after "
+             "K bitwise-identical cycles the engine skips negotiation "
+             "entirely and replays the memorized fused schedule; this "
+             "flag keeps the per-cycle control-vector exchange instead).",
+    )
+    params.add_argument(
+        "--schedule-replay-cycles", type=int, action=_StoreOverrideAction,
+        dest="schedule_replay_cycles", default=None,
+        help="Consecutive bitwise-identical cycles before a replay "
+             "epoch opens (default 50).",
+    )
 
     timeline = parser.add_argument_group("timeline")
     timeline.add_argument(
@@ -287,6 +301,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         action=_StoreOverrideAction,
         dest="autotune_gaussian_process_noise", default=None,
         help="GP observation-noise prior for the score surface",
+    )
+    autotune.add_argument(
+        "--autotune-drift-threshold", type=float,
+        action=_StoreOverrideAction,
+        dest="autotune_drift_threshold", default=None,
+        help="fractional throughput regression below the held peak that "
+             "counts as drift (default 0.2)",
+    )
+    autotune.add_argument(
+        "--autotune-drift-samples", type=int,
+        action=_StoreOverrideAction,
+        dest="autotune_drift_samples", default=None,
+        help="consecutive drifting score windows before the converged "
+             "tuner re-opens its search (default 3)",
     )
 
     logging_group = parser.add_argument_group("logging")

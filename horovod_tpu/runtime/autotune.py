@@ -18,14 +18,28 @@ CategoricalParameter / BayesianParameter split (parameter_manager.h:59-78).
 Parameter sync rides the negotiation: rank 0 attaches tuned params to its
 RequestList and every rank applies them on receipt (the descendant of the
 reference's param Bcast).
+
+Where this DEPARTS from the reference: the reference calls
+``SetAutoTuning(false)`` after one sweep and never moves again; this
+tuner is a *continuous controller*.  After the categorical sweep
+converges it holds the incumbent but keeps scoring every sample window —
+the objective is read from the engine's telemetry plane
+(``engine.fusion_bytes``/``engine.cycle_time_ms`` registry instruments:
+bytes moved per second of *busy* cycle time, so host idle between steps
+cannot convict a good parameter point) — and a drift detector re-opens
+the GP search when throughput shows sustained regression (elastic world
+change, workload phase change).  Tuner state is published as
+``autotune.*`` registry gauges, so ``/metrics`` and the live digest show
+what the tuner is doing at any moment.
 """
 
 from __future__ import annotations
 
 import csv
+import os
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -49,6 +63,28 @@ DEFAULT_WARMUP_SAMPLES = 3  # discarded while pipelines fill (reference WARMUPS)
 DEFAULT_STEPS_PER_SAMPLE = 10  # negotiation cycles per score sample
 DEFAULT_BAYES_SAMPLES_PER_CATEGORY = 12
 GP_NOISE = 1e-6
+
+# Drift detector defaults: re-open the search when the held incumbent's
+# score runs DRIFT_THRESHOLD (fraction) below the post-convergence peak
+# for DRIFT_SAMPLES consecutive sample windows.  20% x 3 windows ignores
+# ordinary jitter (shared-tunnel variance is ±3%, docs/performance.md)
+# while catching a real regime change within ~3 windows.
+DEFAULT_DRIFT_THRESHOLD = 0.2
+DEFAULT_DRIFT_SAMPLES = 3
+_HOLD_EWMA_ALPHA = 0.3
+_HOLD_LOG_EVERY = 50  # CSV decimation while holding (drift rows always log)
+
+# Tuner lifecycle states, published as the autotune.state gauge.
+STATE_WARMUP = 0
+STATE_SEARCHING = 1
+STATE_CONVERGED = 2
+STATE_RETUNING = 3
+STATE_NAMES = {
+    STATE_WARMUP: "warmup",
+    STATE_SEARCHING: "searching",
+    STATE_CONVERGED: "converged",
+    STATE_RETUNING: "retuning",
+}
 
 
 class GaussianProcess:
@@ -202,14 +238,27 @@ class TunedParams:
 
 
 class ParameterManager:
-    """Owns the engine tunables and drives the score→tune loop
-    (reference parameter_manager.h:59-78,178-220).
+    """Owns the engine tunables and drives the continuous score→tune loop
+    (reference parameter_manager.h:59-78,178-220, minus its one-shot
+    freeze).
 
     Usage (engine, rank 0 only):
-        pm = ParameterManager(enabled=..., initial=TunedParams(...))
-        pm.record_bytes(n)                 # per executed response
+        pm = ParameterManager(enabled=..., initial=TunedParams(...),
+                              metrics_source=...)
+        pm.record_bytes(n)                 # legacy scoring feed (no-op
+                                           # when metrics_source is set)
         new = pm.cycle()                   # per negotiation cycle;
                                            # returns TunedParams when moved
+
+    ``metrics_source`` is a zero-arg callable returning cumulative
+    ``(bytes_moved, busy_seconds)`` — the engine wires it to its
+    ``engine.fusion_bytes`` / ``engine.cycle_time_ms`` registry
+    instruments, making the telemetry plane the objective function.
+    Scoring on *busy* time (sum of measured cycle durations, no
+    inter-cycle sleep, no host idle between steps) is what keeps an
+    input-bound phase from convicting a good parameter point.  Without a
+    source the manager falls back to record_bytes() over wall-clock
+    spans (unit tests and the reference behavior).
     """
 
     def __init__(
@@ -221,6 +270,9 @@ class ParameterManager:
         steps_per_sample: Optional[int] = None,
         samples_per_category: Optional[int] = None,
         categories: Optional[List[Dict[str, bool]]] = None,
+        metrics_source: Optional[Callable[[], Tuple[float, float]]] = None,
+        drift_threshold: Optional[float] = None,
+        drift_samples: Optional[int] = None,
     ):
         # Sampling-window knobs resolve through the reference's env names
         # (common.h:67-69 HOROVOD_AUTOTUNE_{WARMUP_SAMPLES,STEPS_PER_SAMPLE,
@@ -243,6 +295,14 @@ class ParameterManager:
         # HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE): raise on noisy shared
         # machines so the tuner discounts sample-to-sample jitter.
         self._gp_noise = envmod.env_float(envmod.AUTOTUNE_GP_NOISE, 1e-4)
+        if drift_threshold is None:
+            drift_threshold = envmod.env_float(
+                envmod.AUTOTUNE_DRIFT_THRESHOLD, DEFAULT_DRIFT_THRESHOLD
+            )
+        if drift_samples is None:
+            drift_samples = envmod.env_int(
+                envmod.AUTOTUNE_DRIFT_SAMPLES, DEFAULT_DRIFT_SAMPLES
+            )
         # `categories` must list only configurations the owning engine
         # actually consumes — every category costs a full Bayesian sweep,
         # so exploring knobs with no consumer wastes 1/len(categories) of
@@ -260,37 +320,115 @@ class ParameterManager:
         self._category_i = 0
         self._bayes = BayesianOptimization(dims=2, seed=0, noise=self._gp_noise)
         self._per_category_samples = 0
-        self._done = False
         self._best: Tuple[float, TunedParams] = (-1.0, initial)
-        self._log_path = log_path
+
+        # Continuous-controller state.
+        self._state = STATE_WARMUP
+        self._source = metrics_source
+        self._src_bytes0 = 0.0
+        self._src_busy0 = 0.0
+        if metrics_source is not None:
+            self._src_bytes0, self._src_busy0 = metrics_source()
+        self.drift_threshold = float(drift_threshold)
+        self.drift_samples = int(drift_samples)
+        self._hold_ewma: Optional[float] = None
+        self._hold_peak = 0.0
+        self._drift_count = 0
+        self._hold_log_i = 0
+        self.reopens = 0
+        self._last_score = 0.0
+
+        # Gauges: the tuner's externally visible state (/metrics and the
+        # live digest read these; resolved once, updates are lock-free).
+        from ..obs import get_registry  # noqa: PLC0415
+
+        metrics = get_registry()
+        self._g_state = metrics.gauge("autotune.state")
+        self._g_last = metrics.gauge("autotune.last_score")
+        self._g_best = metrics.gauge("autotune.best_score")
+        self._g_fusion = metrics.gauge("autotune.fusion_mb")
+        self._g_cycle = metrics.gauge("autotune.cycle_ms")
+        self._g_cache = metrics.gauge("autotune.cache_enabled")
+        self._g_category = metrics.gauge("autotune.category")
+        self._g_samples = metrics.gauge("autotune.samples")
+        self._g_reopens = metrics.gauge("autotune.reopens")
+        self._publish()
+
+        # Tuning-history CSV: APPEND, with the header only on a fresh
+        # file, and epoch-tagged under the elastic launcher — an elastic
+        # respawn re-creates the engine (and this manager), and mode "w"
+        # here used to clobber the very tuning history that explains what
+        # the dead incarnation had learned.
+        self._log_path = None
         if log_path:
-            with open(log_path, "w", newline="") as f:
-                csv.writer(f).writerow(
-                    ["sample", "score_bytes_per_sec", "fusion_mb",
-                     "cycle_ms", "cache_enabled", "hierarchical_allreduce"]
-                )
+            from ..obs import pathspec  # noqa: PLC0415
+
+            log_path = pathspec.epoch_tag(log_path)
+            self._log_path = log_path
+            if (not os.path.exists(log_path)
+                    or os.path.getsize(log_path) == 0):
+                with open(log_path, "a", newline="") as f:
+                    csv.writer(f).writerow(
+                        ["sample", "score_bytes_per_sec", "fusion_mb",
+                         "cycle_ms", "cache_enabled",
+                         "hierarchical_allreduce", "state"]
+                    )
 
     # -------------------------------------------------------------- scoring
 
     def record_bytes(self, n: int) -> None:
         self._bytes += n
 
+    def _window_score(self) -> Tuple[float, float]:
+        """Close the current sample window; returns (score, bytes_moved).
+        Score is bytes per second of busy cycle time when a metrics
+        source is wired; bytes per wall-clock second otherwise."""
+        if self._source is not None:
+            bytes_now, busy_now = self._source()
+            d_bytes = bytes_now - self._src_bytes0
+            d_busy = busy_now - self._src_busy0
+            self._src_bytes0, self._src_busy0 = bytes_now, busy_now
+            self._bytes = 0
+            return (d_bytes / d_busy if d_busy > 0 else 0.0, d_bytes)
+        elapsed = time.monotonic() - self._sample_start
+        moved = self._bytes
+        score = self._bytes / elapsed if elapsed > 0 else 0.0
+        self._bytes = 0
+        return score, moved
+
     def cycle(self) -> Optional[TunedParams]:
-        """Advance one negotiation cycle; maybe emit new params to try."""
-        if not self.enabled or self._done:
+        """Advance one negotiation cycle; maybe emit new params to try.
+
+        Unlike the reference (SetAutoTuning(false) after one sweep),
+        this keeps running after convergence: held samples feed the
+        drift detector, which re-opens the search on sustained
+        regression."""
+        if not self.enabled:
             return None
         self._steps += 1
         if self._steps < self.steps_per_sample:
             return None
-        elapsed = time.monotonic() - self._sample_start
-        score = self._bytes / elapsed if elapsed > 0 else 0.0
-        self._bytes = 0
+        score, moved = self._window_score()
         self._steps = 0
         self._sample_start = time.monotonic()
+        if moved <= 0:
+            # Idle window (training paused: eval, checkpoint, input
+            # stall) — evidence of NOTHING.  Scoring it as 0 would feed
+            # garbage into the GP and, worse, convict a held incumbent
+            # of drift after any pause spanning drift_samples windows.
+            return None
         self._samples_seen += 1
+        self._last_score = score
         if self._samples_seen <= self.warmup_samples:
             return None
-        return self._tune(score)
+        if self._state == STATE_WARMUP:
+            self._state = STATE_SEARCHING
+        try:
+            if self._state == STATE_CONVERGED:
+                return self._hold(score)
+            return self._tune(score)
+        finally:
+            self._publish()
 
     # --------------------------------------------------------------- tuning
 
@@ -315,25 +453,112 @@ class ParameterManager:
         return int(fmb * 1024 * 1024), cms / 1000.0
 
     def _tune(self, score: float) -> Optional[TunedParams]:
+        """One SEARCHING/RETUNING sample: feed the GP, maybe move."""
         if score > self._best[0]:
             self._best = (score, self.current)
         self._log(score)
         self._bayes.add_sample(self._norm(self.current), score)
         self._per_category_samples += 1
         if self._per_category_samples >= self.samples_per_category:
+            self._per_category_samples = 0
+            if self._state == STATE_RETUNING:
+                # a re-opened search stays in the incumbent's category:
+                # one GP budget, then settle again
+                return self._converge()
             # advance the categorical chain; reset the continuous surface
             self._category_i += 1
-            self._per_category_samples = 0
             if self._category_i >= len(self.categories):
-                # converged: settle on the best configuration ever scored
-                self._done = True
-                self.current = self._best[1]
-                return self.current
+                return self._converge()
             self._bayes = BayesianOptimization(
                 dims=2, seed=self._category_i, noise=self._gp_noise
             )
         fusion_bytes, cycle_s = self._denorm(self._bayes.next_point())
-        cat = self.categories[min(self._category_i, len(self.categories) - 1)]
+        cat = self._probe_category()
+        self.current = TunedParams(
+            fusion_bytes=fusion_bytes, cycle_s=cycle_s, **cat
+        )
+        return self.current
+
+    def _probe_category(self) -> Dict[str, bool]:
+        """The categorical config the next continuous probe rides on:
+        the chain position while SEARCHING, the INCUMBENT's own config
+        while RETUNING — after a full sweep _category_i points past the
+        chain's end, and indexing the last entry would silently retune
+        in whatever category happened to be swept last (e.g. cache-off)
+        rather than the one the incumbent won with."""
+        if self._state == STATE_RETUNING:
+            return {
+                "cache_enabled": self._best[1].cache_enabled,
+                "hierarchical_allreduce":
+                    self._best[1].hierarchical_allreduce,
+            }
+        return self.categories[min(self._category_i,
+                                   len(self.categories) - 1)]
+
+    def _converge(self) -> Optional[TunedParams]:
+        """Settle on the best configuration scored and enter the hold
+        state (the reference stops here for good; we keep watching)."""
+        self._state = STATE_CONVERGED
+        # Seed the smoothed hold signal with the winning search score:
+        # it is evidence of the healthy level, but as an EWMA seed its
+        # weight decays 0.7^k per window, so a single lucky sample
+        # cannot permanently inflate the bar real windows are judged
+        # against (the perpetual-retune failure mode).
+        self._hold_ewma = self._best[0]
+        self._hold_peak = 0.0
+        self._drift_count = 0
+        # Emit the incumbent even if it equals the last point tried —
+        # peers apply params idempotently; returning None here would
+        # leave them on the final *probe* point forever.
+        self.current = self._best[1]
+        return self.current
+
+    def _hold(self, score: float) -> Optional[TunedParams]:
+        """One CONVERGED sample: hold the incumbent, watch for drift.
+        Drift is judged on the SMOOTHED signal (EWMA vs the peak the
+        EWMA itself reached), never on a raw window — one noisy window
+        in either direction moves the EWMA by at most alpha."""
+        if self._hold_ewma is None:
+            self._hold_ewma = score
+        else:
+            self._hold_ewma = (
+                _HOLD_EWMA_ALPHA * score
+                + (1 - _HOLD_EWMA_ALPHA) * self._hold_ewma
+            )
+        self._hold_peak = max(self._hold_peak, self._hold_ewma)
+        if self._hold_ewma < self._hold_peak * (1.0 - self.drift_threshold):
+            self._drift_count += 1
+        else:
+            self._drift_count = 0
+        # Hold-state logging is decimated: drifting windows are always
+        # interesting, otherwise one row per _HOLD_LOG_EVERY windows —
+        # the removed one-shot tuner stopped logging at convergence, and
+        # an unbounded per-window append would grow the CSV forever on
+        # long jobs.
+        self._hold_log_i += 1
+        if self._drift_count or self._hold_log_i % _HOLD_LOG_EVERY == 0:
+            self._log(score)
+        if self._drift_count < self.drift_samples:
+            return None
+        return self._reopen(score)
+
+    def _reopen(self, score: float) -> Optional[TunedParams]:
+        """Sustained regression: the world changed under the incumbent.
+        Restart the GP in the incumbent's category, seeded with the
+        incumbent at its CURRENT (regressed) score — the stale
+        pre-drift best would otherwise be unbeatable and the search
+        could never move."""
+        self._state = STATE_RETUNING
+        self.reopens += 1
+        self._drift_count = 0
+        self._per_category_samples = 0
+        self._best = (score, self.current)
+        self._bayes = BayesianOptimization(
+            dims=2, seed=100 + self.reopens, noise=self._gp_noise
+        )
+        self._bayes.add_sample(self._norm(self.current), score)
+        fusion_bytes, cycle_s = self._denorm(self._bayes.next_point())
+        cat = self._probe_category()
         self.current = TunedParams(
             fusion_bytes=fusion_bytes, cycle_s=cycle_s, **cat
         )
@@ -341,10 +566,26 @@ class ParameterManager:
 
     @property
     def converged(self) -> bool:
-        return self._done
+        return self._state == STATE_CONVERGED
+
+    @property
+    def state(self) -> int:
+        return self._state
 
     def best_score(self) -> float:
         return self._best[0]
+
+    def _publish(self) -> None:
+        self._g_state.set(self._state)
+        self._g_last.set(self._last_score)
+        self._g_best.set(self._best[0])
+        self._g_fusion.set(self.current.fusion_bytes / 1048576)
+        self._g_cycle.set(self.current.cycle_s * 1000)
+        self._g_cache.set(int(self.current.cache_enabled))
+        self._g_category.set(min(self._category_i,
+                                 len(self.categories) - 1))
+        self._g_samples.set(self._samples_seen)
+        self._g_reopens.set(self.reopens)
 
     def _log(self, score: float) -> None:
         if not self._log_path:
@@ -356,4 +597,5 @@ class ParameterManager:
                 round(p.fusion_bytes / 1048576, 2),
                 round(p.cycle_s * 1000, 3),
                 int(p.cache_enabled), int(p.hierarchical_allreduce),
+                STATE_NAMES[self._state],
             ])

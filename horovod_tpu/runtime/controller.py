@@ -62,6 +62,26 @@ class ControllerState:
     last_stall_check: float = field(default_factory=time.monotonic)
 
 
+_counter_cache: Optional[Tuple] = None
+
+
+def _negotiation_counters() -> Tuple:
+    """Cached (negotiation_cycles, requests_absorbed) counter handles.
+    Re-resolved when the process-global registry is swapped (tests call
+    reset_registry); otherwise one dict hit per process lifetime."""
+    global _counter_cache
+    from ..obs import get_registry  # noqa: PLC0415
+
+    reg = get_registry()
+    if _counter_cache is None or _counter_cache[0] is not reg:
+        _counter_cache = (
+            reg,
+            reg.counter("controller.negotiation_cycles"),
+            reg.counter("controller.requests_absorbed"),
+        )
+    return _counter_cache[1], _counter_cache[2]
+
+
 def _validate(requests: Dict[int, Request]) -> Optional[str]:
     """Consistency checks the reference performs in ConstructResponse
     (controller.cc:378-611): matching dtype, op params, shapes (allreduce:
@@ -146,6 +166,19 @@ def compute_responses(
     """
     state.cycle_index += 1
     cycle_now = time.monotonic()
+    # Launcher-visible negotiation counters: the per-rank metrics dump
+    # (and the live /metrics plane) carries how many cycles actually ran
+    # the deterministic controller and how many requests it absorbed —
+    # the denominator half of the replay fast path's skip-rate story
+    # (engine.stats.negotiated_cycles is the engine-side mirror; this
+    # one survives even when the engine object is torn down early).
+    # Handles resolved once (engine.py's "resolved once, updates are
+    # lock-free" convention): this runs on every negotiated cycle.
+    m_cycles, m_absorbed = _negotiation_counters()
+    m_cycles.inc()
+    absorbed = sum(len(rlist.requests) for rlist in all_lists)
+    if absorbed:
+        m_absorbed.inc(absorbed)
     # Absorb joins & shutdowns first (reference controller.cc:219-221,256-259).
     for rank, rlist in enumerate(all_lists):
         if rlist.shutdown:

@@ -102,6 +102,54 @@ _STAGEABLE_DTYPES = frozenset(
 )
 
 
+def _replay_plan_ok(plan: List[Response], world: int) -> bool:
+    """Whether a memorized schedule can carry the replay epoch-check
+    lane.  The flag rides the FIRST fused buffer as one extra scalar, so
+    that buffer's reduction must preserve "any rank set a nonzero flag"
+    as a nonzero output: SUM/AVERAGE over a non-bool dtype with nonzero
+    pre/post scales (int AVERAGE floor-divides and could round a lone
+    flag to zero).  Every field tested is negotiated — identical on all
+    ranks — so the qualification decision is too.  Gradient-training
+    schedules (allreduce-SUM/AVERAGE first) qualify; exotic schedules
+    simply never enter replay and keep the bit-vote fast path."""
+    if not plan:
+        return False
+    first = plan[0]
+    if first.response_type != ResponseType.ALLREDUCE:
+        return False
+    meta = getattr(first, "_fuse_meta", None)
+    if meta is None:
+        return False
+    dtype_name, reduce_op, pre, post = meta
+    from ..ops.collectives import ReduceOp as _R  # noqa: PLC0415
+
+    if reduce_op not in (int(_R.SUM), int(_R.AVERAGE)):
+        return False
+    if pre == 0.0 or post == 0.0:
+        return False
+    try:
+        wire = _np_dtype(dtype_name)
+    except Exception:
+        return False
+    if wire.kind == "b":
+        return False
+    if wire.kind in ("i", "u") and reduce_op == int(_R.AVERAGE):
+        return False
+    # float16's narrow exponent range can underflow a flag scaled by
+    # tiny pre/post factors on the plane paths (min subnormal ~6e-8),
+    # and AVERAGE divides by the world on top; bf16/f32 have f32-sized
+    # exponents and are safe for any realistic scale.  The raw-gather
+    # path appends the flag AFTER prescale, so only the plane-scaled
+    # paths need this.
+    if dtype_name == "float16":
+        scale = abs(pre * post)
+        if reduce_op == int(_R.AVERAGE):
+            scale /= max(world, 1)
+        if scale < 1e-6:
+            return False
+    return True
+
+
 def _is_device_tensor(tensor) -> bool:
     """Single-device jax.Array: the payload kind the device data plane can
     carry without a host round-trip.  Sharded arrays and host buffers take
@@ -182,6 +230,11 @@ class EagerEngine:
         self.stats = {
             "cycles": 0,
             "fast_cycles": 0,  # cycles with no payload exchange anywhere
+            "negotiated_cycles": 0,  # cycles that ran a control exchange
+            "replay_cycles": 0,  # zero-control-plane replay executions
+            "replay_idle_cycles": 0,  # replay cycles with nothing enqueued
+            "replay_epochs": 0,  # times the engine entered replay
+            "replay_breaks": 0,  # times a deviation broke an epoch
             "payload_cycles": 0,
             "control_bytes": 0,
             "payload_bytes": 0,
@@ -204,6 +257,7 @@ class EagerEngine:
         # published via a snapshot-time collector instead of mirrored
         # increments on the hot path.
         metrics = get_registry()
+        self._metrics = metrics
         self._m_cycle_ms = metrics.histogram("engine.cycle_time_ms")
         self._m_negotiate_ms = metrics.histogram("engine.negotiation_ms")
         self._m_fusion_bytes = metrics.histogram("engine.fusion_bytes")
@@ -249,28 +303,79 @@ class EagerEngine:
             self._device_plane = device_plane.build_plane()
         self._plane_ok_all = self._device_plane is not None
 
-        # Autotuner (reference parameter_manager.cc): rank 0 scores
-        # bytes/sec per sample window and proposes new params; peers apply
-        # whatever rides rank 0's RequestList.
+        # Stable-schedule replay fast path (ROADMAP item 1b; GSPMD's
+        # static-schedule guarantee recreated dynamically): after
+        # `replay_after` consecutive cycles whose executed schedule is
+        # bitwise-identical on every rank — a pure function of data all
+        # ranks share, so every rank flips in the same cycle — the engine
+        # stops exchanging control vectors entirely and replays the
+        # memorized fused schedule, re-validated per cycle by a one-scalar
+        # epoch-check lane on the first fused buffer (the same
+        # ride-the-data trick as the shutdown-flag propagation).  Any
+        # deviation (cache MISS/CONFLICT, new tensor, shutdown, join,
+        # tuner move, sustained stall) raises the lane and every rank
+        # falls back to full negotiation on the same cycle.
+        self.replay_enabled = (
+            self.world > 1
+            and envmod.env_bool(envmod.SCHEDULE_REPLAY, default=True)
+        )
+        self.replay_after = max(
+            2,
+            envmod.env_int(
+                envmod.SCHEDULE_REPLAY_CYCLES, envmod.DEFAULT_REPLAY_CYCLES
+            ),
+        )
+        self._replaying = False
+        self._replay_plan: Optional[List[Response]] = None
+        self._replay_names: frozenset = frozenset()
+        self._replay_idle_since: Optional[float] = None
+        self._stable_cycles = 0
+        self._last_sched_key: Optional[tuple] = None
+        # Epoch-check lane plumbing (_execute_allreduce): set for the
+        # first fused buffer of a replay cycle only.
+        self._replay_flag_lane: Optional[float] = None
+        self._replay_flag_total = 0.0
+
+        # Autotuner (reference parameter_manager.cc, reworked into a
+        # continuous controller): rank 0 scores bytes per second of BUSY
+        # cycle time — read straight off this engine's registry
+        # instruments, so the telemetry plane is the objective function —
+        # and proposes new params; peers apply whatever rides rank 0's
+        # RequestList.  After convergence it holds but keeps watching;
+        # a drift-detector reopen ships new params, which deterministically
+        # breaks any replay epoch (a tuner move is a deviation).
         self._pm: Optional[ParameterManager] = None
         self._pending_params: Optional[tuple] = None
         if self.rank == 0 and envmod.env_bool(envmod.AUTOTUNE):
             import os  # noqa: PLC0415
 
+            # Continuous knobs (fusion, cycle) plus the response-cache
+            # toggle — a real code path in this engine (the bit-vote
+            # fast path).  Hierarchical stays out: it is not a python-
+            # data-plane knob.  With schedule replay enabled the
+            # cache-off category is excluded too: disabling the cache
+            # forfeits the negotiation-free steady state by
+            # construction, so a sample window that happens to score
+            # cache-off ahead (loopback noise on small tensors) must
+            # not be able to freeze out the fast path.
+            categories = [
+                {"cache_enabled": True, "hierarchical_allreduce": False},
+            ]
+            if not self.replay_enabled:
+                categories.append(
+                    {"cache_enabled": False, "hierarchical_allreduce": False}
+                )
             self._pm = ParameterManager(
                 enabled=True,
                 initial=TunedParams(
                     fusion_bytes=self.fusion_bytes, cycle_s=self.cycle_s
                 ),
                 log_path=os.environ.get(envmod.AUTOTUNE_LOG) or None,
-                # Continuous knobs (fusion, cycle) plus the response-cache
-                # toggle — a real code path in this engine (the bit-vote
-                # fast path).  Hierarchical stays out: it is not a python-
-                # data-plane knob.
-                categories=[
-                    {"cache_enabled": True, "hierarchical_allreduce": False},
-                    {"cache_enabled": False, "hierarchical_allreduce": False},
-                ],
+                categories=categories,
+                metrics_source=(
+                    lambda fb=self._m_fusion_bytes, cy=self._m_cycle_ms:
+                    (fb.sum, cy.sum / 1e3)
+                ),
             )
 
     # ------------------------------------------------------------------ API
@@ -404,6 +509,16 @@ class EagerEngine:
                 self.stats["cache_hits"] / lookups
             )
         metrics.gauge("engine.fusion_threshold_bytes").set(self.fusion_bytes)
+        # The headline steady-state number: fraction of executed cycles
+        # that paid NO control-plane exchange (the CI fastpath gate and
+        # the bench record both read this).
+        if self.stats["cycles"]:
+            metrics.gauge("engine.negotiation_skip_rate").set(
+                1.0 - self.stats["negotiated_cycles"] / self.stats["cycles"]
+            )
+        metrics.gauge("engine.replay_active").set(
+            1.0 if self._replaying else 0.0
+        )
 
     # ------------------------------------------------------ background loop
 
@@ -433,13 +548,25 @@ class EagerEngine:
         self._done = True
 
     def _run_loop_once(self) -> bool:
-        """One cycle (reference RunLoopOnce, operations.cc:550).
+        """One cycle: the replay fast path when an epoch is open, the
+        negotiated path otherwise."""
+        if self._replaying:
+            return self._run_replay_once()
+        return self._run_negotiated_once()
 
-        Steady-state fast path (reference ComputeResponseList
+    def _run_negotiated_once(self) -> bool:
+        """One negotiated cycle (reference RunLoopOnce, operations.cc:550).
+
+        Steady-state fast path, tier 1 (reference ComputeResponseList
         controller.cc:174-202 + CacheCoordinator::sync): requests that hit
         the response cache only arm a slot bit; the cycle exchanges ONE
         fixed-size control vector, and full serialized RequestLists ride a
-        second exchange only when some rank actually has uncached work."""
+        second exchange only when some rank actually has uncached work.
+
+        Tier 2 — schedule replay — is armed HERE: every cycle's stability
+        is judged from the gathered control data (identical on all
+        ranks), and `replay_after` consecutive identical schedules flip
+        every rank into `_run_replay_once` on the same cycle."""
         self.timeline.mark_cycle()
         with self._lock:
             requests = list(self._pending)
@@ -490,6 +617,7 @@ class EagerEngine:
         self._m_negotiate_ms.observe((time.monotonic() - t_neg) * 1e3)
         self._m_queue_depth.set(len(self._table))
         self.stats["cycles"] += 1
+        self.stats["negotiated_cycles"] += 1
 
         state = self._controller
         state.shutdown_ranks.update(shutdown_ranks)
@@ -532,6 +660,7 @@ class EagerEngine:
         # is identical on every rank, so eviction stays coherent.
         protected = voted
 
+        fast = all_lists is None
         if all_lists is None:
             self.stats["fast_cycles"] += 1
             all_lists = [RequestList() for _ in range(self.world)]
@@ -599,7 +728,247 @@ class EagerEngine:
             proposal = self._pm.cycle()
             if proposal is not None:
                 self._pending_params = proposal.as_wire()
+
+        # ---- replay arming: judge this cycle's stability --------------
+        # Every input below is shared data (gathered control vector,
+        # deterministic cache/controller state) — deliberately NOT local
+        # facts like _pending_params, so the stability counters stay
+        # bitwise-identical on every rank and all ranks enter the replay
+        # epoch on the same cycle.  A rank-local fact (rank 0's fresh
+        # tuner proposal) surfaces as a deviation INSIDE the epoch
+        # instead, where the flag lane makes it global.
+        key = None
+        neutral = False
+        if (
+            self.replay_enabled
+            and self.cache_enabled
+            and fast                      # no payload exchanged anywhere
+            and not state.shutdown_ranks
+            and not state.joined_ranks
+            and not state.message_table   # no negotiation mid-flight
+        ):
+            if ready and set(ready) == voted:  # every armed slot completed
+                key = self._cache.schedule_key(ready)
+            elif not ready:
+                # Nothing EXECUTED this cycle (between steps, or an arm
+                # that straddled a cycle boundary and hasn't completed
+                # its vote yet): evidence of neither stability nor
+                # change — the same idle gap a replay epoch tolerates.
+                # Neutral: leave the counter and the last key alone.
+                # (Judged from the gathered bit matrix, so identical
+                # everywhere.)
+                neutral = True
+        if not neutral:
+            if key is not None and key == self._last_sched_key:
+                self._stable_cycles += 1
+            else:
+                self._stable_cycles = 1 if key is not None else 0
+            self._last_sched_key = key
+        if (
+            key is not None
+            and self._stable_cycles >= self.replay_after
+            and _replay_plan_ok(cached_responses, self.world)
+        ):
+            self._enter_replay(cached_responses)
         return not should_shutdown
+
+    # ------------------------------------------------------ schedule replay
+
+    def _enter_replay(self, plan: List[Response]) -> None:
+        """Open a replay epoch: memorize the fused schedule every rank
+        just executed identically `replay_after` times.  Called from the
+        negotiated path with arguments that are identical on every rank,
+        so every rank opens the epoch on the same cycle."""
+        self._replaying = True
+        self._replay_plan = list(plan)
+        self._replay_names = frozenset(
+            n for resp in plan for n in resp.tensor_names
+        )
+        self._replay_idle_since = None
+        self.stats["replay_epochs"] += 1
+        obs_flightrec.record(
+            "replay_enter", name=",".join(sorted(self._replay_names)),
+            cycle=self.stats["cycles"],
+            detail=f"{len(plan)} fused responses",
+        )
+        LOG.info(
+            "entering schedule-replay epoch after %d stable cycles "
+            "(%d fused responses, %d tensors)",
+            self._stable_cycles, len(plan), len(self._replay_names),
+        )
+
+    def _exit_replay(self, reason: str) -> None:
+        self._replaying = False
+        self._replay_plan = None
+        self._replay_names = frozenset()
+        self._replay_idle_since = None
+        self._stable_cycles = 0
+        self._last_sched_key = None
+        self.stats["replay_breaks"] += 1
+        self._metrics.counter("engine.replay_break", reason=reason).inc()
+        obs_flightrec.record(
+            "replay_break", name="", cycle=self.stats["cycles"],
+            detail=reason,
+        )
+        LOG.info("schedule-replay epoch broken: %s", reason)
+
+    def _run_replay_once(self) -> bool:
+        """One replay cycle: zero control-plane exchange.
+
+        Safety argument (docs/performance.md has the long form): the
+        epoch was entered by every rank on the same cycle from shared
+        data; inside it, every rank executes the same memorized fused
+        collectives in the same order, so the SPMD schedule stays
+        matched by construction.  Re-validation rides the FIRST fused
+        buffer: one extra scalar lane carries this rank's deviation
+        flag, the reduction makes the flag sum visible to everyone who
+        participates, and a nonzero sum means every rank discards the
+        cycle's data (a deviating rank contributed zeros), restores its
+        entries, and falls back to full negotiation — which is built
+        for skew, conflicts and shutdown.  A deviating or stalled rank
+        always still joins that first collective (flags up, zeros
+        down), so no peer is left blocked."""
+        self.timeline.mark_cycle()
+        now = time.monotonic()
+        plan = self._replay_plan
+        with self._lock:
+            requests = list(self._pending)
+            self._pending.clear()
+            shutdown = self._shutdown_requested
+            joined = self._joined
+            params_pending = self._pending_params is not None
+
+        deviation = None
+        leftovers: List[Request] = []
+        for req in requests:
+            status, _slot = (
+                self._cache.lookup(req)
+                if self.cache_enabled
+                else (rcache.MISS, -1)
+            )
+            if status == rcache.HIT and req.tensor_name in self._replay_names:
+                continue  # steady-state re-arm; its entry is in the table
+            leftovers.append(req)
+            deviation = "conflict" if status == rcache.CONFLICT else "miss"
+        if leftovers:
+            with self._lock:
+                # keep arrival order for the renegotiation that follows
+                self._pending[:0] = leftovers
+        if params_pending:
+            deviation = "tuner-move"
+        if joined:
+            deviation = "join"
+        if shutdown:
+            deviation = "shutdown"
+
+        if deviation is None:
+            with self._lock:
+                is_ready = all(
+                    n in self._table for n in self._replay_names
+                )
+            if not is_ready:
+                # Nothing (or not everything) enqueued yet.  Peers that
+                # are ready wait inside the first fused collective — the
+                # same wait slow-path negotiation would impose on them.
+                # Sustained idleness past the stall-warning budget breaks
+                # the epoch instead: long skew belongs to the
+                # skew-tolerant negotiated path.
+                if self._replay_idle_since is None:
+                    self._replay_idle_since = now
+                # Bounded even under --no-stall-check (stall_warn=inf):
+                # this deadline is replay's ONLY liveness escape — a
+                # ready or deviating peer is blocked inside the first
+                # fused collective until this rank joins it, and a flag
+                # that never comes would hang the world.  The negotiated
+                # path has no such wait (idle ranks still exchange
+                # control vectors), so disabling stall WARNINGS must not
+                # disable this.
+                if now - self._replay_idle_since > min(self.stall_warn, 60.0):
+                    deviation = "stall"
+                    LOG.warning(
+                        "replay epoch stalled for %.0f s waiting for "
+                        "local enqueues; breaking back to negotiation",
+                        now - self._replay_idle_since,
+                    )
+                else:
+                    self.stats["replay_idle_cycles"] += 1
+                    return True
+        self._replay_idle_since = None
+
+        first = plan[0]
+        my_flag = 1.0 if deviation else 0.0
+        if deviation:
+            # Participate with zeros: the nonzero flag makes everyone
+            # discard this cycle's data, so the lanes only need to be
+            # shaped right, not meaningful.
+            entries1: List[Optional[TensorTableEntry]] = (
+                [None] * len(first.tensor_names)
+            )
+        else:
+            with self._lock:
+                entries1 = [
+                    self._table.pop(n, None) for n in first.tensor_names
+                ]
+        self._replay_flag_lane = my_flag
+        self._replay_flag_total = 0.0
+        try:
+            try:
+                self._execute_allreduce(first, entries1)
+            finally:
+                self._replay_flag_lane = None
+        except BaseException:
+            # Transport failure mid-replay: put the popped entries back
+            # so the loop's _fail_all can fail their futures too.
+            with self._lock:
+                for e in entries1:
+                    if e is not None:
+                        self._table[e.request.tensor_name] = e
+            raise
+
+        self.stats["cycles"] += 1
+        if my_flag != 0.0 or self._replay_flag_total != 0.0:
+            # Epoch broken (locally or by a peer): the flag sum is the
+            # same for every participant, so every rank takes this
+            # branch on the same cycle.  _execute_allreduce skipped the
+            # scatter, so no future saw the discarded data.
+            with self._lock:
+                for e in entries1:
+                    if e is not None:
+                        self._table[e.request.tensor_name] = e
+                pending_names = {r.tensor_name for r in self._pending}
+                # Every planned tensor already enqueued locally goes back
+                # through negotiation (its request was consumed as a
+                # re-arm in some earlier replay cycle).
+                for name in sorted(self._replay_names):
+                    e = self._table.get(name)
+                    if e is not None and name not in pending_names:
+                        self._pending.append(e.request)
+            self._exit_replay(deviation or "peer-flag")
+            return True
+
+        # Clean replay cycle: deliver the rest of the memorized schedule.
+        self.stats["replay_cycles"] += 1
+        names = ",".join(first.tensor_names)
+        obs_flightrec.record(
+            "replay", name=names, cycle=self.stats["cycles"],
+            detail=first.response_type.name,
+        )
+        done = len(first.tensor_names)
+        self.stats["cached_responses"] += done
+        self._m_completed.inc(done)
+        self._m_fusion_bytes.observe(_response_bytes(first))
+        obs_progress.tick(done)
+        for resp in plan[1:]:
+            self._perform_operation(resp)
+            self.stats["cached_responses"] += len(resp.tensor_names)
+        if self._pm is not None:
+            for resp in plan:
+                self._pm.record_bytes(_response_bytes(resp))
+            proposal = self._pm.cycle()
+            if proposal is not None:
+                with self._lock:
+                    self._pending_params = proposal.as_wire()
+        return True
 
     def _check_armed_stalls(self, now: float) -> None:
         """Armed-but-unready slots live outside the controller's message
@@ -835,6 +1204,15 @@ class EagerEngine:
         )
 
     def _execute_allreduce(self, resp: Response, entries) -> None:
+        # Replay epoch-check lane: when set (first fused buffer of a
+        # replay cycle only), ONE extra scalar rides the buffer; after
+        # the reduction the flag sum is published to _replay_flag_total
+        # and a nonzero sum suppresses the scatter — the cycle's data is
+        # being discarded because some rank deviated and contributed
+        # zeros.  _replay_plan_ok guarantees the reduction preserves
+        # nonzero flags, and _scatter_results slices by negotiated
+        # offsets so the trailing lane never reaches a future.
+        flag_lane = self._replay_flag_lane
         meta = getattr(resp, "_fuse_meta", None)
         shapes = getattr(resp, "_shapes", [()] * len(resp.tensor_names))
         dtype_name, reduce_op, pre, post = (
@@ -876,6 +1254,8 @@ class EagerEngine:
                 else:
                     n = int(np.prod(shape)) if shape else 1
                     flats.append(jnp.zeros(n, wire_j))
+            if flag_lane is not None:
+                flats.append(jnp.full(1, flag_lane, wire_j))
             if len(flats) > 1:
                 try:
                     buf = jnp.concatenate(flats)
@@ -900,6 +1280,10 @@ class EagerEngine:
             self.stats["device_payload_bytes"] += (
                 int(total.size) * wire_dtype.itemsize
             )
+            if flag_lane is not None:
+                self._replay_flag_total = abs(float(np.asarray(total[-1])))
+                if self._replay_flag_total != 0.0:
+                    return  # epoch broken: discard
             self._scatter_results(entries, shapes, total)
             return
         # Fused buffer: concat all entries (MemcpyInFusionBuffer analog,
@@ -921,6 +1305,13 @@ class EagerEngine:
         # gloo_operations.cc:107-142).  64-bit dtypes stay on the exact
         # raw-bytes gather (jax without x64 would truncate them).
         if plane_ok and dtype_name in _STAGEABLE_DTYPES and self._use_staged():
+            if flag_lane is not None:
+                # The plane scales pre/post in a float accumulator;
+                # scaled ints never reach this path, so the flag
+                # survives any qualifying scale (see _replay_plan_ok).
+                buf = np.concatenate(
+                    [buf, np.full(1, flag_lane, wire_dtype)]
+                )
             total = np.asarray(
                 self._plane_allreduce(
                     jnp.asarray(buf), dtype_name, reduce_op, pre, post,
@@ -930,11 +1321,29 @@ class EagerEngine:
             self.stats["host_staged_ops"] += 1
             self.stats["host_wire_bytes"] += int(buf.nbytes)
             self.stats["host_recv_bytes"] += int(buf.nbytes)
+            if flag_lane is not None:
+                self._replay_flag_total = abs(float(total[-1]))
+                if self._replay_flag_total != 0.0:
+                    return  # epoch broken: discard
             self._scatter_results(entries, shapes, total)
             return
         if pre != 1.0:
             buf = (buf.astype(acc_dtype) * pre).astype(wire_dtype)
+        if flag_lane is not None:
+            # Appended AFTER the manual prescale: an int wire with a
+            # fractional pre would otherwise truncate a lone flag to 0
+            # and peers would silently scatter a deviating rank's zeros.
+            buf = np.concatenate([buf, np.full(1, flag_lane, wire_dtype)])
         gathered = self._data_allgather(buf)
+        if flag_lane is not None:
+            # Raw gather delivers per-rank rows pre-reduction: read every
+            # rank's flag exactly, then strip the lane before reducing.
+            self._replay_flag_total = float(
+                np.abs(gathered[:, -1].astype(np.float64)).sum()
+            )
+            gathered = gathered[:, :-1]
+            if self._replay_flag_total != 0.0:
+                return  # epoch broken: discard
         if reduce_op == int(_R.ADASUM):
             from ..ops.adasum import _numpy_adasum_rows  # noqa: PLC0415
 

@@ -84,6 +84,13 @@ class ResponseCache:
         self._slots: Dict[int, _Slot] = {}
         self._by_name: Dict[str, int] = {}
         self._tick = 0
+        # Content-mutation counter: bumped on every insert/evict (NOT on
+        # LRU touches, which don't change what a slot means).  All
+        # mutations derive from data every rank observes identically, so
+        # the counter is bitwise-identical everywhere — which is what
+        # lets (mutations, slot list) serve as an exact fingerprint of
+        # an executed schedule for the replay fast path.
+        self._mutations = 0
         # Slots shielded from LRU eviction this cycle (slots some rank is
         # actively voting on — set by the engine from the gathered bit
         # matrix, which is identical on every rank, keeping eviction
@@ -117,6 +124,16 @@ class ResponseCache:
         slot = self._by_name.pop(name, None)
         if slot is not None:
             del self._slots[slot]
+            self._mutations += 1
+
+    def schedule_key(self, slots) -> tuple:
+        """Exact fingerprint of the cached schedule ``slots`` (a sorted
+        slot-index iterable): identical across cycles iff the executed
+        schedule is bitwise-identical.  The mutation counter folds in
+        slot *content*: a conflict-evict-reinsert that reuses the same
+        index still changes the key.  Coherent across ranks because
+        every mutation is (see the module docstring)."""
+        return (self._mutations, tuple(slots))
 
     def insert(self, req: Request, resp: Response) -> None:
         """Insert a freshly negotiated (pre-fusion) response.  Called in
@@ -136,9 +153,11 @@ class ResponseCache:
             victim = min(victims, key=lambda s: self._slots[s].lru_tick)
             del self._by_name[self._slots[victim].tensor_name]
             del self._slots[victim]
+            self._mutations += 1
         # lowest free slot: deterministic allocation
         slot = next(i for i in range(self.capacity) if i not in self._slots)
         self._tick += 1
+        self._mutations += 1
         self._slots[slot] = _Slot(
             signature=request_signature(req),
             response_type=resp.response_type,

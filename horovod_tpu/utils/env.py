@@ -28,6 +28,24 @@ AUTOTUNE_WARMUP_SAMPLES = "HVDTPU_AUTOTUNE_WARMUP_SAMPLES"
 AUTOTUNE_STEPS_PER_SAMPLE = "HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE"
 AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 AUTOTUNE_GP_NOISE = "HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+# Online-tuner drift detector (no reference analog: the reference tunes
+# once and freezes, parameter_manager.cc SetAutoTuning(false); ours keeps
+# scoring after convergence and re-opens the GP search when throughput
+# regresses by DRIFT_THRESHOLD (fraction) for DRIFT_SAMPLES consecutive
+# score windows — elastic world changes and workload phase changes move
+# the optimum, and a frozen tuner would hold a stale incumbent forever).
+AUTOTUNE_DRIFT_THRESHOLD = "HVDTPU_AUTOTUNE_DRIFT_THRESHOLD"
+AUTOTUNE_DRIFT_SAMPLES = "HVDTPU_AUTOTUNE_DRIFT_SAMPLES"
+# Steady-state schedule replay (GSPMD-style static schedule, recreated
+# dynamically): after REPLAY_CYCLES consecutive cycles whose executed
+# schedule is bitwise-identical on every rank, the engine stops
+# exchanging control vectors and replays the memorized fused schedule,
+# re-validated by a one-scalar epoch-check lane on the first fused
+# buffer of each cycle.  SCHEDULE_REPLAY=0 (--no-schedule-replay) opts
+# out; any deviation breaks the epoch back to full negotiation.
+SCHEDULE_REPLAY = "HVDTPU_SCHEDULE_REPLAY"
+SCHEDULE_REPLAY_CYCLES = "HVDTPU_SCHEDULE_REPLAY_CYCLES"
+DEFAULT_REPLAY_CYCLES = 50
 LOG_LEVEL = "HVDTPU_LOG_LEVEL"
 # Device-resident eager data plane (no reference analog by name: the
 # reference's equivalent switch is compile-time HOROVOD_GPU_ALLREDUCE).

@@ -166,6 +166,20 @@ FIXTURES = {
             return hvd.allreduce(x, name="grad_w0")
         """,
     ),
+    "HVD008": (
+        """
+        from jax.experimental import multihost_utils
+
+        def checkpoint_barrier():
+            multihost_utils.sync_global_devices("ckpt")
+        """,
+        """
+        import horovod_tpu as hvd
+
+        def checkpoint_barrier():
+            hvd.barrier()
+        """,
+    ),
     "HVDC101": (
         """
         import threading

@@ -642,7 +642,8 @@ def test_bench_regression_gate(tmp_path):
         {"metric": "m", "value": 99.0, "mfu": 0.22, "device": dev},
         record_dir=str(tmp_path))
     assert out["baseline_record"] == {
-        "file": "BENCH_r02.json", "stale_records_skipped": 1, "stale": True}
+        "file": "BENCH_r02.json", "stale_records_skipped": 1,
+        "degraded_records_skipped": 0, "stale": True}
     assert out["deltas"]["value"]["pct"] == -10.0
     assert out["regression"] is True
 
