@@ -90,7 +90,7 @@ if [ "${1:-full}" = "quick" ]; then
     # paths only stay honest while the chaos tests that drive them
     # (ISSUE 1 acceptance) are exercised on every commit.
     echo "== quick tier: elastic fault-tolerance + injection paths =="
-    python -m pytest tests/test_elastic.py \
+    python -m pytest tests/test_elastic.py tests/test_ckpt.py \
         "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks" \
         -x -q
     echo "== quick tier: observability plane =="
@@ -101,6 +101,7 @@ if [ "${1:-full}" = "quick" ]; then
     # above — don't pay for the multiprocess chaos cases twice per commit.
     python -m pytest tests/ -x -q -m "not full" \
         --ignore=tests/test_elastic.py \
+        --ignore=tests/test_ckpt.py \
         --ignore=tests/test_obs.py \
         --ignore=tests/test_obs_live.py \
         --ignore=tests/test_postmortem.py \
@@ -429,6 +430,91 @@ for p in dumps:
           f"break(s), {s['replay_cycles']} replay cycles — no hang")
 EOF
 rm -rf "$FP_TMP"
+
+# Checkpoint/recovery gate (ISSUE 7): the ckpt unit suite, hvdtpu-lint
+# clean over the new subsystem specifically, and a 2-proc elastic chaos
+# run — a seeded mid-epoch kill must be recovered by the respawned
+# incarnation restoring from its peer's IN-MEMORY replica (provenance
+# says peer, the replica specifically, never disk) inside the recovery
+# budget, the job must finish with the right state, and the sharded
+# manifest written along the way must be schema-valid.
+echo "== ckpt gate: unit suite + lint over the subsystem =="
+python -m pytest tests/test_ckpt.py -x -q
+python -m horovod_tpu.analysis horovod_tpu/ckpt \
+    --baseline horovod_tpu/analysis/baseline.json
+echo "== ckpt gate: chaos — peer-sourced restore within budget =="
+CK_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 180 python - "$CK_TMP" <<'EOF'
+import sys
+
+import numpy as np
+
+import horovod_tpu.elastic as elastic
+from horovod_tpu import ckpt
+
+tmp = sys.argv[1]
+ckpt_dir = f"{tmp}/shards"
+
+
+def train(total_steps=8, directory=ckpt_dir):
+    import numpy as np  # noqa: PLC0415
+
+    import horovod_tpu.elastic as elastic  # noqa: PLC0415
+
+    ctx = elastic.context()
+    state = elastic.State(w=np.zeros(4, dtype=np.float64), step=0)
+
+    @elastic.run
+    def loop(state):
+        while state.step < total_steps:
+            grad = np.full(4, float(state.step + 1) * (ctx.rank + 1))
+            state.w = state.w - 0.1 * ctx.allreduce(
+                grad, name=f"g{state.step}")
+            state.step += 1
+            state.commit()
+            if state.step == 2:
+                # disk tier: every rank writes only its own shard,
+                # rank 0 commits the manifest last
+                state.save_sharded(directory).wait()
+        return state.step, state.last_restore
+
+    return loop(state)
+
+
+env = {"JAX_PLATFORMS": "cpu", "HVDTPU_CKPT_REPLICA": "1",
+       "HVDTPU_CKPT_DIR": ckpt_dir,
+       "HVDTPU_FAULT_SPEC": "worker_exit:step=5:rank=1"}
+results, job = elastic.launch(train, np=2, env=env, max_retries=2,
+                              timeout=120)
+
+assert sorted(results) == [0, 1], results
+assert all(results[r][0] == 8 for r in results), results
+assert [e[0] for e in job.trace].count("respawn") == 1, job.trace
+
+prov = results[1][1]
+assert prov and prov["source"] == "peer", (
+    f"respawned rank restored from {prov}, expected the peer tier")
+assert prov["replica_adopted"] is True, (
+    f"restore did not come from the in-memory replica: {prov}")
+assert prov["ms"] < 10_000, f"recovery took {prov['ms']:.0f} ms"
+
+manifest = ckpt.load_manifest(ckpt_dir, 2)
+assert manifest is not None, "no committed manifest at step 2"
+assert manifest["schema"] == "hvdtpu-sharded-ckpt-v1", manifest["schema"]
+assert manifest["world_size"] == 2, manifest
+assert len(manifest["shards"]) == 2, manifest
+for s in manifest["shards"]:
+    assert len(s["checksum"]) == 64, s
+owned = sorted(i for s in manifest["shards"] for i in s["leaves"])
+assert owned == list(range(manifest["num_leaves"])), manifest
+state = ckpt.restore_sharded(ckpt_dir, step=2)
+print(f"ckpt gate OK: rank 1 restored from its peer replica in "
+      f"{prov['ms']:.0f} ms; manifest valid "
+      f"({manifest['num_leaves']} leaves over 2 shards)")
+EOF
+rm -rf "$CK_TMP"
 
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must

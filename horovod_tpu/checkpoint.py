@@ -105,10 +105,15 @@ class LocalStore(Store):
             return f.read()
 
     def write_bytes(self, path: str, data: bytes) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        # The shared atomic helper (obs/pathspec.py): per-call-unique
+        # tmp name + os.replace + tmp cleanup on failure, the same
+        # discipline shard writes and every obs artifact use — a crash
+        # mid-save can never leave a torn file (or a stale ``.tmp``
+        # that two concurrent writers would race on) for a later
+        # reader to select.
+        from .obs.pathspec import write_bytes_atomic  # noqa: PLC0415
+
+        write_bytes_atomic(path, data)
 
     def delete(self, path: str) -> None:
         if os.path.isdir(path):

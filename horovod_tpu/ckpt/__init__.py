@@ -1,0 +1,48 @@
+"""Sharded checkpoints + peer-replica recovery (ISSUE 7).
+
+Two coupled tiers above the rank-0 orbax path in ``checkpoint.py``:
+
+* :mod:`~horovod_tpu.ckpt.sharded` — every rank writes only its own
+  shard (atomic, checksummed), rank 0 commits a manifest LAST, restore
+  reassembles and reshards across world-size changes (N -> M).
+* :mod:`~horovod_tpu.ckpt.replica` — each rank mirrors its committed
+  shard to its ring neighbor's key over the HMAC-signed KV path, so a
+  respawned rank restores from a live peer replica in seconds and
+  touches disk only when no peer holds a valid copy.
+
+``elastic.State`` routes commit/restore/sync through both tiers; the
+restore *provenance* (``peer`` / ``disk`` / ``none``) is recorded in
+the metrics registry and the flight recorder and surfaced by the
+post-mortem analyzer.  See docs/checkpoint.md.
+"""
+
+from .replica import ReplicaTier, tier_from_env  # noqa: F401
+from .sharded import (  # noqa: F401
+    MANIFEST,
+    SCHEMA,
+    ShardCorruptError,
+    ShardedSave,
+    latest_step,
+    list_steps,
+    load_manifest,
+    restore_sharded,
+    save_sharded,
+    save_sharded_async,
+    shard_assignment,
+)
+
+__all__ = [
+    "MANIFEST",
+    "SCHEMA",
+    "ShardCorruptError",
+    "ShardedSave",
+    "ReplicaTier",
+    "tier_from_env",
+    "latest_step",
+    "list_steps",
+    "load_manifest",
+    "restore_sharded",
+    "save_sharded",
+    "save_sharded_async",
+    "shard_assignment",
+]

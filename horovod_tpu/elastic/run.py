@@ -59,7 +59,22 @@ def run(fn):
         while True:
             try:
                 ctx.rendezvous()
+                before = state.last_restore
                 state.sync(ctx)
+                prov = state.last_restore
+                if prov is not None and prov is not before \
+                        and prov["source"] != "none":
+                    # The one-line operator answer to "where did this
+                    # incarnation's state come from, and how long did
+                    # recovery take" (the full story is in the flight
+                    # recorder / post-mortem).
+                    LOG.info(
+                        "rank %s recovered state at commit %d from %s "
+                        "in %.0f ms", getattr(ctx, "rank", 0),
+                        prov["commits"],
+                        "peer replica" if prov.get("replica_adopted")
+                        else prov["source"], prov["ms"],
+                    )
                 return fn(state, *args, **kwargs)
             except RankDroppedError:
                 # The launcher shrank the world past this rank; no

@@ -37,6 +37,14 @@ def main() -> int:
             "launcher (HVDTPU_ELASTIC_KV unset)"
         )
     ctx.start_heartbeat()
+    from ..utils import env as envmod
+
+    if envmod.env_bool(envmod.CKPT_REPLICA):
+        # First question the recovery runbook asks of a slow restore:
+        # was the replica tier even armed on this incarnation?  Put the
+        # answer in the black box, not in launcher-flag archaeology.
+        flightrec.record("init", name="ckpt_replica",
+                         detail=f"armed rank={ctx.rank} epoch={ctx.epoch}")
     maybe_fail("task_fn", rank=ctx.rank)
     blob = ctx.kv.wait(_SCOPE, "func", timeout=60)
     func, args, kwargs = cloudpickle.loads(blob)

@@ -16,8 +16,10 @@ this module is it:
   loop.  Events are structured ``(seq, t, kind, name, cycle, detail)``
   tuples: collective enqueue/negotiate/execute/complete with op name and
   negotiation cycle, engine phase transitions, elastic rendezvous/epoch
-  events, checkpoint begin/commit, fault injections, and the last
-  exception.
+  events, checkpoint begin/shard/commit plus the recovery tier's
+  ``ckpt.replica_push`` / ``ckpt.restore`` (whose ``source=peer|disk|
+  none`` detail is the restore-provenance record the post-mortem
+  analyzer surfaces), fault injections, and the last exception.
 * **A shared death-path flush** — :func:`flush` dumps the ring (when
   ``HVDTPU_FLIGHTREC_DUMP`` names a target) and then runs every
   registered :func:`on_death` callback (the metrics-registry dump and

@@ -171,6 +171,9 @@ class LiveAggregator:
         tuner = self._tuner_part(views)
         if tuner:
             parts.append(tuner)
+        ckpt = self._ckpt_part(views)
+        if ckpt:
+            parts.append(ckpt)
         return "live[" + time.strftime("%H:%M:%S") + "] " + " | ".join(parts)
 
     @staticmethod
@@ -210,6 +213,46 @@ class LiveAggregator:
             if skip is not None:
                 return f"neg-skip {skip:.0%}"
         return None
+
+    @staticmethod
+    def _ckpt_part(views) -> Optional[str]:
+        """One digest token for the checkpoint/replica tier (ckpt/):
+        how many recoveries sourced from a live peer vs disk, and the
+        replica-push latency — absent while the tier is idle, so quiet
+        jobs stay quiet."""
+        sources: Dict[str, int] = {}
+        pushes = 0
+        push_p50 = None
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                if name == "ckpt.restore_source":
+                    src = (m.get("tags") or {}).get("source", "?")
+                    sources[src] = sources.get(src, 0) + int(m["value"])
+                elif name == "ckpt.replica_pushes":
+                    pushes += int(m["value"])
+                elif name == "ckpt.replica_push_ms" and m.get("count"):
+                    # Worst per-rank p50, not last-iterated: the digest
+                    # exists to surface the slow rank, not to hide it
+                    # behind dict iteration order.
+                    p50 = m.get("p50")
+                    if p50 is not None:
+                        push_p50 = p50 if push_p50 is None \
+                            else max(push_p50, p50)
+        if not sources and not pushes:
+            return None
+        bits = []
+        if sources:
+            bits.append("restores " + " ".join(
+                f"{k}={sources[k]}" for k in ("peer", "disk", "none")
+                if k in sources
+            ))
+        if pushes:
+            token = f"pushes {pushes}"
+            if push_p50 is not None:
+                token += f" (worst p50 {push_p50:.0f}ms)"
+            bits.append(token)
+        return "ckpt " + " ".join(bits)
 
     # ---------------------------------------------------------- history
 

@@ -26,7 +26,7 @@ import re
 from typing import Optional
 
 __all__ = ["resolve", "glob_pattern", "rank_of_path", "epoch_of_path",
-           "epoch_tag", "write_json_atomic"]
+           "epoch_tag", "write_json_atomic", "write_bytes_atomic"]
 
 # Per-call uniquifier for tmp names: pid alone is not enough — a
 # signal-handler flush may reentrantly interrupt an in-progress dump on
@@ -36,18 +36,21 @@ __all__ = ["resolve", "glob_pattern", "rank_of_path", "epoch_of_path",
 _tmp_seq = itertools.count()
 
 
-def write_json_atomic(path: str, doc, *, indent: int = 1) -> str:
-    """The one atomic JSON write every obs artifact uses (metrics dump,
-    flight-recorder dump, post-mortem report, merged timeline):
-    tmp-file + ``os.replace`` so a reader — or a crash mid-write —
-    never sees a torn document.  Returns ``path``."""
+def write_bytes_atomic(path: str, data: bytes) -> str:
+    """The one atomic byte write every durable artifact uses (checkpoint
+    Store payloads, checkpoint shards, and — via
+    :func:`write_json_atomic` — every obs JSON document): per-call-unique
+    tmp file + ``os.replace`` so a reader, a crash mid-write, or a
+    reentrant second writer can never leave a torn or half-visible file.
+    A failed write removes its own tmp so clean directories stay clean.
+    Returns ``path``."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_seq)}"
     try:
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=indent)
+        with open(tmp, "wb") as f:
+            f.write(data)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -56,6 +59,16 @@ def write_json_atomic(path: str, doc, *, indent: int = 1) -> str:
             pass
         raise
     return path
+
+
+def write_json_atomic(path: str, doc, *, indent: int = 1) -> str:
+    """The one atomic JSON write every obs artifact uses (metrics dump,
+    flight-recorder dump, post-mortem report, merged timeline):
+    tmp-file + ``os.replace`` so a reader — or a crash mid-write —
+    never sees a torn document.  Returns ``path``."""
+    return write_bytes_atomic(
+        path, json.dumps(doc, indent=indent).encode()
+    )
 
 _RANK_RE = re.compile(r"(?:^|[^0-9a-zA-Z])rank[._]?(\d+)")
 _EPOCH_RE = re.compile(r"\.e(\d+)\.")

@@ -150,6 +150,24 @@ def _rank_summary(doc: dict) -> dict:
         if events[i].get("kind") == "rendezvous":
             aligned = events[i + 1:]
             break
+    # Restore provenance: the checkpoint tier records every recovery
+    # as a ``ckpt.restore`` event with a ``source=peer|disk|none``
+    # detail.  The NEWEST one is this incarnation's recovery story —
+    # the analyzer's proof of where a respawned rank's state came from.
+    restores = [e for e in events if e.get("kind") == "ckpt.restore"]
+    last_restore = None
+    if restores:
+        ev = restores[-1]
+        fields = dict(
+            kv.split("=", 1) for kv in (ev.get("detail") or "").split()
+            if "=" in kv
+        )
+        last_restore = {
+            "source": fields.get("source"),
+            "replica_adopted": fields.get("replica") == "True",
+            "ms": float(fields["ms"]) if "ms" in fields else None,
+            "commits": ev.get("cycle"),
+        }
     return {
         "rank": int(doc.get("rank")),
         "epoch": doc.get("epoch") or 0,
@@ -163,6 +181,7 @@ def _rank_summary(doc: dict) -> dict:
         "last_event": last_event,
         "last_collective": (last_complete or {}).get("name") or None,
         "last_exception": doc.get("last_exception"),
+        "last_restore": last_restore,
         "submitted": [e.get("name") for e in aligned
                       if e.get("kind") == "enqueue"],
         "completed": [e.get("name") for e in aligned
@@ -322,6 +341,10 @@ def analyze(
         "first_failure": first_failure,
         "last_common_collective": _last_common_collective(ranks),
         "schedule_divergence": _schedule_divergence(ranks),
+        "restore_provenance": {
+            str(r["rank"]): r["last_restore"]
+            for r in ranks if r.get("last_restore")
+        },
         "ranks": ranks,
         "live_last_round": _read_live_history(live_history),
     }
@@ -423,6 +446,22 @@ def verdict(report: dict) -> str:
             f"COLLECTIVE SCHEDULE DIVERGENCE at submission #"
             f"{div['index'] + 1}: {ops} — ranks disagreeing on the op "
             f"sequence is the classic desync hang."
+        )
+    prov = report.get("restore_provenance") or {}
+    if prov:
+        parts.append(
+            "Recovery provenance: "
+            + "; ".join(
+                f"rank {rank} restored from "
+                + {"peer": "a live peer", "disk": "the disk manifest",
+                   "none": "nothing (fresh start)"}.get(
+                       (p or {}).get("source"), "an unknown source")
+                + (f" at commit {p['commits']}"
+                   if (p or {}).get("commits") is not None else "")
+                for rank, p in sorted(prov.items(),
+                                      key=lambda kv: int(kv[0]))
+            )
+            + "."
         )
     missing = report.get("ranks_missing_dumps") or []
     if missing and (first is None

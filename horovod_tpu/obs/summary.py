@@ -75,11 +75,7 @@ def format_summary_table(dumps: Dict[str, dict]) -> str:
     if not dumps:
         return "(no metrics dumps found)"
 
-    def col_key(label: str):
-        head = label.split("@")[0]
-        return (0, int(head)) if head.isdigit() else (1, label)
-
-    columns = sorted(dumps, key=col_key)
+    columns = sorted(dumps, key=_rank_sort_key)
     rows: Dict[str, Dict[str, str]] = {}
     for label in columns:
         for metric in dumps[label].get("metrics", []):
@@ -137,6 +133,61 @@ def straggler_section(dumps: Dict[str, dict]) -> Optional[str]:
     if verdict["alerts"]:
         lines.append(f"alerts past --alert-skew-ms: {verdict['alerts']}")
     return "\n".join(lines)
+
+
+def ckpt_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job checkpoint/recovery verdict: per-rank restore
+    provenance (peer / disk / none), shard and replica-push volume,
+    and the restore-time distribution.  None when no rank touched the
+    checkpoint tier — jobs without it see no new output."""
+    rows = []
+    restore_ms = []
+    for label in sorted(dumps, key=_rank_sort_key):
+        metrics = dumps[label].get("metrics", [])
+        sources = {}
+        pushes = dropped = 0
+        shard_bytes = 0.0
+        for m in metrics:
+            name = m.get("name")
+            if name == "ckpt.restore_source":
+                src = (m.get("tags") or {}).get("source", "?")
+                sources[src] = sources.get(src, 0) + int(m["value"])
+            elif name == "ckpt.replica_pushes":
+                pushes += int(m["value"])
+            elif name == "ckpt.replica_dropped":
+                dropped += int(m["value"])
+            elif name == "ckpt.shard_bytes" and m.get("count"):
+                shard_bytes += float(m.get("sum") or 0.0)
+            elif name == "ckpt.restore_ms" and m.get("count"):
+                restore_ms.append(m)
+        if not sources and not pushes and not shard_bytes:
+            continue
+        src_s = (" ".join(f"{k}={v}" for k, v in sorted(sources.items()))
+                 or "-")
+        row = (f"rank {label}: restores {src_s}, replica pushes {pushes}"
+               + (f" (dropped {dropped})" if dropped else ""))
+        if shard_bytes:
+            row += f", shard bytes {shard_bytes:.3g}"
+        rows.append(row)
+    if not rows:
+        return None
+    if restore_ms:
+        n = sum(m["count"] for m in restore_ms)
+        worst = max(m["max"] for m in restore_ms)
+        p50s = [m["p50"] for m in restore_ms if m.get("p50") is not None]
+        rows.append(
+            f"restore time: n={n} p50~{(sum(p50s) / len(p50s)):.3g}ms "
+            f"max={worst:.3g}ms" if p50s else f"restore time: n={n}"
+        )
+    return "\n".join(rows)
+
+
+def _rank_sort_key(label: str):
+    """Rank-label ordering shared by the summary table's columns and
+    the ckpt section's rows: numeric ranks first (numerically, with
+    ``@e<N>`` incarnation tags ignored), everything else after."""
+    head = label.split("@", 1)[0]
+    return (0, int(head), label) if head.isdigit() else (1, label, "")
 
 
 def summarize(raw: str) -> Optional[str]:

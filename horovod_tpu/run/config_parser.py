@@ -31,6 +31,16 @@ _ARG_ENV_MAP = {
         envmod.SCHEDULE_REPLAY_CYCLES,
         "params.schedule-replay-cycles",
     ),
+    "ckpt_dir": (envmod.CKPT_DIR, "checkpoint.dir"),
+    "ckpt_replica": (envmod.CKPT_REPLICA, "checkpoint.replica"),
+    "ckpt_replica_chunk_kb": (
+        envmod.CKPT_REPLICA_CHUNK_KB,
+        "checkpoint.replica-chunk-kb",
+    ),
+    "ckpt_commit_timeout_secs": (
+        envmod.CKPT_COMMIT_TIMEOUT,
+        "checkpoint.commit-timeout-secs",
+    ),
     "timeline_filename": (envmod.TIMELINE, "timeline.filename"),
     "timeline_mark_cycles": (envmod.TIMELINE_MARK_CYCLES, "timeline.mark-cycles"),
     "metrics_dump": (envmod.METRICS_DUMP, "metrics.dump"),

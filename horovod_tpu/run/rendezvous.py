@@ -13,8 +13,11 @@ and serving as a generic KV side-channel for integrations.
 Security model (same as the reference's): every payload is authenticated
 with an HMAC over a per-job secret that travels to workers via the
 launcher's env, because the values are pickles — an unauthenticated write
-would be remote code execution.  All-local jobs additionally bind loopback
-only."""
+would be remote code execution.  Each MAC binds verb + key + body, so a
+signature captured for one operation can never be replayed as another
+(a PUT body can't mint a DELETE token, a value signed under one key
+can't be served under another).  All-local jobs additionally bind
+loopback only."""
 
 from __future__ import annotations
 
@@ -38,8 +41,19 @@ def make_secret() -> str:
     return _secrets.token_hex(32)
 
 
-def _sign(secret: str, body: bytes) -> str:
-    return hmac.new(secret.encode(), body, hashlib.sha256).hexdigest()
+def _mac(secret: str, verb: str, key: str, body: bytes = b"") -> str:
+    """Every MAC binds verb + key + body (newline-framed; neither verb
+    nor key can contain a newline).  Without the verb/key domain
+    separation, a signed PUT whose *user-chosen body* spelled out a
+    delete token would hand an observer a valid DELETE for that key —
+    cross-verb replay is exactly what the binding closes."""
+    msg = f"{verb}\n{key}\n".encode() + body
+    return hmac.new(secret.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def _delete_mac(secret: str, key: str) -> str:
+    """DELETE has no body: its MAC covers verb + key alone."""
+    return _mac(secret, "DELETE", key)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -56,7 +70,7 @@ class _Handler(BaseHTTPRequestHandler):
         value = self.rfile.read(length)
         mac = self.headers.get(_MAC_HEADER, "")
         if not hmac.compare_digest(
-            mac, _sign(self.server.secret, value)  # type: ignore[attr-defined]
+            mac, _mac(self.server.secret, "PUT", self._key(), value)  # type: ignore[attr-defined]
         ):
             self.send_response(403)
             self.send_header("Content-Length", "0")
@@ -128,12 +142,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(value)))
         self.send_header(
             _MAC_HEADER,
-            _sign(self.server.secret, value),  # type: ignore[attr-defined]
+            _mac(self.server.secret, "GET", self._key(), value),  # type: ignore[attr-defined]
         )
         self.end_headers()
         self.wfile.write(value)
 
     def do_DELETE(self):
+        # Deletes are mutations: signed like PUT, with the MAC bound to
+        # method + key (there is no body) — or an unauthenticated client
+        # could erase rendezvous worlds and checkpoint replicas out from
+        # under a live job, and a captured delete could be replayed
+        # against arbitrary keys.
+        mac = self.headers.get(_MAC_HEADER, "")
+        if not hmac.compare_digest(
+            mac, _delete_mac(self.server.secret, self._key())  # type: ignore[attr-defined]
+        ):
+            self.send_response(403)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self.server.kv.pop(self._key(), None)  # type: ignore[attr-defined]
         self.send_response(200)
@@ -215,13 +242,30 @@ class KVStoreClient:
         req = Request(
             f"{self._base}/{scope}/{key}", data=value, method="PUT"
         )
-        req.add_header(_MAC_HEADER, _sign(self._secret, value))
+        req.add_header(_MAC_HEADER,
+                       _mac(self._secret, "PUT", f"{scope}/{key}", value))
         try:
             urlopen(req, timeout=30).read()
         except HTTPError as e:
             if e.code == 403:
                 raise PermissionError(
                     f"KV store at {self._addr} rejected the payload signature"
+                ) from e
+            raise
+
+    def delete(self, scope: str, key: str) -> None:
+        """Authenticated delete; absent keys are a no-op (the replica
+        tier garbage-collects superseded chunks with this)."""
+        req = Request(f"{self._base}/{scope}/{key}", method="DELETE")
+        req.add_header(_MAC_HEADER, _delete_mac(self._secret,
+                                                f"{scope}/{key}"))
+        try:
+            urlopen(req, timeout=30).read()
+        except HTTPError as e:
+            if e.code == 403:
+                raise PermissionError(
+                    f"KV store at {self._addr} rejected the delete "
+                    f"signature"
                 ) from e
             raise
 
@@ -239,7 +283,9 @@ class KVStoreClient:
             ) from e
         body = resp.read()
         mac = resp.headers.get(_MAC_HEADER, "")
-        if not hmac.compare_digest(mac, _sign(self._secret, body)):
+        if not hmac.compare_digest(
+            mac, _mac(self._secret, "GET", f"{scope}/{key}", body)
+        ):
             raise PermissionError(
                 f"KV store at {self._addr} returned a bad payload signature"
             )

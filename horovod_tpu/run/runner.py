@@ -186,6 +186,39 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "epoch opens (default 50).",
     )
 
+    ckpt = parser.add_argument_group("checkpointing")
+    ckpt.add_argument(
+        "--ckpt-dir", action=_StoreOverrideAction, dest="ckpt_dir",
+        default=None,
+        help="Sharded-checkpoint directory (HVDTPU_CKPT_DIR): every "
+             "rank writes only its own shard; rank 0 commits the "
+             "manifest last; elastic State.sync falls back to the "
+             "newest valid manifest here when no live peer replica "
+             "exists.",
+    )
+    ckpt.add_argument(
+        "--ckpt-replica", action=_StoreTrueOverrideAction,
+        dest="ckpt_replica", default=None,
+        help="Peer-replica recovery tier: after every State.commit "
+             "each rank pushes its committed shard to its ring "
+             "neighbor's replica key over the HMAC-signed KV path, so "
+             "a respawned rank restores from a live peer in seconds "
+             "instead of from disk.",
+    )
+    ckpt.add_argument(
+        "--ckpt-replica-chunk-kb", type=int, action=_StoreOverrideAction,
+        dest="ckpt_replica_chunk_kb", default=None,
+        help="Replica push chunk size in KiB (default 1024).",
+    )
+    ckpt.add_argument(
+        "--ckpt-commit-timeout-secs", type=float,
+        action=_StoreOverrideAction,
+        dest="ckpt_commit_timeout_secs", default=None,
+        help="Seconds each rank waits for the sharded manifest to "
+             "commit (rank 0: for every peer's shard sidecar) before "
+             "failing the save on every rank (default 120).",
+    )
+
     timeline = parser.add_argument_group("timeline")
     timeline.add_argument(
         "--timeline-filename", action=_StoreOverrideAction,
@@ -1377,3 +1410,7 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if straggler is not None:
         print("\n== straggler attribution ==")
         print(straggler)
+    ckpt = obs_summary.ckpt_section(dumps)
+    if ckpt is not None:
+        print("\n== checkpoint / recovery ==")
+        print(ckpt)

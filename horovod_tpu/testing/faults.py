@@ -15,11 +15,13 @@ Grammar::
 
     HVDTPU_FAULT_SPEC="ckpt_write:step=3:rank=0,worker_exit:step=5:rank=2"
 
-* ``point`` — the injection-site name.  Sites wired in this PR:
+* ``point`` — the injection-site name.  Sites wired so far:
   ``ckpt_write`` (checkpoint.py rank-0 write), ``enqueue`` (eager-engine
   enqueue path), ``worker_exit`` (elastic context, once per collective;
   also run/task_fn.py at function start), ``task_fn`` (run/task_fn.py
-  before the user function runs).
+  before the user function runs), ``shard_write`` (ckpt/sharded.py
+  per-rank shard write), ``replica_push`` (ckpt/replica.py peer-replica
+  push after each commit).
 * ``rank`` — only fire on this rank (resolved from the ``rank=`` call
   argument, else ``HVDTPU_RANK``, else ``HVDTPU_ELASTIC_RANK``).  Absent
   means any rank.
@@ -45,8 +47,15 @@ Grammar::
   progress-beat staleness policy exists to catch);
   ``delay:<ms>`` sleeps the calling thread for that many milliseconds
   and then CONTINUES (default 1000) — a deterministic straggler, the
-  chaos input the live telemetry plane's attribution is tested against.
-  ``worker_exit``/``task_fn`` points default to ``exit``.
+  chaos input the live telemetry plane's attribution is tested against;
+  ``corrupt_write`` instructs the call site to flip bytes in the data it
+  is about to write (the site receives the action name back from
+  :func:`maybe_fail` and applies :func:`corrupt_bytes` — a deterministic
+  torn/corrupted shard, the chaos input checksum validation is tested
+  against); ``drop_replica`` instructs the call site to suppress the
+  write entirely (the peer-replica push path — a deterministically
+  stale replica).  ``worker_exit``/``task_fn`` points default to
+  ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
 * ``name`` — only fire when the call site passes a matching ``name=``
@@ -59,11 +68,20 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-__all__ = ["InjectedFault", "maybe_fail", "parse_spec", "reset", "active"]
+__all__ = ["InjectedFault", "maybe_fail", "corrupt_bytes", "parse_spec",
+           "reset", "active"]
 
 SPEC_ENV = "HVDTPU_FAULT_SPEC"
 DEFAULT_EXIT_CODE = 43
 _EXIT_POINTS = ("worker_exit", "task_fn")
+# Advisory actions only take effect at call sites that consume
+# maybe_fail's return value; parse-time validation keeps a spec like
+# "ckpt_write:action=corrupt_write" from "firing" as a silent no-op —
+# a chaos test built on it would pass vacuously.
+_ADVISORY_POINTS = {
+    "corrupt_write": ("shard_write",),
+    "drop_replica": ("replica_push",),
+}
 
 
 class InjectedFault(RuntimeError):
@@ -143,7 +161,8 @@ def parse_spec(raw: str) -> List[FaultSpec]:
             elif key == "epoch":
                 spec.epoch = None if value in ("any", "*") else int(value)
             elif key == "action":
-                if value not in ("raise", "exit", "abort", "hang", "delay"):
+                if value not in ("raise", "exit", "abort", "hang", "delay",
+                                 "corrupt_write", "drop_replica"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -152,6 +171,13 @@ def parse_spec(raw: str) -> List[FaultSpec]:
                 raise ValueError(
                     f"unknown fault spec key {key!r} in {chunk!r}"
                 )
+        allowed = _ADVISORY_POINTS.get(spec.action)
+        if allowed is not None and spec.point not in allowed:
+            raise ValueError(
+                f"action={spec.action} is only implemented at "
+                f"{'/'.join(allowed)}, not at point {spec.point!r} — "
+                f"it would fire as a silent no-op there"
+            )
         specs.append(spec)
     return specs
 
@@ -201,25 +227,42 @@ def _resolve_epoch() -> int:
     return int(value) if value not in (None, "") else 0
 
 
+def corrupt_bytes(data: bytes) -> bytes:
+    """Deterministically damage ``data`` (first/middle/last byte flipped)
+    — the payload an ``action=corrupt_write`` call site writes instead of
+    the real one, so checksum validation has something real to catch."""
+    if not data:
+        return data
+    buf = bytearray(data)
+    for i in (0, len(buf) // 2, len(buf) - 1):
+        buf[i] ^= 0xFF
+    return bytes(buf)
+
+
 def maybe_fail(
     point: str,
     *,
     step: Optional[int] = None,
     rank: Optional[int] = None,
     name: Optional[str] = None,
-) -> None:
+) -> Optional[str]:
     """Fire any matching fault for ``point``; no-op when none match.
 
     ``step=None`` uses the per-point invocation counter (1-based) — the
     counter advances on every call whether or not a fault fires, so
     ``step=N`` deterministically means "the Nth visit to this point".
+
+    Returns the fired action name for the *advisory* actions the call
+    site must apply itself (``corrupt_write``, ``drop_replica``) and
+    ``None`` otherwise — existing callers that ignore the return value
+    keep their exact semantics.
     """
     specs = _load().get(point)
     counter = None
     if specs is not None or point in _counters:
         counter = _counters[point] = _counters.get(point, 0) + 1
     if not specs:
-        return
+        return None
     observed_step = step if step is not None else counter
     observed_rank = _resolve_rank(rank)
     observed_epoch = _resolve_epoch()
@@ -244,6 +287,11 @@ def maybe_fail(
             "fault", name=point,
             detail=f"{spec.action}:{spec.describe()}",
         )
+        if spec.action in ("corrupt_write", "drop_replica"):
+            # Advisory actions: the call site owns the I/O, so the
+            # registry can only instruct it — corrupt the payload it is
+            # about to write, or skip the push entirely.
+            return spec.action
         if spec.action == "delay":
             # A deterministic straggler: stall the calling thread, then
             # proceed normally — the collective completes late, which is
@@ -251,7 +299,7 @@ def maybe_fail(
             import time  # noqa: PLC0415
 
             time.sleep(spec.delay_ms / 1000.0)
-            return
+            return None
         if spec.action == "exit":
             # os._exit, not sys.exit: the injected death must look like a
             # hard crash (no atexit, no finally blocks posting results).

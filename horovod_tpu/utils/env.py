@@ -72,6 +72,19 @@ ALERT_SKEW = "HVDTPU_ALERT_SKEW_MS"
 # black box for obs/postmortem.py.
 FLIGHTREC_DUMP = "HVDTPU_FLIGHTREC_DUMP"
 FLIGHTREC_CAPACITY = "HVDTPU_FLIGHTREC_CAPACITY"
+# Sharded checkpoint + peer-replica recovery tier (ckpt/): CKPT_DIR is
+# the sharded-manifest directory the elastic State tier saves to and
+# falls back to on restore when no live peer holds a valid replica;
+# CKPT_REPLICA turns on the in-memory replica push after every commit
+# (each rank mirrors its committed shard to its ring neighbor's key
+# over the HMAC-signed KV path, chunked at CKPT_REPLICA_CHUNK_KB);
+# CKPT_COMMIT_TIMEOUT bounds the manifest-commit wait on every rank.
+CKPT_DIR = "HVDTPU_CKPT_DIR"
+CKPT_REPLICA = "HVDTPU_CKPT_REPLICA"
+CKPT_REPLICA_CHUNK_KB = "HVDTPU_CKPT_REPLICA_CHUNK_KB"
+DEFAULT_REPLICA_CHUNK_KB = 1024
+CKPT_COMMIT_TIMEOUT = "HVDTPU_CKPT_COMMIT_TIMEOUT_SECS"
+DEFAULT_CKPT_COMMIT_TIMEOUT = 120.0
 
 
 def resolve_rank(default=None):

@@ -1,0 +1,905 @@
+"""Sharded checkpoints + peer-replica recovery (horovod_tpu/ckpt/,
+ISSUE 7): shard/manifest format with checksum validation and N->M
+reshard, the replica tier's push/fetch over the signed KV path, the
+elastic State tier routing (peer -> disk -> none provenance), the new
+fault actions, and the 2-proc chaos acceptance — kill a rank mid-epoch,
+the respawned incarnation restores from its peer's in-memory replica."""
+
+import glob
+import json
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu.elastic as elastic
+from horovod_tpu import ckpt
+from horovod_tpu.ckpt.replica import SCOPE as REP_SCOPE, ReplicaTier
+from horovod_tpu.ckpt.sharded import (
+    ShardCorruptError,
+    shard_assignment,
+    step_dir,
+    write_shard,
+)
+from horovod_tpu.elastic.context import LocalContext
+from horovod_tpu.elastic.state import State
+from horovod_tpu.run.rendezvous import KVStoreClient, KVStoreServer
+from horovod_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(faults.SPEC_ENV, raising=False)
+    monkeypatch.delenv("HVDTPU_CKPT_REPLICA", raising=False)
+    monkeypatch.delenv("HVDTPU_CKPT_DIR", raising=False)
+    faults.reset()
+    elastic.reset_context()
+    yield
+    faults.reset()
+    elastic.reset_context()
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "params": {
+            "w": rng.randn(4, 3).astype(np.float32),
+            "b": rng.randn(3).astype(np.float32),
+        },
+        "opt": [rng.randn(2).astype(np.float64), np.int32(seed)],
+        "step": np.int64(7 + seed),
+    }
+
+
+def _assert_tree_equal(a, b):
+    import jax
+
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a, b,
+    )
+
+
+def _save_world(directory, state, step, world):
+    """Simulate ``world`` writers: start every rank's async save first
+    (rank 0 blocks on the others' sidecars), then commit them all."""
+    handles = [
+        ckpt.save_sharded_async(directory, state, step, rank=r,
+                                world_size=world)
+        for r in range(world)
+    ]
+    for h in handles:
+        h.wait()
+    return handles
+
+
+# ---------------------------------------------------------------------------
+# Sharded format
+# ---------------------------------------------------------------------------
+
+
+def test_shard_assignment_round_robin():
+    assert shard_assignment(5, 2) == [[0, 2, 4], [1, 3]]
+    assert shard_assignment(3, 4) == [[0], [1], [2], []]
+    assert shard_assignment(0, 1) == [[]]
+    with pytest.raises(ValueError):
+        shard_assignment(3, 0)
+
+
+def test_save_restore_roundtrip_world1(tmp_path):
+    d = str(tmp_path)
+    state = _state()
+    ckpt.save_sharded(d, state, 3, rank=0, world_size=1)
+    assert ckpt.list_steps(d) == [3]
+    _assert_tree_equal(ckpt.restore_sharded(d, target=_state(9)), state)
+
+
+def test_multi_writer_save_and_manifest(tmp_path):
+    d = str(tmp_path)
+    state = _state()
+    _save_world(d, state, 5, world=4)
+    manifest = ckpt.load_manifest(d, 5)
+    assert manifest["schema"] == ckpt.SCHEMA
+    assert manifest["world_size"] == 4
+    assert len(manifest["shards"]) == 4
+    assert manifest["num_leaves"] == len(manifest["leaves"])
+    # every shard checksummed, every leaf assigned exactly once
+    for s in manifest["shards"]:
+        assert len(s["checksum"]) == 64
+    owned = sorted(i for s in manifest["shards"] for i in s["leaves"])
+    assert owned == list(range(manifest["num_leaves"]))
+    _assert_tree_equal(ckpt.restore_sharded(d, target=_state(1)), state)
+
+
+def test_restore_without_target_uses_manifest_treedef(tmp_path):
+    d = str(tmp_path)
+    state = _state()
+    _save_world(d, state, 1, world=2)
+    if ckpt.load_manifest(d, 1).get("treedef") is None:
+        pytest.skip("this jax cannot pickle treedefs")
+    _assert_tree_equal(ckpt.restore_sharded(d), state)
+
+
+def test_reshard_n_to_m_roundtrips_bitwise(tmp_path):
+    """A checkpoint written by 4 ranks restores under a 2-rank world
+    (and vice versa) to the identical pytree — the elastic shrink/grow
+    contract."""
+    d = str(tmp_path)
+    state = _state()
+    _save_world(d, state, 1, world=4)
+    restored = ckpt.restore_sharded(d, target=_state(3))
+    _assert_tree_equal(restored, state)
+    _save_world(d, restored, 2, world=2)
+    again = ckpt.restore_sharded(d, target=_state(3))
+    assert ckpt.load_manifest(d, 2)["world_size"] == 2
+    _assert_tree_equal(again, state)
+
+
+def test_corrupt_shard_rejected_and_falls_back(tmp_path):
+    """A checksum-rejected shard invalidates its whole step; restore
+    falls back to the previous committed step instead of dying (an
+    explicitly requested step raises)."""
+    d = str(tmp_path)
+    good, bad = _state(0), _state(1)
+    ckpt.save_sharded(d, good, 1, rank=0, world_size=1)
+    os.environ[faults.SPEC_ENV] = "shard_write:action=corrupt_write"
+    faults.reset()
+    try:
+        ckpt.save_sharded(d, bad, 2, rank=0, world_size=1)
+    finally:
+        del os.environ[faults.SPEC_ENV]
+        faults.reset()
+    # the manifest committed (checksum was computed pre-corruption),
+    # but the bytes on disk are damaged — exactly a torn write
+    assert ckpt.list_steps(d) == [1, 2]
+    with pytest.raises(ShardCorruptError, match="checksum"):
+        ckpt.restore_sharded(d, target=_state(5), step=2)
+    out = ckpt.restore_sharded(d, target=_state(5))  # silent fallback
+    _assert_tree_equal(out, good)
+
+
+def test_uncommitted_step_is_invisible(tmp_path):
+    """A step directory without a manifest (writer died pre-commit) is
+    not a checkpoint: latest_step never selects it."""
+    d = str(tmp_path)
+    ckpt.save_sharded(d, _state(), 1, rank=0, world_size=1)
+    leaves = {0: np.ones(3, np.float32)}
+    write_shard(d, 2, 0, 1, leaves)  # shard + sidecar, no manifest
+    assert os.path.isdir(step_dir(d, 2))
+    assert ckpt.latest_step(d) == 1
+
+
+def test_missing_peer_shard_fails_commit_on_every_rank(tmp_path):
+    """Rank 0 never shows up: the manifest never commits, and the
+    waiting rank's wait() raises instead of blessing the step."""
+    d = str(tmp_path)
+    h = ckpt.save_sharded_async(d, _state(), 1, rank=1, world_size=2,
+                                commit_timeout=0.3)
+    with pytest.raises(TimeoutError, match="manifest never committed"):
+        h.wait()
+    with pytest.raises(TimeoutError):  # repeat wait never blesses it
+        h.wait()
+    assert ckpt.latest_step(d) is None
+
+
+def test_async_save_snapshots_before_mutation(tmp_path):
+    """The handle's contract: leaves are snapshotted before return, so
+    an in-place ``w -= lr*g`` between start and wait() must not tear
+    the shard (np.asarray would alias the caller's numpy buffer)."""
+    d = str(tmp_path)
+    w = np.arange(8, dtype=np.float64)
+    h = ckpt.save_sharded_async(d, {"w": w}, 1, rank=0, world_size=1)
+    w -= 100.0  # mutate immediately, racing the writer thread
+    h.wait()
+    out = ckpt.restore_sharded(d, target={"w": np.zeros(8)})
+    np.testing.assert_array_equal(out["w"], np.arange(8, dtype=np.float64))
+
+
+def test_restore_rejects_same_arity_different_structure(tmp_path):
+    """Leaf count alone must not admit a checkpoint from a different
+    model: per-leaf shape/dtype from the manifest gate the restore."""
+    d = str(tmp_path)
+    ckpt.save_sharded(d, {"a": np.zeros((4, 3), np.float32),
+                          "b": np.zeros(3, np.int64)}, 1,
+                      rank=0, world_size=1)
+    with pytest.raises(ValueError, match="shape"):
+        ckpt.restore_sharded(d, target={"a": np.zeros((2, 2), np.float32),
+                                        "b": np.zeros(3, np.int64)})
+    with pytest.raises(ValueError, match="dtype"):
+        ckpt.restore_sharded(d, target={"a": np.zeros((4, 3), np.float64),
+                                        "b": np.zeros(3, np.int64)})
+
+
+def test_clean_save_leaves_no_tmp_files(tmp_path):
+    d = str(tmp_path)
+    _save_world(d, _state(), 1, world=2)
+    assert not glob.glob(os.path.join(d, "**", "*.tmp.*"), recursive=True)
+
+
+def test_resave_same_step_commits_fresh_attempt(tmp_path):
+    """A retried save at the same step must not be poisoned by the
+    earlier attempt's manifest: the new commit carries the new data."""
+    d = str(tmp_path)
+    ckpt.save_sharded(d, {"w": np.zeros(4)}, 1, rank=0, world_size=1)
+    ckpt.save_sharded(d, {"w": np.full(4, 7.0)}, 1, rank=0, world_size=1)
+    out = ckpt.restore_sharded(d, target={"w": np.zeros(4)}, step=1)
+    np.testing.assert_array_equal(out["w"], np.full(4, 7.0))
+
+
+def test_failed_resave_never_destroys_durable_step(tmp_path):
+    """A re-save attempt that never completes must leave the step's
+    previously committed manifest fully restorable — durability is
+    never traded for the retry handshake."""
+    d = str(tmp_path)
+    ckpt.save_sharded(d, {"w": np.zeros(4)}, 1, rank=0, world_size=1)
+    # a doomed 2-writer re-save of the same step: rank 0 never shows up
+    h = ckpt.save_sharded_async(d, {"w": np.full(4, 9.0)}, 1, rank=1,
+                                world_size=2, commit_timeout=0.4)
+    with pytest.raises((TimeoutError, RuntimeError)):
+        h.wait()
+    out = ckpt.restore_sharded(d, target={"w": np.zeros(4)}, step=1)
+    np.testing.assert_array_equal(out["w"], np.zeros(4))
+
+
+def test_target_structure_mismatch_raises(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_sharded(d, _state(), 1, rank=0, world_size=1)
+    with pytest.raises(ValueError, match="leaves"):
+        ckpt.restore_sharded(d, target={"only": np.ones(2)})
+
+
+def test_restore_empty_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_sharded(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Replica tier
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def kv_server():
+    server = KVStoreServer()
+    server.start()
+    try:
+        yield server, KVStoreClient(f"127.0.0.1:{server.port}",
+                                    server.secret)
+    finally:
+        server.stop()
+
+
+def test_replica_push_fetch_roundtrip(kv_server):
+    server, kv = kv_server
+    tier = ReplicaTier(kv, 0, [0, 1, 2], chunk_bytes=8)
+    payload = b"0123456789" * 5
+    assert tier.push(payload, step=4, commits=4)
+    got, meta = tier.fetch(0)
+    assert got == payload
+    assert meta["step"] == 4 and meta["commits"] == 4
+    assert meta["chunks"] == 7  # 50 bytes / 8
+    assert meta["holder"] == 1  # ring neighbor in [0, 1, 2]
+
+
+def test_replica_ring_holder_wraps():
+    tier = ReplicaTier(object(), 2, [0, 1, 2], chunk_bytes=8)
+    assert tier.holder() == 0
+    assert tier.holder(1) == 2
+
+
+def test_replica_mid_push_death_keeps_previous_version(kv_server):
+    """Chunks land before the meta record: a rank dying mid-push (here:
+    new-step chunks present, meta never written) leaves the previous
+    replica fully fetchable — never a torn one."""
+    server, kv = kv_server
+    tier = ReplicaTier(kv, 0, [0, 1], chunk_bytes=8)
+    v1 = b"version-one-payload"
+    assert tier.push(v1, step=1, commits=1)
+    kv.put(REP_SCOPE, "o0.s2.c0", b"half-a-v2")  # died before meta
+    got, meta = tier.fetch(0)
+    assert got == v1 and meta["step"] == 1
+
+
+def test_replica_corrupt_chunk_rejected(kv_server):
+    server, kv = kv_server
+    tier = ReplicaTier(kv, 0, [0, 1], chunk_bytes=1024)
+    assert tier.push(b"payload", step=1)
+    kv.put(REP_SCOPE, "o0.s1.c0", b"garbage")
+    assert tier.fetch(0) is None  # checksum mismatch -> fall back
+
+
+def test_replica_gc_removes_superseded_chunks(kv_server):
+    server, kv = kv_server
+    tier = ReplicaTier(kv, 0, [0, 1], chunk_bytes=4)
+    tier.push(b"old-payload!", step=1)
+    tier.push(b"new-payload!", step=2)
+    assert not server.scan(f"{REP_SCOPE}/o0.s1.")
+    got, meta = tier.fetch(0)
+    assert got == b"new-payload!" and meta["step"] == 2
+
+
+def test_replica_from_another_job_rejected(kv_server):
+    """A reused KV endpoint must never serve one job's replica to the
+    next job's respawn: the meta's job fingerprint gates adoption."""
+    server, kv = kv_server
+    tier = ReplicaTier(kv, 0, [0, 1], chunk_bytes=64)
+    assert tier.push(b"previous-job-state", step=3)
+    other_job = ReplicaTier(kv, 0, [0, 1], chunk_bytes=64)
+    other_job.job_id = "0123456789abcdef"  # a different job generation
+    assert other_job.fetch(0) is None
+    assert tier.fetch(0) is not None  # the owning job still sees it
+
+
+def test_replica_failed_push_sweeps_its_chunks(kv_server):
+    """A push that dies before its meta lands must not leak its chunks
+    in the launcher-resident store forever."""
+    server, kv = kv_server
+
+    class _MetaFailsKV:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def put(self, scope, key, value):
+            if key.startswith("owner_"):
+                raise ConnectionError("kv went away")
+            self._inner.put(scope, key, value)
+
+        def get(self, scope, key):
+            return self._inner.get(scope, key)
+
+        def delete(self, scope, key):
+            self._inner.delete(scope, key)
+
+    tier = ReplicaTier(_MetaFailsKV(kv), 0, [0], chunk_bytes=4)
+    assert tier.push(b"twelve bytes", step=1) is False
+    assert not server.scan(f"{REP_SCOPE}/o0.s1."), (
+        "failed push leaked its chunks"
+    )
+
+
+def test_drop_replica_fault_suppresses_one_push(kv_server, monkeypatch):
+    server, kv = kv_server
+    monkeypatch.setenv(faults.SPEC_ENV,
+                       "replica_push:action=drop_replica")
+    faults.reset()
+    tier = ReplicaTier(kv, 0, [0, 1], chunk_bytes=64)
+    assert tier.push(b"dropped", step=1) is False
+    assert tier.fetch(0) is None  # nothing landed
+    assert tier.push(b"kept", step=2) is True  # count=1: only the first
+    assert tier.fetch(0)[0] == b"kept"
+
+
+def test_kv_delete_requires_signature(kv_server):
+    server, kv = kv_server
+    kv.put("s", "k", b"v")
+    bad = KVStoreClient(f"127.0.0.1:{server.port}", "wrong-secret")
+    with pytest.raises(PermissionError):
+        bad.delete("s", "k")
+    assert kv.get("s", "k") == b"v"
+    kv.delete("s", "k")
+    assert kv.get("s", "k") is None
+
+
+def test_fault_grammar_new_actions():
+    specs = faults.parse_spec(
+        "shard_write:rank=1:action=corrupt_write,"
+        "replica_push:step=3:action=drop_replica"
+    )
+    assert specs[0].action == "corrupt_write" and specs[0].rank == 1
+    assert specs[1].action == "drop_replica" and specs[1].step == 3
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.parse_spec("shard_write:action=corrupt")
+    # advisory actions are rejected at points that don't consume them —
+    # the spec would otherwise "fire" as a silent no-op
+    with pytest.raises(ValueError, match="silent no-op"):
+        faults.parse_spec("ckpt_write:action=corrupt_write")
+    with pytest.raises(ValueError, match="silent no-op"):
+        faults.parse_spec("worker_exit:action=drop_replica")
+    data = b"abcdef"
+    flipped = faults.corrupt_bytes(data)
+    assert flipped != data and len(flipped) == len(data)
+    assert faults.corrupt_bytes(data) == flipped  # deterministic
+
+
+# ---------------------------------------------------------------------------
+# Elastic State tier routing + provenance
+# ---------------------------------------------------------------------------
+
+
+def test_state_commit_pushes_replica_and_fresh_sync_adopts(kv_server):
+    server, kv = kv_server
+    st = State(w=np.zeros(3), step=0)
+    st._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st.w = st.w + 5.0
+    st.step = 3
+    st.commit()
+    # a "respawned incarnation": fresh State, same rank, no history
+    st2 = State(w=np.zeros(3), step=0)
+    st2._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st2.sync(LocalContext())
+    assert st2.step == 3 and st2.w.tolist() == [5.0] * 3
+    assert st2.last_restore["source"] == "peer"
+    assert st2.last_restore["replica_adopted"] is True
+    assert st2.last_restore["commits"] == 1
+
+
+def test_state_disk_fallback_when_no_replica(tmp_path, kv_server):
+    server, kv = kv_server
+    d = str(tmp_path)
+    st = State(w=np.zeros(3), step=0)
+    st.w = st.w + 2.0
+    st.step = 9
+    st.commit()
+    st._ckpt_dir = d
+    st.save_sharded(ctx=LocalContext()).wait()
+    st2 = State(w=np.zeros(3), step=0)
+    st2._replica_tier = ReplicaTier(kv, 0, [0])  # KV empty: no replica
+    st2._ckpt_dir = d
+    st2.sync(LocalContext())
+    assert st2.step == 9
+    assert st2.last_restore["source"] == "disk"
+    assert st2.last_restore["replica_adopted"] is False
+
+
+def test_interrupted_first_sync_still_records_provenance(kv_server):
+    """A cascading failure DURING the respawn's first sync (the
+    election raises after the replica was already adopted) must not
+    lose the provenance record: the retried sync still reports the
+    peer restore, even though adoption already bumped the commit
+    count."""
+    from horovod_tpu.elastic.exceptions import HorovodShutdownError
+
+    server, kv = kv_server
+    st = State(w=np.zeros(3), step=0)
+    st._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st.step = 4
+    st.commit()
+
+    class _DiesMidSync(LocalContext):
+        def sync_state(self, blob, commit_count):
+            raise HorovodShutdownError("peer died mid-election")
+
+    st2 = State(w=np.zeros(3), step=0)
+    st2._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    with pytest.raises(HorovodShutdownError):
+        st2.sync(_DiesMidSync())
+    assert st2.commits == 1  # the replica WAS adopted before the raise
+    assert st2.last_restore is None  # ...but nothing recorded yet
+    st2.sync(LocalContext())  # the elastic.run retry
+    assert st2.last_restore is not None
+    assert st2.last_restore["source"] == "peer"
+    assert st2.last_restore["replica_adopted"] is True
+    assert st2.step == 4
+
+
+def test_state_provenance_none_on_fresh_start():
+    st = State(w=np.zeros(2))
+    st._replica_tier = False
+    st.sync(LocalContext())
+    assert st.last_restore["source"] == "none"
+
+
+def test_state_corrupt_replica_falls_back_to_disk(tmp_path, kv_server):
+    """A checksum-rejected replica must not poison recovery: sync falls
+    through to the disk manifest."""
+    server, kv = kv_server
+    d = str(tmp_path)
+    st = State(w=np.zeros(3), step=0)
+    st._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st.step = 4
+    st.commit()
+    st._ckpt_dir = d
+    st.save_sharded(ctx=LocalContext()).wait()
+    kv.put(REP_SCOPE, "o0.s1.c0", b"garbage")  # corrupt the replica
+    st2 = State(w=np.zeros(3), step=0)
+    st2._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st2._ckpt_dir = d
+    st2.sync(LocalContext())
+    assert st2.step == 4
+    assert st2.last_restore["source"] == "disk"
+
+
+def test_state_stale_replica_never_shadows_newer_disk(tmp_path,
+                                                      kv_server):
+    """The replica holds commit 1 (later pushes were dropped) while the
+    disk manifest holds commit 3: sync must adopt the newer disk state,
+    and must not claim the replica restored anything."""
+    server, kv = kv_server
+    d = str(tmp_path)
+    st = State(w=np.zeros(3), step=0)
+    st._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st._ckpt_dir = d
+    st.step = 1
+    st.commit()  # replica at commit 1
+    st._replica_tier = False  # subsequent pushes "dropped"
+    st.step = 2
+    st.commit()
+    st.step = 3
+    st.commit()  # commits=3, replica still at 1
+    st.save_sharded(ctx=LocalContext()).wait()
+    st2 = State(w=np.zeros(3), step=0)
+    st2._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st2._ckpt_dir = d
+    st2.sync(LocalContext())
+    assert st2.step == 3, "stale replica shadowed the newer manifest"
+    assert st2.last_restore["source"] == "disk"
+    assert st2.last_restore["replica_adopted"] is False
+
+
+def test_state_peer_restore_never_reads_disk_shards(tmp_path, kv_server,
+                                                    monkeypatch):
+    """'Never touch cold storage': when the replica is at least as
+    fresh as the disk manifest, sync must not reassemble the disk
+    checkpoint (metadata peek only)."""
+    from horovod_tpu.ckpt import sharded as _sharded
+
+    server, kv = kv_server
+    d = str(tmp_path)
+    st = State(w=np.zeros(3), step=0)
+    st._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st._ckpt_dir = d
+    st.step = 2
+    st.commit()  # replica at commit 1
+    st.save_sharded(ctx=LocalContext()).wait()  # disk also at commit 1
+    calls = []
+    real = _sharded.restore_sharded
+    monkeypatch.setattr(_sharded, "restore_sharded",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    st2 = State(w=np.zeros(3), step=0)
+    st2._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st2._ckpt_dir = d
+    st2.sync(LocalContext())
+    assert st2.last_restore["source"] == "peer"
+    assert not calls, "peer restore read the disk checkpoint anyway"
+
+
+def test_state_replica_adopted_not_claimed_when_election_overrides():
+    """A stale replica the owner election overrides with a fresher
+    survivor broadcast must not be reported as a replica restore."""
+
+    class _SurvivorCtx(LocalContext):
+        """Election winner is a peer holding a NEWER snapshot."""
+
+        def sync_state(self, blob, commit_count):
+            return pickle.dumps(({"w": np.full(3, 9.0), "step": 7}, 7))
+
+    st = State(w=np.zeros(3), step=0)
+    st._replica_tier = _FakeTier(  # replica stale at commit 2
+        pickle.dumps(({"w": np.full(3, 2.0), "step": 2}, 2)))
+    st.sync(_SurvivorCtx())
+    assert st.step == 7
+    assert st.last_restore["source"] == "peer"
+    assert st.last_restore["replica_adopted"] is False, (
+        "a stale, overridden replica was claimed as the restore source"
+    )
+
+
+class _FakeTier:
+    def __init__(self, payload):
+        self._payload = payload
+        self.rank, self.world = 0, [0]
+
+    def fetch(self, owner=None):
+        return self._payload, {"step": 0}
+
+    def push(self, payload, *, step, commits=None):
+        return True
+
+
+def test_state_save_sharded_survives_sparse_world(tmp_path):
+    """After an elastic shrink the world can have rank gaps ({0, 2});
+    shards are indexed by world POSITION, so the save still commits
+    with dense writer indices and restores bitwise."""
+
+    class _Ctx(LocalContext):
+        def __init__(self, rank, world):
+            super().__init__()
+            self.rank, self.world, self.size = rank, world, len(world)
+
+    d = str(tmp_path)
+    st0 = State(w=np.arange(4.0), step=0)
+    st2 = State(w=np.arange(4.0), step=0)
+    for st in (st0, st2):
+        st.step = 5
+        st.commit()
+    h0 = st0.save_sharded(d, ctx=_Ctx(0, [0, 2]))
+    h2 = st2.save_sharded(d, ctx=_Ctx(2, [0, 2]))
+    h0.wait()
+    h2.wait()
+    manifest = ckpt.load_manifest(d, ckpt.latest_step(d))
+    assert manifest["world_size"] == 2
+    out = ckpt.restore_sharded(d, target={"w": np.zeros(4), "step": 0})
+    np.testing.assert_array_equal(out["w"], np.arange(4.0))
+    # a rank outside the world is told to re-rendezvous, not to corrupt
+    with pytest.raises(RuntimeError, match="not in the current world"):
+        st0.save_sharded(d, ctx=_Ctx(1, [0, 2]))
+
+
+def test_kv_delete_mac_binds_key_no_replay(kv_server):
+    """A captured DELETE MAC for one key must not replay against
+    another: the signature binds method + key."""
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    from horovod_tpu.run.rendezvous import _MAC_HEADER, _delete_mac
+
+    server, kv = kv_server
+    kv.put("s", "a", b"1")
+    kv.put("s", "b", b"2")
+    mac_for_a = _delete_mac(server.secret, "s/a")
+    req = Request(f"http://127.0.0.1:{server.port}/s/b", method="DELETE")
+    req.add_header(_MAC_HEADER, mac_for_a)  # the replay
+    with pytest.raises(HTTPError) as err:
+        urlopen(req, timeout=5)
+    assert err.value.code == 403
+    assert kv.get("s", "b") == b"2"
+    kv.delete("s", "a")
+    assert kv.get("s", "a") is None
+
+
+def test_restore_provenance_lands_in_flightrec_ring(tmp_path):
+    from horovod_tpu.obs import flightrec
+
+    st = State(w=np.zeros(2))
+    st._replica_tier = False
+    st._ckpt_dir = str(tmp_path)  # tier armed (empty dir): recorded
+    st.sync(LocalContext())
+    events = [e for e in flightrec.get_recorder().snapshot()
+              if e["kind"] == "ckpt.restore"]
+    assert events, "sync recorded no ckpt.restore event"
+    assert "source=none" in events[-1]["detail"]
+
+
+def test_unarmed_fresh_start_stays_quiet():
+    """A job with NO ckpt tier configured must not emit provenance
+    metrics or flight-recorder events — quiet jobs stay quiet — while
+    the API answer (last_restore) is still available."""
+    from horovod_tpu.obs import flightrec, get_registry
+
+    before = get_registry().counter("ckpt.restore_source",
+                                    source="none").value
+    n_events = len([e for e in flightrec.get_recorder().snapshot()
+                    if e["kind"] == "ckpt.restore"])
+    st = State(w=np.zeros(2))
+    st._replica_tier = False  # no tier, no ckpt dir
+    st.sync(LocalContext())
+    assert st.last_restore["source"] == "none"
+    assert get_registry().counter("ckpt.restore_source",
+                                  source="none").value == before
+    assert len([e for e in flightrec.get_recorder().snapshot()
+                if e["kind"] == "ckpt.restore"]) == n_events
+
+
+def test_restore_provenance_metrics_counters(kv_server):
+    from horovod_tpu.obs import get_registry
+
+    server, kv = kv_server
+    st = State(w=np.zeros(2), step=0)
+    st._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    st.step = 1
+    st.commit()
+    st2 = State(w=np.zeros(2), step=0)
+    st2._replica_tier = ReplicaTier(kv, 0, [0], chunk_bytes=64)
+    before = get_registry().counter("ckpt.restore_source",
+                                    source="peer").value
+    st2.sync(LocalContext())
+    reg = get_registry()
+    assert reg.counter("ckpt.restore_source",
+                       source="peer").value == before + 1
+    assert reg.histogram("ckpt.restore_ms").count >= 1
+    assert reg.counter("ckpt.replica_pushes").value >= 1
+    assert reg.histogram("ckpt.replica_push_ms").count >= 1
+
+
+# ---------------------------------------------------------------------------
+# Surfacing: post-mortem, summary, CLI
+# ---------------------------------------------------------------------------
+
+
+def _fake_dump(rank, events, trigger="signal:SIGTERM", epoch=0):
+    from horovod_tpu.obs import flightrec
+
+    return {
+        "schema": flightrec.SCHEMA,
+        "rank": rank,
+        "epoch": epoch,
+        "trigger": trigger,
+        "wall_time": 1000.0 + rank,
+        "recorded": len(events),
+        "overwritten": 0,
+        "events": events,
+        "last_exception": None,
+    }
+
+
+def test_postmortem_surfaces_restore_provenance():
+    from horovod_tpu.obs import postmortem
+
+    dumps = [
+        _fake_dump(0, [
+            {"seq": 0, "t": 1.0, "kind": "rendezvous", "name": "epoch1",
+             "cycle": 1, "detail": "world=[0, 1]"},
+            {"seq": 1, "t": 1.1, "kind": "ckpt.restore", "name": "commit4",
+             "cycle": 4, "detail": "source=none replica=False ms=1"},
+        ]),
+        _fake_dump(1, [
+            {"seq": 0, "t": 1.0, "kind": "rendezvous", "name": "epoch1",
+             "cycle": 1, "detail": "world=[0, 1]"},
+            {"seq": 1, "t": 1.2, "kind": "ckpt.restore", "name": "commit4",
+             "cycle": 4, "detail": "source=peer replica=True ms=42"},
+        ], trigger="signal:SIGABRT"),
+    ]
+    report = postmortem.analyze(dumps, expected_ranks=2)
+    prov = report["restore_provenance"]
+    assert prov["1"]["source"] == "peer"
+    assert prov["1"]["replica_adopted"] is True
+    assert prov["1"]["ms"] == 42.0
+    assert prov["0"]["source"] == "none"
+    text = postmortem.verdict(report)
+    assert "rank 1 restored from a live peer at commit 4" in text
+
+
+def test_summary_ckpt_section_renders():
+    from horovod_tpu.obs.summary import ckpt_section
+
+    dumps = {
+        "0": {"metrics": [
+            {"name": "ckpt.restore_source", "type": "counter",
+             "tags": {"source": "peer"}, "value": 1},
+            {"name": "ckpt.replica_pushes", "type": "counter",
+             "tags": {}, "value": 5},
+            {"name": "ckpt.restore_ms", "type": "histogram", "tags": {},
+             "count": 1, "sum": 40.0, "min": 40.0, "max": 40.0,
+             "mean": 40.0, "p50": 40.0, "p90": 40.0, "p99": 40.0},
+        ]},
+        "1": {"metrics": []},
+    }
+    text = ckpt_section(dumps)
+    assert "rank 0: restores peer=1, replica pushes 5" in text
+    assert "restore time" in text
+    assert "rank 1" not in text  # quiet ranks stay quiet
+    assert ckpt_section({"0": {"metrics": []}}) is None
+
+
+def test_live_digest_gains_ckpt_token():
+    from horovod_tpu.obs.live import LiveAggregator
+
+    agg = LiveAggregator()
+    agg.ingest({"rank": 0, "epoch": 0, "seq": 1, "metrics": [
+        {"n": "ckpt.restore_source", "k": "c",
+         "g": {"source": "peer"}, "v": 1},
+        {"n": "ckpt.replica_pushes", "k": "c", "v": 8},
+        {"n": "ckpt.replica_push_ms", "k": "h", "c": 8, "s": 24.0,
+         "mn": 1, "mx": 9, "q50": 3.0, "q90": 8.0, "q99": 9.0},
+    ]})
+    # a second, slower rank: the digest must surface the WORST p50,
+    # not whichever view iterates last
+    agg.ingest({"rank": 1, "epoch": 0, "seq": 1, "metrics": [
+        {"n": "ckpt.replica_pushes", "k": "c", "v": 8},
+        {"n": "ckpt.replica_push_ms", "k": "h", "c": 8, "s": 7200.0,
+         "mn": 800, "mx": 990, "q50": 900.0, "q90": 980.0,
+         "q99": 990.0},
+    ]})
+    assert "ckpt restores peer=1 pushes 16 (worst p50 900ms)" \
+        in agg.digest(2)
+    # quiet jobs stay quiet: no ckpt token without tier activity
+    agg2 = LiveAggregator()
+    agg2.ingest({"rank": 0, "epoch": 0, "seq": 1, "metrics": [
+        {"n": "engine.collectives_completed", "k": "c", "v": 4},
+    ]})
+    assert "ckpt" not in agg2.digest(1)
+
+
+def test_cli_ckpt_knobs_map_to_env():
+    from horovod_tpu.run.config_parser import set_env_from_args
+    from horovod_tpu.run.runner import parse_args
+
+    args = parse_args([
+        "-np", "2", "--ckpt-replica", "--ckpt-dir", "/ckpts",
+        "--ckpt-replica-chunk-kb", "256",
+        "--ckpt-commit-timeout-secs", "30", "python", "x",
+    ])
+    env = {}
+    set_env_from_args(env, args)
+    assert env["HVDTPU_CKPT_REPLICA"] == "1"
+    assert env["HVDTPU_CKPT_DIR"] == "/ckpts"
+    assert env["HVDTPU_CKPT_REPLICA_CHUNK_KB"] == "256"
+    assert env["HVDTPU_CKPT_COMMIT_TIMEOUT_SECS"] == "30.0"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end chaos (real processes through the elastic launcher)
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_chaos_train(total_steps=8):
+    import numpy as np  # noqa: PLC0415
+
+    import horovod_tpu.elastic as elastic  # noqa: PLC0415
+
+    ctx = elastic.context()
+    state = elastic.State(w=np.zeros(4, dtype=np.float64), step=0)
+
+    @elastic.run
+    def loop(state):
+        while state.step < total_steps:
+            grad = np.full(4, float(state.step + 1) * (ctx.rank + 1))
+            state.w = state.w - 0.1 * ctx.allreduce(
+                grad, name=f"g{state.step}")
+            state.step += 1
+            state.commit()
+        return state.w.tolist(), state.step, state.last_restore
+
+    return loop(state)
+
+
+@pytest.mark.multiprocess
+def test_ckpt_chaos_respawn_restores_from_peer_replica(tmp_path):
+    """ISSUE 7 acceptance: 2-proc elastic job with the replica tier on;
+    rank 1 is killed mid-epoch; its respawned incarnation restores from
+    its predecessor's in-memory replica (provenance says peer, the
+    replica specifically), training resumes from the last commit, and
+    the job finishes with the no-fault run's state — in seconds, never
+    touching disk."""
+    bb = str(tmp_path / "bb")
+    os.makedirs(bb)  # a non-existent spec would resolve as a plain path
+    clean_env = {"JAX_PLATFORMS": "cpu", "HVDTPU_CKPT_REPLICA": "1"}
+    fault_env = dict(clean_env,
+                     HVDTPU_FAULT_SPEC="worker_exit:step=5:rank=1",
+                     HVDTPU_FLIGHTREC_DUMP=bb)
+
+    clean, _ = elastic.launch(_ckpt_chaos_train, np=2, env=clean_env,
+                              timeout=120)
+    faulted, job = elastic.launch(_ckpt_chaos_train, np=2, env=fault_env,
+                                  max_retries=2, timeout=120)
+
+    assert sorted(faulted) == [0, 1]
+    for rank in (0, 1):
+        assert faulted[rank][0] == clean[rank][0]
+        assert faulted[rank][1] == 8
+    events = [e[0] for e in job.trace]
+    assert events.count("respawn") == 1
+
+    # The respawned rank 1 restored from its peer replica, fast.
+    prov = faulted[1][2]
+    assert prov is not None and prov["source"] == "peer", prov
+    assert prov["replica_adopted"] is True, (
+        "rank 1 adopted a live survivor broadcast, not its "
+        f"predecessor's replica: {prov}"
+    )
+    assert prov["commits"] >= 1
+    assert prov["ms"] < 30_000, prov  # seconds, not minutes
+    # Rank 0 (the survivor) recovered nothing: it rolled back to its
+    # own commit.
+    assert faulted[0][2] is not None and faulted[0][2]["source"] == "none"
+
+    # Provenance reached the respawned incarnation's black box.
+    dumps = glob.glob(os.path.join(bb, "flightrec.e*.rank.1.json"))
+    restored = []
+    for p in dumps:
+        with open(p) as f:
+            doc = json.load(f)
+        restored += [e for e in doc.get("events", [])
+                     if e.get("kind") == "ckpt.restore"
+                     and "source=peer" in e.get("detail", "")]
+    assert restored, f"no peer-sourced ckpt.restore event in {dumps}"
+
+
+@pytest.mark.multiprocess
+def test_ckpt_chaos_shrink_keeps_state_after_world_change(tmp_path):
+    """Elastic world change (N->M): the respawn budget is 0, so losing
+    rank 1 shrinks 3 -> 2; the survivors' state is unaffected and the
+    job completes — committed state survives a world-size change."""
+    env = {"JAX_PLATFORMS": "cpu", "HVDTPU_CKPT_REPLICA": "1",
+           "HVDTPU_FAULT_SPEC": "worker_exit:step=3:rank=1"}
+    results, job = elastic.launch(
+        _ckpt_chaos_train, np=3, env=env, min_workers=2, max_retries=0,
+        timeout=120)
+    assert job.world == [0, 2]
+    assert all(results[r][1] == 8 for r in results)
+    assert "shrink" in [e[0] for e in job.trace]
