@@ -355,17 +355,17 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
         loss = jax.lax.pmean(loss, hvd.DP_AXIS)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops.collectives import shard_map_compat
 
     mesh = hvd.mesh("flat")
     step = jax.jit(
-        shard_map(
+        shard_map_compat(
             local_step,
             mesh=mesh,
             in_specs=(P(), P(), P(hvd.DP_AXIS)),
             out_specs=(P(), P(), P()),
-            check_vma=False,
         ),
         donate_argnums=(0, 1),
     )
@@ -455,17 +455,17 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops.collectives import shard_map_compat
 
     mesh = hvd.mesh("flat")
     step = jax.jit(
-        shard_map(
+        shard_map_compat(
             local_step,
             mesh=mesh,
             in_specs=(P(), P(), P(), P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
             out_specs=(P(), P(), P(), P()),
-            check_vma=False,
         ),
         donate_argnums=(0, 1, 2),
     )
@@ -659,6 +659,11 @@ def collect_engine_gauges() -> dict:
             "engine.stats.replay_cycles",
             "engine.stats.replay_epochs",
             "engine.stats.replay_breaks",
+            # Two-fabric counters (multislice): what the DCN actually
+            # carried vs ICI, and the DCN wire compression factor.
+            "engine.dcn_bytes",
+            "engine.ici_bytes",
+            "engine.dcn_compression_ratio",
         }
         out = {}
         for m in get_registry().snapshot():
@@ -717,6 +722,11 @@ def main() -> int:
                         help="space-to-depth stem (MLPerf TPU recipe)")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (dev mode; numbers not comparable)")
+    parser.add_argument("--num-slices", type=int, default=0,
+                        help="force a multislice partition "
+                        "(HVDTPU_NUM_SLICES) so the record embeds the "
+                        "per-fabric byte counters; 0 = discovered "
+                        "topology")
     parser.add_argument("--attempts", type=int, default=4,
                         help="retries (fresh process) on tunnel UNAVAILABLE")
     parser.add_argument("--watchdog-secs", type=int, default=780,
@@ -739,6 +749,9 @@ def main() -> int:
         # be flipped back.
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
+    if args.num_slices > 0:
+        # Before hvd.init(): the slice partition is resolved there.
+        os.environ["HVDTPU_NUM_SLICES"] = str(args.num_slices)
 
     is_gpt = args.model.startswith("gpt-")
     if args.batch_size is None:
@@ -846,6 +859,13 @@ def main() -> int:
     gauges = collect_engine_gauges()
     if gauges:
         out["engine_gauges"] = gauges
+    try:
+        import horovod_tpu as hvd  # noqa: PLC0415
+
+        if hvd.num_slices() > 1:
+            out["num_slices"] = hvd.num_slices()
+    except Exception:
+        pass
     on_cpu = jax.devices()[0].platform == "cpu"
     if on_cpu:
         # A CPU measurement is a trajectory placeholder, not a perf

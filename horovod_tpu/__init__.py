@@ -31,6 +31,10 @@ from .basics import (  # noqa: F401
     num_devices,
     device_rank,
     is_homogeneous,
+    slice_id,
+    num_slices,
+    slice_size,
+    slice_of_rank,
     xla_collectives_built,
     native_engine_built,
     mpi_built,
@@ -46,6 +50,7 @@ from .basics import (  # noqa: F401
     DP_AXIS,
     CROSS_AXIS,
     LOCAL_AXIS,
+    SLICE_AXIS,
 )
 from .ops.collectives import (  # noqa: F401
     ReduceOp,

@@ -57,11 +57,16 @@ __all__ = [
     "num_devices",
     "device_rank",
     "is_homogeneous",
+    "slice_id",
+    "num_slices",
+    "slice_size",
+    "slice_of_rank",
     "mesh",
     "global_topology",
     "DP_AXIS",
     "CROSS_AXIS",
     "LOCAL_AXIS",
+    "SLICE_AXIS",
 ]
 
 # Canonical mesh axis names.  DP_AXIS is the flat data-parallel axis every
@@ -72,6 +77,11 @@ __all__ = [
 DP_AXIS = "hvd"
 CROSS_AXIS = "hvd_cross"
 LOCAL_AXIS = "hvd_local"
+# Outermost axis of the 3-level (slice, host, chip) multislice mesh:
+# collectives over SLICE_AXIS ride DCN, everything inside a slice rides
+# ICI (the fabric split NCCLHierarchicalAllreduce reasons about,
+# nccl_operations.cc:218-229, mapped onto TPU pods).
+SLICE_AXIS = "hvd_slice"
 
 
 class NotInitializedError(RuntimeError):
@@ -94,6 +104,12 @@ class Topology:
     cross_size: int
     devices: Sequence[jax.Device] = field(default_factory=list)
     homogeneous: bool = True
+    # Slice partition of the job (ICI within a slice, DCN between):
+    # devices split into num_slices contiguous equal groups; slice_id is
+    # the group this process's devices live in.  1 slice = single-pod
+    # job, every fabric-aware path degenerates to flat.
+    num_slices: int = 1
+    slice_id: int = 0
     # Whether init() started jax.distributed itself; shutdown() only tears
     # down what it owns (≙ the reference's MPIContextManager negotiating
     # MPI_Init/Finalize ownership, horovod/common/mpi/mpi_context.cc).
@@ -112,6 +128,96 @@ _mesh_cache: dict = {}
 def _env_int(name: str, default: int) -> int:
     value = os.environ.get(name)
     return int(value) if value not in (None, "") else default
+
+
+def resolve_slice_partition(
+    world: int,
+    proc: int,
+    devices: Sequence,
+    env: Optional[dict] = None,
+) -> tuple:
+    """Resolve the slice partition of the job -> ``(num_slices, slice_id)``.
+
+    Priority (each level validated, invalid values downgrade to the next
+    with one warning rather than killing the job):
+
+    1. ``HVDTPU_NUM_SLICES``  — forced count of contiguous process blocks.
+    2. ``HVDTPU_SLICE_SIZE``  — forced processes-per-slice (the CPU/dev
+       simulation knob: a 4-proc world with SLICE_SIZE=2 behaves like
+       two 2-host slices, so every multislice code path is testable on
+       a laptop).
+    3. Platform discovery — ``jax.Device.slice_index`` is populated on
+       real multislice TPU deployments; distinct values define slices.
+    4. Single slice.
+
+    A forced partition must divide the world evenly (equal slices are
+    what make the hierarchical schedule's shard math rank-symmetric).
+    Pure function of its inputs so the partition logic is unit-testable
+    without re-initializing a topology.
+    """
+    from .utils.logging import get_logger  # noqa: PLC0415
+
+    log = get_logger("basics")
+    e = os.environ if env is None else env
+
+    def _val(name):
+        raw = e.get(name)
+        try:
+            return int(raw) if raw not in (None, "") else 0
+        except ValueError:
+            log.warning("%s=%r is not an integer; ignoring", name, raw)
+            return 0
+
+    # The unit a forced partition divides: processes in a real multi-proc
+    # world, devices in a single-process world (where SLICE_SIZE means
+    # chips-per-slice — the 8-virtual-device in-process test topology).
+    units = world if world > 1 else max(len(devices), 1)
+    n = _val("HVDTPU_NUM_SLICES")
+    if n <= 0:
+        ssize = _val("HVDTPU_SLICE_SIZE")
+        if ssize > 0:
+            if units % ssize:
+                log.warning(
+                    "HVDTPU_SLICE_SIZE=%d does not divide the %d-unit "
+                    "world; running single-slice", ssize, units,
+                )
+            else:
+                n = units // ssize
+    if n > 1:
+        if units % n:
+            log.warning(
+                "forced slice count %d does not divide the %d-unit world; "
+                "running single-slice", n, units,
+            )
+            return 1, 0
+        return n, (proc // (world // n)) if world > 1 else 0
+    if n == 1:
+        return 1, 0
+    # Platform discovery: slice_index exists (and differs) only on real
+    # multislice TPU deployments.
+    try:
+        indices = sorted(
+            {getattr(d, "slice_index", None) for d in devices} - {None}
+        )
+    except TypeError:
+        indices = []
+    if len(indices) > 1:
+        mine = sorted(
+            {
+                getattr(d, "slice_index", None)
+                for d in devices
+                if getattr(d, "process_index", 0) == proc
+            }
+            - {None}
+        )
+        if len(mine) == 1:
+            return len(indices), indices.index(mine[0])
+        log.warning(
+            "process %d spans multiple slices %s; treating the job as "
+            "single-slice (hierarchical collectives need slice-aligned "
+            "processes)", proc, mine,
+        )
+    return 1, 0
 
 
 def init(comm=None) -> Topology:
@@ -186,6 +292,11 @@ def init(comm=None) -> Topology:
             per_proc[d.process_index] = per_proc.get(d.process_index, 0) + 1
         homogeneous = len(set(per_proc.values())) <= 1
 
+        eff_world = world if world > 1 else 1
+        eff_proc = proc if world > 1 else 0
+        n_slices, slice_i = resolve_slice_partition(
+            eff_world, eff_proc, devices
+        )
         _topology = Topology(
             process_rank=proc if world > 1 else 0,
             process_count=world if world > 1 else 1,
@@ -195,9 +306,27 @@ def init(comm=None) -> Topology:
             cross_size=_env_int("HVDTPU_CROSS_SIZE", world if world > 1 else 1),
             devices=devices,
             homogeneous=homogeneous,
+            num_slices=n_slices,
+            slice_id=slice_i,
             owns_jax_distributed=owns_distributed,
         )
         del local_devices
+        # The hierarchical knob without a multi-slice topology is a
+        # no-op; one clear line beats silent downgrade (the flat XLA
+        # psum is already torus-optimal within a single slice, so this
+        # is a downgrade in name only — but the user should know).
+        from .utils import env as envmod  # noqa: PLC0415
+
+        if n_slices < 2 and envmod.env_bool(envmod.HIERARCHICAL_ALLREDUCE):
+            from .utils.logging import get_logger  # noqa: PLC0415
+
+            get_logger("basics").warning(
+                "--hierarchical-allreduce requested but this topology "
+                "has a single slice; flat allreduce is already optimal "
+                "on one ICI domain — knob downgraded (force a partition "
+                "with HVDTPU_NUM_SLICES/HVDTPU_SLICE_SIZE to test the "
+                "two-fabric path)"
+            )
 
     # Arm the observability plane: first registry use installs the
     # HVDTPU_METRICS_DUMP exit hook, so every initialized rank leaves a
@@ -336,6 +465,48 @@ def is_homogeneous() -> bool:
     return global_topology().homogeneous
 
 
+def slice_id() -> int:
+    """Which slice this process's devices live in (0 on single-slice
+    jobs).  Slices are the DCN-connected partitions of a multislice job;
+    everything within a slice shares ICI."""
+    return global_topology().slice_id
+
+
+def num_slices() -> int:
+    """Number of DCN-connected slices in the job (1 = single-pod)."""
+    return global_topology().num_slices
+
+
+def slice_size() -> int:
+    """Ranks per slice (the ``local_size`` of the two-fabric hierarchy:
+    the cross-slice phase of hierarchical allreduce carries
+    1/slice_size of the bytes).  On the single-process dev topology —
+    where the forced partition splits DEVICES, not processes — this is
+    chips per slice, and it is always >= 1."""
+    topo = global_topology()
+    if topo.num_slices <= 1:
+        return topo.process_count
+    if (
+        topo.process_count > 1
+        and topo.process_count % topo.num_slices == 0
+    ):
+        return topo.process_count // topo.num_slices
+    if topo.num_devices % topo.num_slices == 0:
+        return max(topo.num_devices // topo.num_slices, 1)
+    return 1
+
+
+def slice_of_rank(rank: int) -> int:
+    """Slice containing process ``rank`` (contiguous-block partition —
+    the single mapping the engine, the straggler tagger and the launcher
+    blacklist all share, so a slice-level verdict can never name a
+    different slice than the data plane ran on)."""
+    topo = global_topology()
+    if topo.num_slices <= 1 or topo.process_count % topo.num_slices:
+        return 0
+    return int(rank) // (topo.process_count // topo.num_slices)
+
+
 # -- feature probes (reference horovod_mpi_built/_enabled, horovod_gloo_*,
 # horovod_nccl_built, horovod_mpi_threads_supported — operations.cc:726-799,
 # basics.py:131-210).  The TPU build's transports are XLA collectives and
@@ -395,6 +566,28 @@ def ddl_built() -> bool:
     return False
 
 
+def slice_grid(
+    devices: Sequence, num_slices: int, hosts: int
+) -> np.ndarray:
+    """Reshape a flat device list into the 3-level (slice, host, chip)
+    view: contiguous device blocks per slice, contiguous per host within
+    it.  ``hosts`` is the number of host groups WITHIN one slice (1 when
+    the host level degenerates, e.g. a single-process dev world forced
+    into chip-level slices).  Pure function for unit-testability."""
+    devices = np.asarray(devices, dtype=object)
+    total = devices.size
+    if num_slices < 1 or total % num_slices:
+        raise ValueError(
+            f"cannot partition {total} devices into {num_slices} slices"
+        )
+    per_slice = total // num_slices
+    if hosts < 1 or per_slice % hosts:
+        raise ValueError(
+            f"cannot split a {per_slice}-device slice over {hosts} hosts"
+        )
+    return devices.reshape(num_slices, hosts, per_slice // hosts)
+
+
 def mesh(shape: str = "flat") -> jax.sharding.Mesh:
     """Build (and cache) the named device mesh collectives compile over.
 
@@ -405,6 +598,13 @@ def mesh(shape: str = "flat") -> jax.sharding.Mesh:
                          NCCLHierarchicalAllreduce, nccl_operations.cc:162-300).
                          Collectives over LOCAL_AXIS ride ICI; CROSS_AXIS
                          rides DCN.
+    ``slice``         -> 3D mesh (SLICE_AXIS=slices, CROSS_AXIS=hosts
+                         within a slice, LOCAL_AXIS=chips/host): the full
+                         two-fabric view of a multislice job.  SLICE_AXIS
+                         collectives ride DCN; the inner two axes ride
+                         ICI.  Requires a multi-slice topology (forced
+                         via HVDTPU_NUM_SLICES/HVDTPU_SLICE_SIZE on dev
+                         worlds, discovered on real multislice TPU).
     """
     topo = global_topology()
     if shape in _mesh_cache:
@@ -422,6 +622,22 @@ def mesh(shape: str = "flat") -> jax.sharding.Mesh:
         per = len(devices) // max(hosts, 1)
         m = jax.sharding.Mesh(
             devices.reshape(hosts, per), (CROSS_AXIS, LOCAL_AXIS)
+        )
+    elif shape == "slice":
+        if topo.num_slices < 2:
+            raise ValueError(
+                "mesh('slice') needs a multi-slice topology; force one "
+                "with HVDTPU_NUM_SLICES / HVDTPU_SLICE_SIZE on dev worlds"
+            )
+        hosts = (
+            topo.process_count // topo.num_slices
+            if topo.process_count > 1
+            and topo.process_count % topo.num_slices == 0
+            else 1
+        )
+        m = jax.sharding.Mesh(
+            slice_grid(devices, topo.num_slices, hosts),
+            (SLICE_AXIS, CROSS_AXIS, LOCAL_AXIS),
         )
     else:
         raise ValueError(f"unknown mesh shape {shape!r}")

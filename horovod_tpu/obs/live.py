@@ -133,13 +133,17 @@ class LiveAggregator:
             )
         if verdict is None:
             return None
-        return {
+        out = {
             "rank": verdict["rank"],
             "last_arrivals": verdict["last_arrivals"],
             "share": verdict["share"],
             "worst_skew_ms": verdict["worst_skew_ms"],
             "ops_with_skew": int(verdict["skew"]["count"] or 0),
         }
+        if "slice" in verdict:
+            out["slice"] = verdict["slice"]
+            out["slice_share"] = verdict["slice_share"]
+        return out
 
     # ----------------------------------------------------------- digest
 
@@ -160,17 +164,26 @@ class LiveAggregator:
             "phase " + "/".join(phases),
         ]
         if strag is not None:
-            parts.append(
+            token = (
                 f"straggler rank {strag['rank']} "
                 f"({strag['last_arrivals']} last-arrivals, "
                 f"{strag['share']:.0%}, worst skew "
                 f"{strag['worst_skew_ms']:.0f}ms)"
             )
+            if "slice" in strag:
+                token += (
+                    f" — slice {strag['slice']} is the straggler "
+                    f"({strag['slice_share']:.0%} of blame)"
+                )
+            parts.append(token)
         else:
             parts.append("straggler none")
         tuner = self._tuner_part(views)
         if tuner:
             parts.append(tuner)
+        fabric = self._fabric_part(views)
+        if fabric:
+            parts.append(fabric)
         ckpt = self._ckpt_part(views)
         if ckpt:
             parts.append(ckpt)
@@ -213,6 +226,35 @@ class LiveAggregator:
             if skip is not None:
                 return f"neg-skip {skip:.0%}"
         return None
+
+    @staticmethod
+    def _fabric_part(views) -> Optional[str]:
+        """One digest token for the two-fabric data path (multislice
+        jobs): bytes over DCN vs ICI and the DCN compression factor —
+        absent on single-slice jobs, whose planes never touch these
+        counters.  Worst (max) per-rank view: the counters are
+        deterministic and near-identical across ranks, and max never
+        under-reports a fabric."""
+        dcn = ici = 0.0
+        ratio = None
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                if name == "engine.dcn_bytes":
+                    dcn = max(dcn, float(m["value"]))
+                elif name == "engine.ici_bytes":
+                    ici = max(ici, float(m["value"]))
+                elif name == "engine.dcn_compression_ratio":
+                    v = float(m["value"])
+                    ratio = v if ratio is None else max(ratio, v)
+        if not dcn and not ici:
+            return None
+        token = f"fabric dcn {dcn / 1e6:.1f}MB ici {ici / 1e6:.1f}MB"
+        if ici:
+            token += f" (dcn/ici {dcn / ici:.2f})"
+        if ratio and ratio > 1.0:
+            token += f" wire x{ratio:.1f}"
+        return token
 
     @staticmethod
     def _ckpt_part(views) -> Optional[str]:

@@ -53,6 +53,20 @@ __all__ = [
 ]
 
 
+def _slice_tag(rank: int) -> dict:
+    """``{"slice": str(k)}`` on multi-slice topologies, ``{}`` otherwise.
+    Single-slice jobs keep the untagged counter identity so their dumps
+    (and every existing consumer) are byte-compatible."""
+    try:
+        from .. import basics  # noqa: PLC0415
+
+        if basics.is_initialized() and basics.num_slices() > 1:
+            return {"slice": str(basics.slice_of_rank(rank))}
+    except Exception:
+        pass
+    return {}
+
+
 def record(
     rank: int,
     skew_ms: float,
@@ -61,9 +75,14 @@ def record(
     timeline=None,
     alert_ms: float = 0.0,
 ) -> None:
-    """Blame ``rank`` for one collective's arrival skew of ``skew_ms``."""
+    """Blame ``rank`` for one collective's arrival skew of ``skew_ms``.
+    On multi-slice topologies the last-arrivals counter also carries the
+    blamed rank's slice, so the merger can name the straggling SLICE —
+    the actionable unit when a whole pod's DCN link is the problem."""
     reg = get_registry()
-    reg.counter(PREFIX + "last_arrivals", rank=str(rank)).inc()
+    reg.counter(
+        PREFIX + "last_arrivals", rank=str(rank), **_slice_tag(rank)
+    ).inc()
     reg.histogram(PREFIX + "skew_ms").observe(skew_ms)
     worst = reg.gauge(PREFIX + "worst_skew_ms")
     if skew_ms > worst.value:
@@ -123,8 +142,13 @@ def merge_blames(metric_lists) -> Optional[dict]:
     double-counting agreement).  Returns None when nobody was blamed,
     else ``{rank, last_arrivals, share, blames, skew, worst_skew_ms,
     alerts}`` with ``blames`` the full per-rank merged counts and
-    ``skew`` the largest reporter's histogram fields."""
+    ``skew`` the largest reporter's histogram fields.  When the counters
+    carry slice tags (multi-slice jobs), the verdict also includes
+    ``slice`` (the slice whose ranks drew the most blame) and
+    ``slice_blames`` — the slice-level verdict the live digest and the
+    summary print as "slice K is the straggler"."""
     blames: Dict[int, int] = {}
+    rank_slice: Dict[int, int] = {}
     worst_skew = 0.0
     skew = {"count": 0, "p50": None, "p99": None, "max": None}
     alerts = 0
@@ -132,12 +156,18 @@ def merge_blames(metric_lists) -> Optional[dict]:
         for m in metrics:
             name = m.get("name", "")
             if name == PREFIX + "last_arrivals":
+                tags = m.get("tags") or {}
                 try:
-                    blamed = int((m.get("tags") or {})["rank"])
+                    blamed = int(tags["rank"])
                 except (KeyError, TypeError, ValueError):
                     continue
                 blames[blamed] = max(blames.get(blamed, 0),
                                      int(m["value"]))
+                if "slice" in tags:
+                    try:
+                        rank_slice[blamed] = int(tags["slice"])
+                    except (TypeError, ValueError):
+                        pass
             elif name == PREFIX + "worst_skew_ms":
                 worst_skew = max(worst_skew, float(m["value"]))
             elif name == PREFIX + "skew_ms":
@@ -150,7 +180,7 @@ def merge_blames(metric_lists) -> Optional[dict]:
         return None
     top = max(blames, key=lambda r: (blames[r], -r))
     total = sum(blames.values())
-    return {
+    verdict = {
         "rank": top,
         "last_arrivals": blames[top],
         "share": blames[top] / total if total else 0.0,
@@ -159,6 +189,22 @@ def merge_blames(metric_lists) -> Optional[dict]:
         "worst_skew_ms": round(worst_skew, 3),
         "alerts": alerts,
     }
+    if rank_slice:
+        slice_blames: Dict[int, int] = {}
+        for r, count in blames.items():
+            s = rank_slice.get(r)
+            if s is not None:
+                slice_blames[s] = slice_blames.get(s, 0) + count
+        if slice_blames:
+            top_slice = max(
+                slice_blames, key=lambda s: (slice_blames[s], -s)
+            )
+            verdict["slice"] = top_slice
+            verdict["slice_blames"] = slice_blames
+            verdict["slice_share"] = (
+                slice_blames[top_slice] / total if total else 0.0
+            )
+    return verdict
 
 
 def reset() -> None:

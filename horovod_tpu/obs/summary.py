@@ -20,6 +20,7 @@ __all__ = [
     "collect_dumps",
     "format_summary_table",
     "straggler_section",
+    "fabric_section",
     "summarize",
 ]
 
@@ -132,7 +133,46 @@ def straggler_section(dumps: Dict[str, dict]) -> Optional[str]:
         )
     if verdict["alerts"]:
         lines.append(f"alerts past --alert-skew-ms: {verdict['alerts']}")
+    if "slice" in verdict:
+        lines.append(
+            f"slice {verdict['slice']} is the straggler "
+            f"({verdict['slice_share']:.0%} of blame; per-slice "
+            + " ".join(
+                f"{s}={c}"
+                for s, c in sorted(verdict["slice_blames"].items())
+            )
+            + ")"
+        )
     return "\n".join(lines)
+
+
+def fabric_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job two-fabric byte report (multislice jobs): per-rank
+    DCN vs ICI bytes the data plane moved and the DCN wire compression
+    factor.  None when no rank touched the fabric counters — single-
+    slice jobs see no new output."""
+    rows = []
+    for label in sorted(dumps, key=_rank_sort_key):
+        dcn = ici = 0.0
+        ratio = None
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if name == "engine.dcn_bytes":
+                dcn = float(m["value"])
+            elif name == "engine.ici_bytes":
+                ici = float(m["value"])
+            elif name == "engine.dcn_compression_ratio":
+                ratio = float(m["value"])
+        if not dcn and not ici:
+            continue
+        row = (
+            f"rank {label}: dcn {dcn:.3g} B, ici {ici:.3g} B"
+            + (f", dcn/ici {dcn / ici:.3f}" if ici else "")
+        )
+        if ratio and ratio > 1.0:
+            row += f", dcn wire compressed x{ratio:.1f}"
+        rows.append(row)
+    return "\n".join(rows) if rows else None
 
 
 def ckpt_section(dumps: Dict[str, dict]) -> Optional[str]:

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..basics import DP_AXIS
+from .collectives import axis_size
 
 __all__ = ["adasum_allreduce", "adasum_combine"]
 
@@ -93,7 +94,7 @@ def adasum_allreduce(tensor, *, axis_name: str = DP_AXIS):
     fp16 inputs but accumulates dots in double; bf16 inputs here would lose
     the projection's precision), casting back at the end.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n & (n - 1) != 0:
         raise ValueError(f"Adasum requires a power-of-2 world size, got {n}")
 

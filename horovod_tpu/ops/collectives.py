@@ -101,8 +101,35 @@ def axis_rank(axis_name: str = DP_AXIS):
 
 
 def axis_size(axis_name: str = DP_AXIS) -> int:
-    """Static width of the collective axis."""
-    return lax.axis_size(axis_name)
+    """Static width of the collective axis.  ``lax.axis_size`` across
+    the jax version drift: older releases lack it, where ``psum(1, axis)``
+    constant-folds to the same static width (the documented pre-axis_size
+    idiom)."""
+    size = getattr(lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """shard_map across the jax version drift: newer jax exposes
+    ``jax.shard_map`` (replication check kwarg ``check_vma``), older
+    releases only ``jax.experimental.shard_map.shard_map``
+    (``check_rep``).  The ONE shim the data plane, the jit optimizer
+    path, and the bench all build their shard_maps through — without it
+    every one of those paths is dead on the older interpreter."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as sm  # noqa: PLC0415
+
+    return sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 # ---------------------------------------------------------------------------

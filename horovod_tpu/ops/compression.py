@@ -5,25 +5,51 @@ The reference halves allreduce bytes by casting fp32 grads to fp16 before
 the wire and back after.  On TPU the natural wire dtype is **bfloat16**
 (same exponent range as fp32, native MXU/ICI support), so that is the
 default compressor; fp16 is kept for parity.
+
+Multi-slice jobs use these compressors on the DCN leg of hierarchical
+allreduce (``--dcn-compression``): only the 1/local_size shard that
+crosses the slow fabric is cast, the ICI phases stay exact.  For
+optimizer-level compression of the whole wire,
+:class:`ErrorFeedbackCompressor` carries the quantization residual
+forward so the bias does not accumulate across steps.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["Compressor", "NoneCompressor", "BFloat16Compressor", "FP16Compressor", "Compression"]
+__all__ = [
+    "Compressor",
+    "NoneCompressor",
+    "BFloat16Compressor",
+    "FP16Compressor",
+    "ErrorFeedbackCompressor",
+    "Compression",
+]
 
 
 class Compressor:
-    """Interface (reference compression.py:20-31)."""
+    """Interface (reference compression.py:20-31).
+
+    Contract: ``compress`` preserves the tensor's SHAPE and may narrow
+    its dtype (the wire dtype); ``decompress`` restores the original
+    dtype exactly and never changes the shape.  Round-tripping is lossy
+    for values a narrower wire cannot represent — bounded by the wire
+    format's relative precision (bf16: 2^-8, fp16: 2^-11 for in-range
+    values), never by more.
+    """
 
     @staticmethod
     def compress(tensor):
-        """Returns (compressed_tensor, context-for-decompress)."""
+        """tensor -> ``(wire_tensor, ctx)``: same shape, possibly
+        narrower dtype; ``ctx`` is whatever ``decompress`` needs to
+        restore the original dtype (``None`` = nothing to undo)."""
         raise NotImplementedError
 
     @staticmethod
     def decompress(tensor, ctx):
+        """``(wire_tensor, ctx)`` -> tensor in the original dtype; the
+        shape is returned untouched."""
         raise NotImplementedError
 
 
@@ -67,8 +93,74 @@ class FP16Compressor(_CastCompressor):
     wire_dtype = jnp.float16
 
 
+class ErrorFeedbackCompressor(Compressor):
+    """Residual-carrying (error-feedback) compressor for the DCN leg.
+
+    A plain cast compressor throws its quantization error away every
+    step; over many steps the *bias* of that error accumulates in the
+    model (the EF-SGD observation — Seide et al. 2014, Karimireddy et
+    al. 2019).  This wrapper keeps the residual ``x - dec(enc(x))`` per
+    tensor key and adds it back before the next compression, so every
+    quantized bit eventually reaches the wire: the error is carried, not
+    compounded.
+
+    Stateful (a residual per ``key``), so it lives OUTSIDE jit: the
+    residual dict is ordinary Python state, and calling ``compress``
+    under a trace would leak tracers into it — a guard below raises
+    instead.  That also means it is NOT a drop-in for the
+    ``DistributedGradientTransform(compression=...)`` hook (which runs
+    inside the jitted step AND compresses many leaves with no key —
+    same-shape leaves would cross-contaminate residuals through the
+    shared default).  Use it at the eager layer, bracketing the reduce
+    yourself, with an explicit ``key`` per tensor stream.
+
+    Contract refinements over :class:`Compressor`: ``compress`` takes a
+    stable ``key`` identifying the tensor stream (the default is only
+    safe for a SINGLE stream); shapes must be stable per key — a shape
+    change resets that key's residual.
+    """
+
+    def __init__(self, inner=BFloat16Compressor):
+        self._inner = inner
+        self._residuals: dict = {}
+
+    def compress(self, tensor, *, key: str = "default"):
+        import jax.core as _core  # noqa: PLC0415
+
+        if isinstance(tensor, _core.Tracer):
+            raise TypeError(
+                "ErrorFeedbackCompressor is stateful (residual carried "
+                "across calls) and cannot run inside jit/shard_map "
+                "tracing; compress eagerly, or use a pure cast "
+                "compressor (Compression.bf16/fp16) on the wire"
+            )
+        t = jnp.asarray(tensor)
+        prev = self._residuals.get(key)
+        if prev is not None and prev.shape == t.shape:
+            t = t + prev.astype(t.dtype)
+        wire, ctx = self._inner.compress(t)
+        # Residual in the ORIGINAL dtype: what the wire failed to carry.
+        restored = self._inner.decompress(wire, ctx)
+        self._residuals[key] = (t - jnp.asarray(restored, t.dtype))
+        return wire, ctx
+
+    def decompress(self, tensor, ctx):
+        return self._inner.decompress(tensor, ctx)
+
+    def reset(self) -> None:
+        """Drop all residual state (elastic rendezvous / new stream)."""
+        self._residuals.clear()
+
+
 class Compression:
-    """Namespace matching ``hvd.Compression`` (reference compression.py:66-74)."""
+    """Namespace matching ``hvd.Compression`` (reference compression.py:66-74).
+
+    Every member is a stateless class usable directly as a
+    ``compression=`` argument.  :class:`ErrorFeedbackCompressor` is
+    deliberately NOT here: it is stateful (a residual per stream) and
+    must be instantiated — passing a namespace member where an instance
+    is required would fail deep inside a trace instead of at the call
+    site."""
 
     none = NoneCompressor
     fp16 = FP16Compressor

@@ -102,6 +102,27 @@ def parse_hostfile(path: str) -> List[HostSlots]:
     return out
 
 
+def slice_assignment(np: int, num_slices: int) -> List[int]:
+    """rank -> slice id for a forced multislice partition: ``num_slices``
+    contiguous equal blocks of ranks (the same contiguous-block rule
+    ``basics.slice_of_rank`` applies inside the workers, so the launcher
+    and the data plane always agree which slice a rank is in).
+
+    Raises when the partition cannot be even — the launcher should
+    refuse a bad ``--num-slices`` before spawning anything, not let every
+    worker discover it independently."""
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if np % num_slices:
+        raise ValueError(
+            f"--num-slices {num_slices} does not divide np={np}: slices "
+            f"must be equal (the hierarchical schedule's cross-fabric "
+            f"shard math is only rank-symmetric over equal slices)"
+        )
+    per = np // num_slices
+    return [r // per for r in range(np)]
+
+
 def allocate(hosts: List[HostSlots], np: int) -> List[SlotInfo]:
     """Fill slots host-by-host up to ``np`` processes (reference
     gloo_run.py:54-112: ranks assigned in host order; local_rank within

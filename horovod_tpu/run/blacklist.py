@@ -17,9 +17,13 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from ..utils.logging import get_logger
 
 __all__ = ["HostBlacklist"]
+
+LOG = get_logger("blacklist")
 
 DEFAULT_COOLDOWN_BASE_SECS = 10.0
 DEFAULT_COOLDOWN_CAP_SECS = 300.0
@@ -45,10 +49,35 @@ class HostBlacklist:
         self.cooldown_cap = cooldown_cap
         self._clock = clock
         self._hosts: Dict[str, _Entry] = {}
+        # Slice-level memory (multislice jobs): which hosts of each
+        # slice have failed, and which slices have been blacklisted
+        # wholesale.  A slice whose DCN link or shared power domain is
+        # bad kills its hosts one by one; waiting to blacklist them
+        # individually burns one respawn per host on a doomed slice.
+        self._slice_failed: Dict[int, Set[str]] = {}
+        # slice -> readmit_at of its wholesale hold; once the hold
+        # expires the slice gets a CLEAN failure window (stale failures
+        # must neither instantly re-blacklist a recovered slice nor —
+        # the opposite bug — block a persistently bad one from ever
+        # being held out again).
+        self._slices_out: Dict[int, float] = {}
 
-    def record_failure(self, host: str) -> int:
+    def record_failure(
+        self,
+        host: str,
+        *,
+        slice_id: Optional[int] = None,
+        slice_hosts: Optional[Sequence[str]] = None,
+    ) -> int:
         """Register a worker failure on ``host``; returns the host's
-        total failure count."""
+        total failure count.
+
+        With ``slice_id``/``slice_hosts`` (the launcher's view of which
+        slice the failed rank belonged to and every host in it), a
+        QUORUM of distinct failed hosts — strictly more than half the
+        slice — blacklists the WHOLE slice: every member host gets the
+        failed hosts' longest cooldown, so the next respawn lands on a
+        healthy slice instead of the next victim of the same fabric."""
         entry = self._hosts.setdefault(host, _Entry())
         entry.failures += 1
         cooldown = min(
@@ -56,7 +85,51 @@ class HostBlacklist:
             self.cooldown_cap,
         )
         entry.readmit_at = self._clock() + cooldown
+        if slice_id is not None and slice_hosts:
+            members = set(slice_hosts)
+            now = self._clock()
+            if (
+                slice_id in self._slices_out
+                and now >= self._slices_out[slice_id]
+            ):
+                # The previous wholesale hold expired: fresh window —
+                # only failures AFTER readmission count toward the next
+                # quorum, and a still-bad slice can be held out again.
+                del self._slices_out[slice_id]
+                self._slice_failed[slice_id] = set()
+            failed = self._slice_failed.setdefault(slice_id, set())
+            failed.add(host)
+            if (
+                slice_id not in self._slices_out
+                and 2 * len(failed & members) > len(members)
+            ):
+                worst = max(
+                    self._hosts[h].readmit_at
+                    for h in failed & members
+                    if h in self._hosts
+                )
+                self._slices_out[slice_id] = worst
+                for h in members:
+                    e = self._hosts.setdefault(h, _Entry())
+                    e.readmit_at = max(e.readmit_at, worst)
+                LOG.warning(
+                    "slice %d blacklisted: %d/%d of its hosts failed "
+                    "(%s); all member hosts held out until the longest "
+                    "cooldown elapses",
+                    slice_id, len(failed & members), len(members),
+                    ",".join(sorted(failed & members)),
+                )
         return entry.failures
+
+    def blacklisted_slices(self) -> List[int]:
+        """Slices currently held out wholesale by the failure quorum (a
+        slice re-admits implicitly when its hold expires — and becomes
+        eligible for a fresh quorum if its hosts keep failing)."""
+        now = self._clock()
+        return sorted(
+            s for s, readmit_at in self._slices_out.items()
+            if now < readmit_at
+        )
 
     def failures(self, host: str) -> int:
         entry = self._hosts.get(host)

@@ -24,6 +24,8 @@ _ARG_ENV_MAP = {
         envmod.HIERARCHICAL_ALLREDUCE,
         "params.hierarchical-allreduce",
     ),
+    "num_slices": (envmod.NUM_SLICES, "params.num-slices"),
+    "dcn_compression": (envmod.DCN_COMPRESSION, "params.dcn-compression"),
     # --no-schedule-replay writes "0" into the positive env knob (see
     # the inversion in set_env_from_args): one env var, default-on.
     "no_schedule_replay": (envmod.SCHEDULE_REPLAY, "params.no-schedule-replay"),

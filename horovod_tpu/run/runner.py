@@ -170,6 +170,30 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     params.add_argument(
         "--hierarchical-allreduce", action=_StoreTrueOverrideAction,
         dest="hierarchical_allreduce", default=None,
+        help="Pin the two-fabric (slice-aware) allreduce schedule on: "
+             "reduce-scatter on ICI, cross-slice exchange on "
+             "1/slice_size of the bytes over DCN, gather back on ICI.  "
+             "Needs a multi-slice topology (--num-slices or discovered); "
+             "single-slice worlds log a downgrade warning and stay flat. "
+             "Without this flag the autotuner still explores the "
+             "hierarchical schedule on multi-slice topologies.",
+    )
+    params.add_argument(
+        "--num-slices", type=int, action=_StoreOverrideAction,
+        dest="num_slices", default=None,
+        help="Slice partition of the world: that many contiguous equal "
+             "blocks of ranks (ICI within a block, DCN between).  Real "
+             "multislice TPU jobs are discovered automatically; this "
+             "forces a partition (CPU/dev simulation, or overriding "
+             "discovery).  Must divide -np.",
+    )
+    params.add_argument(
+        "--dcn-compression", action=_StoreOverrideAction,
+        dest="dcn_compression", default=None,
+        choices=["none", "bf16", "fp16"],
+        help="Wire dtype for the cross-slice (DCN) leg of hierarchical "
+             "allreduce; only the 1/slice_size shard that crosses the "
+             "slow fabric is cast, ICI phases stay exact (default none).",
     )
     params.add_argument(
         "--no-schedule-replay", action=_StoreTrueOverrideAction,
@@ -382,6 +406,8 @@ def check_build() -> str:
         "    [X] jit/SPMD collectives (psum/all_gather/ppermute over mesh)",
         "    [X] eager per-op engine (negotiation, fusion, join, timeline)",
         "    [X] hierarchical allreduce (cross x local mesh)",
+        "    [X] multi-slice two-fabric collectives (ICI scatter + DCN "
+        "exchange, --num-slices / --dcn-compression)",
         "    [X] adasum",
     ]
     return "\n".join(lines)
@@ -1019,6 +1045,37 @@ def launch_elastic_job(
     result = ElasticJobResult()
     trace = result.trace
     blacklist = HostBlacklist(cooldown_base=blacklist_cooldown)
+    # Slice-aware blacklisting (multislice jobs): a failure is recorded
+    # against its rank's slice too, and a quorum of dead hosts within
+    # one slice blacklists the whole slice — same contiguous-block
+    # rank->slice rule as basics.slice_of_rank.
+    try:
+        num_slices = int(base_env.get(envmod.NUM_SLICES) or 0)
+    except ValueError:
+        num_slices = 0
+    if num_slices <= 0:
+        try:
+            ssize = int(base_env.get(envmod.SLICE_SIZE) or 0)
+        except ValueError:
+            ssize = 0
+        num_slices = np // ssize if ssize > 0 and np % ssize == 0 else 0
+    slice_of: Dict[int, int] = {}
+    if num_slices > 1 and np % num_slices == 0:
+        from .allocate import slice_assignment  # noqa: PLC0415
+
+        slice_of = dict(enumerate(slice_assignment(np, num_slices)))
+
+    def record_rank_failure(rank: int, host: str) -> int:
+        sid = slice_of.get(rank)
+        if sid is None:
+            return blacklist.record_failure(host)
+        members = sorted(
+            {host_of[r] for r, s in slice_of.items()
+             if s == sid and r in host_of}
+        )
+        return blacklist.record_failure(
+            host, slice_id=sid, slice_hosts=members
+        )
     progress_policy = ProgressPolicy(progress_timeout, progress_grace)
     procs = ProcessSet()
     procs.install_signal_handlers()
@@ -1093,7 +1150,7 @@ def launch_elastic_job(
                         f"elastic rank {rank} raised:\n{tb}"
                     )
                 host = host_of[rank]
-                count = blacklist.record_failure(host)
+                count = record_rank_failure(rank, host)
                 metrics.counter("launcher.rank_failures").inc()
                 metrics.counter("launcher.blacklists").inc()
                 trace.append(("failure", rank, rc, epoch))
@@ -1305,6 +1362,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.log_level = "debug"
     if args.log_level:
         os.environ["HVDTPU_LOG_LEVEL"] = args.log_level
+    if getattr(args, "num_slices", None):
+        # Refuse a bad partition HERE, before spawning anything — every
+        # worker would otherwise discover it independently and downgrade.
+        from .allocate import slice_assignment  # noqa: PLC0415
+
+        try:
+            slice_assignment(args.np, args.num_slices)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
 
     env: Dict[str, str] = {}
     config_parser.set_env_from_args(env, args)
@@ -1410,6 +1477,10 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if straggler is not None:
         print("\n== straggler attribution ==")
         print(straggler)
+    fabric = obs_summary.fabric_section(dumps)
+    if fabric is not None:
+        print("\n== cross-fabric bytes (dcn vs ici) ==")
+        print(fabric)
     ckpt = obs_summary.ckpt_section(dumps)
     if ckpt is not None:
         print("\n== checkpoint / recovery ==")

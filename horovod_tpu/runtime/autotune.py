@@ -50,14 +50,42 @@ from ..utils import env as envmod
 FUSION_BOUNDS_MB = (1.0, 128.0)
 CYCLE_BOUNDS_MS = (1.0, 50.0)
 
-# Categorical exploration chain (reference explores hierarchical/cache
-# combinations; on TPU "hierarchical" selects the 2-level cross×local
-# reduction in the data plane).
-CATEGORIES: List[Dict[str, bool]] = [
-    {"cache_enabled": True, "hierarchical_allreduce": False},
-    {"cache_enabled": True, "hierarchical_allreduce": True},
-    {"cache_enabled": False, "hierarchical_allreduce": False},
-]
+def build_categories(
+    *,
+    multislice: bool = False,
+    replay_enabled: bool = False,
+    hierarchical_capable: bool = True,
+) -> List[Dict[str, bool]]:
+    """The ONE categorical exploration chain both engines tune over
+    (reference explores hierarchical/cache combinations as
+    CategoricalParameter values, parameter_manager.h:59-78).
+
+    Topology-derived: each entry costs a full Bayesian sweep, so a knob
+    with no consumer on this topology must not appear —
+
+    * ``hierarchical_allreduce: True`` is explored ONLY on multi-slice
+      topologies whose data plane can run the two-fabric schedule
+      (``multislice and hierarchical_capable``).  On a single slice the
+      flat XLA psum is already torus-optimal and the hierarchical path
+      would be pure overhead; before this builder each engine hand-rolled
+      its own list and a dead always-on entry drifted into the default.
+    * ``cache_enabled: False`` is excluded while schedule replay is on:
+      disabling the cache forfeits the negotiation-free steady state by
+      construction, so a noisy sample window must not be able to freeze
+      out the fast path.
+    """
+    cats: List[Dict[str, bool]] = [
+        {"cache_enabled": True, "hierarchical_allreduce": False},
+    ]
+    if multislice and hierarchical_capable:
+        cats.append(
+            {"cache_enabled": True, "hierarchical_allreduce": True}
+        )
+    if not replay_enabled:
+        cats.append(
+            {"cache_enabled": False, "hierarchical_allreduce": False}
+        )
+    return cats
 
 DEFAULT_WARMUP_SAMPLES = 3  # discarded while pipelines fill (reference WARMUPS)
 DEFAULT_STEPS_PER_SAMPLE = 10  # negotiation cycles per score sample
@@ -306,8 +334,12 @@ class ParameterManager:
         # `categories` must list only configurations the owning engine
         # actually consumes — every category costs a full Bayesian sweep,
         # so exploring knobs with no consumer wastes 1/len(categories) of
-        # the tuning budget per phantom entry.
-        self.categories = CATEGORIES if categories is None else categories
+        # the tuning budget per phantom entry.  Engines pass the
+        # topology-derived build_categories() result; the no-argument
+        # default is the conservative single-slice chain.
+        self.categories = (
+            build_categories() if categories is None else categories
+        )
         self.enabled = enabled
         self.current = initial
         self.warmup_samples = warmup_samples

@@ -44,12 +44,28 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..basics import global_topology
+# the jax-version shard_map shim lives with the other collective
+# compat helpers; aliased here because every plane fn builds on it
+from ..ops.collectives import shard_map_compat as _shard_map
 from ..utils.logging import get_logger
 
 LOG = get_logger("device_plane")
 
 PROC_AXIS = "hvdtpu_proc"
 LOCAL_AXIS = "hvdtpu_local"
+# Two-fabric axes of the slice mesh (multislice jobs): ICI_AXIS spans
+# the processes WITHIN one slice (fast fabric), DCN_AXIS spans the
+# slices (slow fabric).  The hierarchical allreduce reduce-scatters over
+# ICI, allreduces only the 1/slice_procs shard over DCN, and gathers
+# back over ICI — NCCLHierarchicalAllreduce's schedule
+# (nccl_operations.cc:162-300) with the fabrics renamed.
+DCN_AXIS = "hvdtpu_dcn"
+ICI_AXIS = "hvdtpu_ici"
+
+# DCN wire compressors (--dcn-compression): the cross-slice shard is
+# cast to this dtype before the DCN psum and widened after.  Only float
+# wires compress; integer payloads always cross exact.
+DCN_WIRES = {"none": None, "bf16": "bfloat16", "fp16": "float16"}
 
 
 class DevicePlane:
@@ -100,6 +116,23 @@ class DevicePlane:
             )
         devs = [by_proc[p][0] for p in range(self.world)]
         self.mesh = Mesh(np.asarray(devs, dtype=object), (PROC_AXIS,))
+        # Slice mesh (multislice topologies only): the anchor-device row
+        # reshaped (num_slices, procs_per_slice).  Built whenever the
+        # topology's slice partition divides the world evenly; whether a
+        # given cycle USES it is the engine's negotiated decision.
+        self.num_slices = max(int(topo.num_slices), 1)
+        self.slice_procs = 1
+        self.mesh_slices = None
+        if (
+            self.num_slices > 1
+            and self.world > 1
+            and self.world % self.num_slices == 0
+        ):
+            self.slice_procs = self.world // self.num_slices
+            grid = np.asarray(devs, dtype=object).reshape(
+                self.num_slices, self.slice_procs
+            )
+            self.mesh_slices = Mesh(grid, (DCN_AXIS, ICI_AXIS))
         counts = {len(v) for v in by_proc.values()}
         self.n_local = counts.pop() if len(counts) == 1 else 1
         if self.n_local > 1:
@@ -163,12 +196,92 @@ class DevicePlane:
             return total.astype(wire)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh, in_specs=P(PROC_AXIS), out_specs=P(),
-                check_vma=False,
             ),
             donate_argnums=(0,),
         )
+
+    # ---------------------------------------- hierarchical (two-fabric) path
+
+    @property
+    def hierarchical_ok(self) -> bool:
+        """Whether this plane can run the slice-aware schedule: a
+        multi-slice topology whose slice partition divides the world."""
+        return self.mesh_slices is not None
+
+    def _stage_slices(self, flat: jax.Array) -> jax.Array:
+        """Stage a 1-D buffer (padded to a multiple of slice_procs) onto
+        the slice mesh: global shape (num_slices, slice_procs, n), this
+        process's row at (slice_id, intra-slice index)."""
+        if next(iter(flat.devices())) != self.device:
+            flat = jax.device_put(flat, self.device)
+        row = flat[None, None]
+        shape = (self.num_slices, self.slice_procs) + tuple(flat.shape)
+        sharding = NamedSharding(self.mesh_slices, P(DCN_AXIS, ICI_AXIS))
+        return jax.make_array_from_single_device_arrays(shape, sharding, [row])
+
+    @functools.lru_cache(maxsize=256)
+    def _allreduce_hier_fn(self, reduce_op: int, pre: float, post: float,
+                           wire: str, acc: str, exact_int_avg: bool,
+                           dcn_wire: Optional[str]):
+        """The 3-phase two-fabric schedule (parallel/hierarchical.py's
+        jit op applied to the engine's staged fused buffer):
+        psum_scatter(ICI) -> psum(DCN) on 1/slice_procs of the bytes,
+        optionally on a compressed wire -> all_gather(ICI).  SUM/AVERAGE
+        only — scatter-based reduction does not compose with MIN/MAX."""
+        from ..ops.collectives import ReduceOp  # noqa: PLC0415
+
+        def f(x):  # x: (1, 1, n) — this rank's padded fused buffer
+            v = x[0, 0].astype(acc)
+            if pre != 1.0:
+                v = (v * pre).astype(wire).astype(acc)
+            # Phase 1 (ICI): reduce-scatter so each intra-slice rank owns
+            # the slice-partial sum of its 1/slice_procs segment.
+            shard = lax.psum_scatter(
+                v, ICI_AXIS, scatter_dimension=0, tiled=True
+            )
+            # Phase 2 (DCN): allreduce only the shard across slices; the
+            # compressed wire casts the slice-partial sums down before
+            # the slow fabric and widens right after.
+            if dcn_wire is not None:
+                shard = lax.psum(shard.astype(dcn_wire), DCN_AXIS).astype(acc)
+            else:
+                shard = lax.psum(shard, DCN_AXIS)
+            if reduce_op == int(ReduceOp.AVERAGE):
+                if exact_int_avg:
+                    shard = shard // self.world
+                else:
+                    shard = shard / self.world
+            if post != 1.0:
+                shard = shard * post
+            # Phase 3 (ICI): gather the fully-reduced shards back.
+            return lax.all_gather(shard.astype(wire), ICI_AXIS, tiled=True)
+
+        return jax.jit(
+            _shard_map(
+                f, mesh=self.mesh_slices,
+                in_specs=P(DCN_AXIS, ICI_AXIS), out_specs=P(),
+            ),
+            donate_argnums=(0,),
+        )
+
+    def allreduce_hier(self, flat: jax.Array, reduce_op: int, pre: float,
+                       post: float, acc_dtype: str, exact_int_avg: bool,
+                       dcn_wire: Optional[str] = None) -> jax.Array:
+        """Hierarchical reduce of a 1-D fused buffer; caller guarantees
+        ``hierarchical_ok`` and a SUM/AVERAGE reduce_op (both negotiated,
+        so every rank takes this path on the same op)."""
+        n = int(flat.shape[0])
+        pad = (-n) % self.slice_procs
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        fn = self._allreduce_hier_fn(
+            reduce_op, pre, post, str(flat.dtype), acc_dtype,
+            exact_int_avg, dcn_wire,
+        )
+        out = self._local(fn(self._stage_slices(flat)))
+        return out[:n]
 
     # ------------------------------------------- sharded (multi-chip) path
 
@@ -222,10 +335,9 @@ class DevicePlane:
             return full[None]  # (1, k, m)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh2d,
                 in_specs=P(PROC_AXIS, LOCAL_AXIS), out_specs=P(PROC_AXIS),
-                check_vma=False,
             ),
             donate_argnums=(0,),
         )
@@ -277,9 +389,8 @@ class DevicePlane:
             return lax.all_gather(x[0], PROC_AXIS)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh, in_specs=P(PROC_AXIS), out_specs=P(),
-                check_vma=False,
             ),
             donate_argnums=(0,),
         )
@@ -297,10 +408,9 @@ class DevicePlane:
             return full.reshape(full.shape[0], -1)           # (world, k*m)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh2d,
                 in_specs=P(PROC_AXIS, LOCAL_AXIS), out_specs=P(),
-                check_vma=False,
             ),
             donate_argnums=(0,),
         )
@@ -328,9 +438,8 @@ class DevicePlane:
             return lax.psum(contrib, PROC_AXIS).astype(wire)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh, in_specs=P(PROC_AXIS), out_specs=P(),
-                check_vma=False,
             ),
             donate_argnums=(0,),
         )
@@ -347,10 +456,9 @@ class DevicePlane:
             return lax.all_gather(chunk, LOCAL_AXIS).reshape(-1)  # (k*m,)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh2d,
                 in_specs=P(PROC_AXIS, LOCAL_AXIS), out_specs=P(),
-                check_vma=False,
             ),
             donate_argnums=(0,),
         )
@@ -392,9 +500,9 @@ class DevicePlane:
             return chunk.astype(wire)[None]
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh, in_specs=P(PROC_AXIS),
-                out_specs=P(PROC_AXIS), check_vma=False,
+                out_specs=P(PROC_AXIS),
             ),
             donate_argnums=(0,),
         )
@@ -419,10 +527,10 @@ class DevicePlane:
             return full.reshape(-1)[None]  # (1, k*mb)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh2d,
                 in_specs=P(PROC_AXIS, LOCAL_AXIS),
-                out_specs=P(PROC_AXIS), check_vma=False,
+                out_specs=P(PROC_AXIS),
             ),
             donate_argnums=(0,),
         )
@@ -458,9 +566,9 @@ class DevicePlane:
             return out.reshape(v.shape)[None]
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh, in_specs=P(PROC_AXIS),
-                out_specs=P(PROC_AXIS), check_vma=False,
+                out_specs=P(PROC_AXIS),
             ),
             donate_argnums=(0,),
         )
@@ -478,10 +586,10 @@ class DevicePlane:
             return full.reshape(full.shape[0], -1)[None]  # (1, world, k*mb)
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 f, mesh=self.mesh2d,
                 in_specs=P(PROC_AXIS, LOCAL_AXIS),
-                out_specs=P(PROC_AXIS), check_vma=False,
+                out_specs=P(PROC_AXIS),
             ),
             donate_argnums=(0,),
         )

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import atexit
 import concurrent.futures
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -49,7 +50,7 @@ from ..utils import env as envmod
 from ..utils.logging import get_logger
 from . import response_cache as rcache
 from . import timeline as timeline_mod
-from .autotune import ParameterManager, TunedParams
+from .autotune import ParameterManager, TunedParams, build_categories
 from .controller import ControllerState, _fuse, compute_responses
 from .messages import Request, RequestList, RequestType, Response, ResponseType
 
@@ -266,6 +267,16 @@ class EagerEngine:
         self._m_cached_stalls = metrics.counter(
             "engine.cached_stall_warnings"
         )
+        # Per-fabric byte counters (multislice observability): bytes the
+        # XLA data plane moved over the fast intra-slice fabric (ICI) vs
+        # the slow cross-slice fabric (DCN).  On the hierarchical path
+        # dcn_bytes ≈ ici_bytes / slice_procs — the bandwidth argument
+        # the schedule exists for; on the flat path of a multislice job
+        # every payload byte is charged to DCN, which is exactly the
+        # full-tensor cost the tuner should see and move away from.
+        self._m_dcn_bytes = metrics.counter("engine.dcn_bytes")
+        self._m_ici_bytes = metrics.counter("engine.ici_bytes")
+        self._m_dcn_ratio = metrics.gauge("engine.dcn_compression_ratio")
         # WeakMethod so the registry never pins a dead engine alive, and
         # the closure signals CollectorRetired once the engine is gone
         # so the registry prunes it (single deref — no GC race between
@@ -302,6 +313,54 @@ class EagerEngine:
 
             self._device_plane = device_plane.build_plane()
         self._plane_ok_all = self._device_plane is not None
+
+        # Two-fabric (multislice) data path: when the topology has >1
+        # slice and the plane built its slice mesh, SUM/AVERAGE fused
+        # allreduces can run the hierarchical schedule — selected
+        # per-cycle from the tuner's hierarchical_allreduce param (or
+        # pinned by --hierarchical-allreduce).  The flag only ever flips
+        # through negotiated tuned params or the launcher-uniform env,
+        # so every rank picks the same schedule for the same op.
+        from .device_plane import DCN_WIRES  # noqa: PLC0415
+
+        self._hier_capable = bool(
+            self._device_plane is not None
+            and self._device_plane.hierarchical_ok
+        )
+        self.hierarchical = False
+        hier_req = envmod.env_bool(envmod.HIERARCHICAL_ALLREDUCE)
+        # --hierarchical-allreduce PINS the schedule: tuned params keep
+        # moving fusion/cycle/cache but must not un-pin it (identical on
+        # every rank — the env is launcher-uniform, capability is
+        # topology-derived).
+        self._hier_pinned = bool(hier_req and self._hier_capable)
+        if hier_req:
+            if self._hier_capable:
+                self.hierarchical = True
+            else:
+                # The single-slice half of this downgrade warns at
+                # init() (basics.py) so jit-only jobs see it too; this
+                # covers a multi-slice topology whose plane can't run
+                # the schedule (plane disabled/failed, uneven slices).
+                LOG.warning(
+                    "hierarchical allreduce requested but the device "
+                    "plane cannot run the two-fabric schedule on this "
+                    "topology (%d slices over %d ranks, plane=%s); "
+                    "downgrading to flat allreduce",
+                    getattr(topo, "num_slices", 1), self.world,
+                    "ok" if self._device_plane is not None else "absent",
+                )
+        dcn_choice = (
+            os.environ.get(envmod.DCN_COMPRESSION) or "none"
+        ).strip().lower()
+        if dcn_choice not in DCN_WIRES:
+            LOG.warning(
+                "unknown %s=%r (choices: %s); DCN wire stays uncompressed",
+                envmod.DCN_COMPRESSION, dcn_choice,
+                "/".join(sorted(DCN_WIRES)),
+            )
+            dcn_choice = "none"
+        self._dcn_wire = DCN_WIRES[dcn_choice]
 
         # Stable-schedule replay fast path (ROADMAP item 1b; GSPMD's
         # static-schedule guarantee recreated dynamically): after
@@ -347,28 +406,35 @@ class EagerEngine:
         self._pm: Optional[ParameterManager] = None
         self._pending_params: Optional[tuple] = None
         if self.rank == 0 and envmod.env_bool(envmod.AUTOTUNE):
-            import os  # noqa: PLC0415
-
-            # Continuous knobs (fusion, cycle) plus the response-cache
-            # toggle — a real code path in this engine (the bit-vote
-            # fast path).  Hierarchical stays out: it is not a python-
-            # data-plane knob.  With schedule replay enabled the
-            # cache-off category is excluded too: disabling the cache
-            # forfeits the negotiation-free steady state by
-            # construction, so a sample window that happens to score
-            # cache-off ahead (loopback noise on small tensors) must
-            # not be able to freeze out the fast path.
-            categories = [
-                {"cache_enabled": True, "hierarchical_allreduce": False},
-            ]
-            if not self.replay_enabled:
-                categories.append(
-                    {"cache_enabled": False, "hierarchical_allreduce": False}
-                )
+            # Topology-derived category chain (autotune.build_categories,
+            # shared with the native engine): continuous knobs (fusion,
+            # cycle) plus the response-cache toggle, plus — ONLY on
+            # multi-slice topologies whose plane can run the two-fabric
+            # schedule — hierarchical_allreduce, so the online controller
+            # picks flat vs hierarchical from measured bytes/sec.
+            categories = build_categories(
+                multislice=self._hier_capable,
+                replay_enabled=self.replay_enabled,
+            )
+            if self._hier_pinned:
+                # The pin removes the hierarchical axis from the search:
+                # every category keeps the schedule on (deduped), so a
+                # noisy sample window can never score the job back to
+                # flat against the user's explicit flag.
+                seen: set = set()
+                pinned = []
+                for c in categories:
+                    c = {**c, "hierarchical_allreduce": True}
+                    k = tuple(sorted(c.items()))
+                    if k not in seen:
+                        seen.add(k)
+                        pinned.append(c)
+                categories = pinned
             self._pm = ParameterManager(
                 enabled=True,
                 initial=TunedParams(
-                    fusion_bytes=self.fusion_bytes, cycle_s=self.cycle_s
+                    fusion_bytes=self.fusion_bytes, cycle_s=self.cycle_s,
+                    hierarchical_allreduce=self.hierarchical,
                 ),
                 log_path=os.environ.get(envmod.AUTOTUNE_LOG) or None,
                 categories=categories,
@@ -995,10 +1061,16 @@ class EagerEngine:
 
     def _apply_params(self, p: TunedParams) -> None:
         """Apply rank-0-tuned params (reference SynchronizeParameters,
-        controller.cc:33-47)."""
+        controller.cc:33-47).  The hierarchical toggle applies on the
+        same cycle boundary on every rank (it rides the negotiation), so
+        schedule selection stays coherent; the capability gate is
+        topology-derived and identical everywhere."""
         self.fusion_bytes = p.fusion_bytes
         self.cycle_s = p.cycle_s
         self.cache_enabled = p.cache_enabled
+        self.hierarchical = (
+            bool(p.hierarchical_allreduce) or self._hier_pinned
+        ) and self._hier_capable
 
     # ---------------------------------------------------------- negotiation
 
@@ -1189,18 +1261,65 @@ class EagerEngine:
     def _plane_allreduce(self, buf, dtype_name, reduce_op, pre, post,
                          is_int):
         """One XLA-plane reduce of a fused buffer — shared by the device
-        path (jax buf in, jax total out) and the staged host path."""
+        path (jax buf in, jax total out) and the staged host path.
+
+        Routes to the hierarchical (two-fabric) schedule when the tuned
+        ``hierarchical`` flag is up, the plane has a slice mesh, and the
+        negotiated reduce op composes with scatter-based reduction
+        (SUM/AVERAGE) — every input to this decision is shared data, so
+        all ranks issue the same collective.  Per-fabric byte counters
+        are charged here: the hierarchical path's DCN leg carries
+        1/slice_procs of the bytes (optionally on the compressed wire);
+        a flat reduce on a multislice topology charges the full payload
+        to DCN, which is the cost the schedule exists to avoid."""
         from ..ops.collectives import ReduceOp as _R  # noqa: PLC0415
 
-        return self._plane().allreduce(
+        plane = self._plane()
+        acc_dtype = (
+            "float32" if dtype_name in ("bfloat16", "float16") else dtype_name
+        )
+        exact_int_avg = bool(is_int and reduce_op == int(_R.AVERAGE))
+        wire_item = _np_dtype(dtype_name).itemsize
+        if (
+            self.hierarchical
+            and plane.hierarchical_ok
+            and reduce_op in (int(_R.SUM), int(_R.AVERAGE))
+        ):
+            # Integer payloads always cross DCN exact: a float-cast wire
+            # would corrupt them.
+            dcn_wire = self._dcn_wire if not is_int else None
+            total = plane.allreduce_hier(
+                buf, reduce_op, pre, post, acc_dtype, exact_int_avg,
+                dcn_wire,
+            )
+            dcn_item = (
+                _np_dtype(dcn_wire).itemsize if dcn_wire else wire_item
+            )
+            # Both fabrics charged at the PADDED size the schedule
+            # actually moved: the dcn == ici / slice_procs identity must
+            # hold exactly even when the buffer (e.g. with the replay
+            # flag lane appended) is not divisible by slice_procs.
+            shard_elems = -(-int(buf.size) // plane.slice_procs)
+            self._m_ici_bytes.inc(
+                shard_elems * plane.slice_procs * wire_item
+            )
+            self._m_dcn_bytes.inc(shard_elems * dcn_item)
+            self._m_dcn_ratio.set(wire_item / dcn_item)
+            return total
+        if plane.num_slices > 1:
+            # Flat reduce on a multislice world: the full payload
+            # crosses the slow fabric — the cost the schedule avoids.
+            # Single-slice jobs deliberately touch NEITHER counter, so
+            # the fabric digest/summary sections stay absent there (the
+            # documented contract).
+            self._m_dcn_bytes.inc(int(buf.size) * wire_item)
+        return plane.allreduce(
             buf,
             reduce_op,
             pre,
             post,
-            acc_dtype="float32"
-            if dtype_name in ("bfloat16", "float16")
-            else dtype_name,
-            exact_int_avg=bool(is_int and reduce_op == int(_R.AVERAGE)),
+            acc_dtype=acc_dtype,
+            exact_int_avg=exact_int_avg,
         )
 
     def _execute_allreduce(self, resp: Response, entries) -> None:

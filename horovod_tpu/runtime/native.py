@@ -146,6 +146,18 @@ class NativeEngine:
             "engine.collectives_completed"
         )
 
+        # The hierarchical knob has no consumer in the native TCP data
+        # plane; say so instead of silently ignoring it (the python
+        # engine's XLA plane is the one that can run the two-fabric
+        # schedule — HVDTPU_EAGER_ENGINE=python).
+        if envmod.env_bool(envmod.HIERARCHICAL_ALLREDUCE):
+            LOG.warning(
+                "hierarchical allreduce requested but the native TCP "
+                "data plane has no two-fabric schedule; downgrading to "
+                "flat (use HVDTPU_EAGER_ENGINE=python for the slice-aware "
+                "XLA path)"
+            )
+
         port = self.lib.hvdtpu_listen()
         if port < 0:
             raise RuntimeError("native engine: listen failed")
@@ -194,7 +206,11 @@ class NativeEngine:
         # through hvdtpu_set_params and ride the negotiation to every rank.
         self._tuner: Optional[threading.Thread] = None
         if self.rank == 0 and envmod.env_bool(envmod.AUTOTUNE):
-            from .autotune import ParameterManager, TunedParams  # noqa: PLC0415
+            from .autotune import (  # noqa: PLC0415
+                ParameterManager,
+                TunedParams,
+                build_categories,
+            )
 
             self._pm = ParameterManager(
                 enabled=True,
@@ -202,13 +218,18 @@ class NativeEngine:
                     fusion_bytes=fusion, cycle_s=cycle_ms / 1000.0
                 ),
                 log_path=os.environ.get(envmod.AUTOTUNE_LOG) or None,
-                # The native engine consumes fusion/cycle (continuous) and
-                # the response-cache toggle (categorical); hierarchical is
-                # not a native-data-plane knob, so it is not explored.
-                categories=[
-                    {"cache_enabled": True, "hierarchical_allreduce": False},
-                    {"cache_enabled": False, "hierarchical_allreduce": False},
-                ],
+                # Shared topology-derived chain (autotune.build_categories):
+                # the native engine consumes fusion/cycle (continuous) and
+                # the response-cache toggle (categorical); its TCP data
+                # plane has no two-fabric schedule, so hierarchical is
+                # never explored regardless of topology
+                # (hierarchical_capable=False), and it has no schedule
+                # replay, so the cache-off category stays.
+                categories=build_categories(
+                    multislice=topo.num_slices > 1,
+                    replay_enabled=False,
+                    hierarchical_capable=False,
+                ),
             )
             self._tuner = threading.Thread(
                 target=self._tuner_loop, name="hvdtpu_autotune", daemon=True

@@ -20,6 +20,19 @@ STALL_SHUTDOWN_TIME = "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS"
 STALL_CHECK_DISABLE = "HVDTPU_STALL_CHECK_DISABLE"
 CACHE_CAPACITY = "HVDTPU_CACHE_CAPACITY"
 HIERARCHICAL_ALLREDUCE = "HVDTPU_HIERARCHICAL_ALLREDUCE"
+# Multi-slice topology (ICI within a slice, DCN between slices).  The
+# slice partition is discovered from the platform when it can be
+# (jax Device.slice_index on real multislice deployments) and forced
+# otherwise: NUM_SLICES partitions the world into that many contiguous
+# equal blocks of processes; SLICE_SIZE is the same knob expressed as
+# processes-per-slice (the forced partition that lets every multislice
+# code path run on a CPU dev world).  NUM_SLICES wins when both are set.
+NUM_SLICES = "HVDTPU_NUM_SLICES"
+SLICE_SIZE = "HVDTPU_SLICE_SIZE"
+# Wire dtype for the cross-slice (DCN) leg of hierarchical allreduce:
+# none (negotiated dtype), bf16, or fp16 (ops/compression.py).  Only the
+# 1/local_size shard that crosses DCN is cast; ICI phases stay exact.
+DCN_COMPRESSION = "HVDTPU_DCN_COMPRESSION"
 AUTOTUNE = "HVDTPU_AUTOTUNE"
 AUTOTUNE_LOG = "HVDTPU_AUTOTUNE_LOG"
 # Sampling-window knobs (reference common.h:67-69

@@ -13,10 +13,10 @@ import pytest
 
 from horovod_tpu.runtime.autotune import (
     BayesianOptimization,
-    CATEGORIES,
     GaussianProcess,
     ParameterManager,
     TunedParams,
+    build_categories,
 )
 from horovod_tpu.runtime.messages import Request, RequestList, RequestType
 
@@ -149,15 +149,18 @@ class TestParameterManager:
         assert len(lines) == 2
 
     def test_categorical_chain_explored(self):
+        # widest chain: a multislice-capable engine without replay
+        categories = build_categories(multislice=True, replay_enabled=False)
         pm = ParameterManager(
             enabled=True,
             initial=TunedParams(1048576, 0.005),
             warmup_samples=0,
             steps_per_sample=1,
             samples_per_category=3,
+            categories=categories,
         )
         seen = set()
-        for _ in range(3 * len(CATEGORIES) + 1):
+        for _ in range(3 * len(categories) + 1):
             pm.record_bytes(1000)
             p = pm.cycle()
             if p is not None:

@@ -1,0 +1,749 @@
+"""Multislice simulation suite (ISSUE 8): slice topology resolution,
+the two-fabric hierarchical schedule vs the flat path (bitwise where the
+math is exact, bounded where the DCN wire is compressed), the
+topology-derived autotune categories, slice-tagged straggler blame, and
+the slice blacklist — all on the virtual CPU mesh with forced
+partitions, plus real 4-process forced-2x2 acceptance through the
+launcher (reference strategy: NCCLHierarchicalAllreduce's fabric split,
+nccl_operations.cc:162-300, simulated the way the reference CI simulates
+multi-node with multi-process-on-localhost)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+import horovod_tpu.run as hvdrun
+from horovod_tpu.basics import resolve_slice_partition, slice_grid
+from horovod_tpu.ops.compression import (
+    BFloat16Compressor,
+    Compression,
+    ErrorFeedbackCompressor,
+    FP16Compressor,
+)
+from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+from horovod_tpu.run.allocate import slice_assignment
+from horovod_tpu.run.blacklist import HostBlacklist
+from horovod_tpu.runtime.autotune import build_categories
+from horovod_tpu.obs import straggler as obs_straggler
+
+N = 8  # 2 slices x 4 "ranks" on the virtual mesh
+
+
+@pytest.fixture
+def hvd_caplog(caplog):
+    """caplog that sees horovod_tpu records: the package logger sets
+    propagate=False (it owns its stderr handler), so caplog's root
+    handler needs propagation re-enabled for the test's duration."""
+    import logging
+
+    root = logging.getLogger("horovod_tpu")
+    root.propagate = True
+    try:
+        with caplog.at_level("WARNING", logger="horovod_tpu"):
+            yield caplog
+    finally:
+        root.propagate = False
+
+
+# ---------------------------------------------------------------------------
+# slice topology resolution
+# ---------------------------------------------------------------------------
+
+
+def test_forced_num_slices_partitions_processes():
+    assert resolve_slice_partition(8, 0, [], {"HVDTPU_NUM_SLICES": "2"}) \
+        == (2, 0)
+    assert resolve_slice_partition(8, 3, [], {"HVDTPU_NUM_SLICES": "2"}) \
+        == (2, 0)
+    assert resolve_slice_partition(8, 4, [], {"HVDTPU_NUM_SLICES": "2"}) \
+        == (2, 1)
+    assert resolve_slice_partition(8, 7, [], {"HVDTPU_NUM_SLICES": "4"}) \
+        == (4, 3)
+
+
+def test_forced_slice_size_is_procs_per_slice():
+    assert resolve_slice_partition(4, 2, [], {"HVDTPU_SLICE_SIZE": "2"}) \
+        == (2, 1)
+    # NUM_SLICES wins when both are set
+    assert resolve_slice_partition(
+        4, 3, [], {"HVDTPU_SLICE_SIZE": "2", "HVDTPU_NUM_SLICES": "4"}
+    ) == (4, 3)
+
+
+def test_uneven_forced_partition_downgrades_with_warning(hvd_caplog):
+    assert resolve_slice_partition(
+        4, 0, [], {"HVDTPU_NUM_SLICES": "3"}
+    ) == (1, 0)
+    assert "does not divide" in hvd_caplog.text
+
+
+def test_explicit_single_slice_is_silent(hvd_caplog):
+    assert resolve_slice_partition(
+        4, 0, [], {"HVDTPU_NUM_SLICES": "1"}
+    ) == (1, 0)
+    assert hvd_caplog.text == ""
+
+
+def test_single_process_world_partitions_devices():
+    # the in-process 8-device test world: SLICE_SIZE counts chips
+    devs = list(range(8))
+    assert resolve_slice_partition(
+        1, 0, devs, {"HVDTPU_SLICE_SIZE": "4"}
+    ) == (2, 0)
+    assert resolve_slice_partition(
+        1, 0, devs, {"HVDTPU_NUM_SLICES": "2"}
+    ) == (2, 0)
+
+
+class _FakeDev:
+    def __init__(self, slice_index, process_index):
+        self.slice_index = slice_index
+        self.process_index = process_index
+
+
+def test_platform_discovery_via_slice_index():
+    devs = [_FakeDev(s, p) for s in (0, 1) for p in (2 * s, 2 * s + 1)]
+    assert resolve_slice_partition(4, 0, devs, {}) == (2, 0)
+    assert resolve_slice_partition(4, 3, devs, {}) == (2, 1)
+
+
+def test_discovery_rejects_process_spanning_slices(hvd_caplog):
+    devs = [_FakeDev(0, 0), _FakeDev(1, 0), _FakeDev(1, 1), _FakeDev(0, 1)]
+    assert resolve_slice_partition(2, 0, devs, {}) == (1, 0)
+    assert "spans multiple slices" in hvd_caplog.text
+
+
+def test_slice_grid_three_level_view():
+    assert slice_grid(list(range(8)), 2, 1).shape == (2, 1, 4)
+    assert slice_grid(list(range(8)), 2, 2).shape == (2, 2, 2)
+    g = slice_grid(list(range(8)), 2, 2)
+    assert g[1, 0, 0] == 4  # contiguous blocks per slice
+    with pytest.raises(ValueError):
+        slice_grid(list(range(8)), 3, 1)
+    with pytest.raises(ValueError):
+        slice_grid(list(range(8)), 2, 3)
+
+
+def test_session_topology_is_single_slice():
+    # the in-process suite initializes without forced slices
+    assert hvd.num_slices() == 1
+    assert hvd.slice_id() == 0
+    assert hvd.slice_of_rank(0) == 0
+    with pytest.raises(ValueError):
+        hvd.mesh("slice")
+
+
+def test_slice_assignment_contract():
+    assert slice_assignment(4, 2) == [0, 0, 1, 1]
+    assert slice_assignment(6, 3) == [0, 0, 1, 1, 2, 2]
+    assert slice_assignment(4, 1) == [0, 0, 0, 0]
+    with pytest.raises(ValueError):
+        slice_assignment(4, 3)
+    with pytest.raises(ValueError):
+        slice_assignment(4, 0)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical vs flat: bitwise equivalence + compressed-wire bounds
+# ---------------------------------------------------------------------------
+
+
+def _mesh2d():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices()[:N], dtype=object).reshape(2, 4)
+    return Mesh(devices, (hvd.CROSS_AXIS, hvd.LOCAL_AXIS))
+
+
+def _run(fn, x):
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.runtime.device_plane import _shard_map
+
+    return _shard_map(
+        fn,
+        mesh=_mesh2d(),
+        in_specs=(P((hvd.CROSS_AXIS, hvd.LOCAL_AXIS)),),
+        out_specs=P((hvd.CROSS_AXIS, hvd.LOCAL_AXIS)),
+    )(x)
+
+
+@pytest.mark.parametrize("op", [hvd.Sum, hvd.Average])
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+@pytest.mark.parametrize("shape", [(5,), (8,), (3, 7), (1,)])
+def test_hierarchical_bitwise_equals_flat(op, dtype, shape):
+    """Integer-valued payloads sum exactly in any association order, so
+    the 3-phase schedule must be BITWISE-equal to the flat reduction —
+    across dtypes and pad/unpad shapes."""
+    if dtype == np.int32 and op == hvd.Average:
+        pytest.skip("int average: engine-exact floor semantics, not a "
+                    "shard_map op contract")
+    rng = np.random.RandomState(7)
+    x = rng.randint(-50, 50, size=(N,) + shape).astype(dtype)
+
+    def step(v):
+        return hierarchical_allreduce(v[0], op)[None]
+
+    out = np.asarray(_run(step, x))
+    expect = x.astype(np.float64).sum(axis=0)
+    if op == hvd.Average:
+        expect = expect / N
+    for r in range(N):
+        np.testing.assert_array_equal(
+            np.asarray(out[r], np.float64), expect
+        )
+
+
+@pytest.mark.parametrize("wire,rel", [("bf16", 2 ** -7), ("fp16", 2 ** -10)])
+def test_hierarchical_compressed_wire_tolerance(wire, rel):
+    """The DCN leg on a compressed wire: error bounded by one cast
+    round-trip on slice-partial sums (documented tolerance in
+    docs/performance.md)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(N, 33).astype(np.float32)
+
+    def exact(v):
+        return hierarchical_allreduce(v[0], hvd.Average)[None]
+
+    def compressed(v):
+        return hierarchical_allreduce(
+            v[0], hvd.Average, compression=wire
+        )[None]
+
+    ref = np.asarray(_run(exact, x))
+    got = np.asarray(_run(compressed, x))
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() <= rel * scale * 2
+    # and it is genuinely lossy-or-equal, never wildly off
+    assert not np.allclose(got, 0)
+
+
+def test_hierarchical_rejects_unknown_compression():
+    with pytest.raises(ValueError, match="unknown dcn compression"):
+        hierarchical_allreduce(np.ones(4, np.float32), compression="zstd")
+
+
+# ---------------------------------------------------------------------------
+# compressors: contracts + error feedback
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "comp,rel", [(BFloat16Compressor, 2 ** -8), (FP16Compressor, 2 ** -11)]
+)
+def test_cast_compressor_roundtrip_bounds(comp, rel):
+    rng = np.random.RandomState(11)
+    x = rng.randn(257).astype(np.float32)
+    wire, ctx = comp.compress(x)
+    back = np.asarray(comp.decompress(wire, ctx))
+    assert back.dtype == np.float32 and back.shape == x.shape
+    assert np.abs(back - x).max() <= rel * np.abs(x).max() * 2
+
+
+def test_error_feedback_carries_residual():
+    """A constant stream that the wire rounds: naive casting accumulates
+    K*eps of bias; error feedback keeps the ACCUMULATED error within a
+    couple of single-step quanta because every dropped bit is re-fed."""
+    ef = ErrorFeedbackCompressor(BFloat16Compressor)
+    x = np.float32(1.0 + 2.0 ** -9)  # not representable in bf16
+    steps = 64
+    ef_sum = 0.0
+    naive_sum = 0.0
+    for i in range(steps):
+        w, ctx = ef.compress(np.full(4, x, np.float32), key="g")
+        ef_sum += float(np.asarray(ef.decompress(w, ctx))[0])
+        nw, nctx = BFloat16Compressor.compress(np.full(4, x, np.float32))
+        naive_sum += float(np.asarray(
+            BFloat16Compressor.decompress(nw, nctx))[0])
+    true_sum = steps * float(x)
+    assert abs(ef_sum - true_sum) <= 3 * 2.0 ** -8
+    assert abs(naive_sum - true_sum) >= steps * 2.0 ** -9 * 0.9
+    assert abs(ef_sum - true_sum) < abs(naive_sum - true_sum) / 8
+
+
+def test_error_feedback_reset_and_shape_change():
+    ef = ErrorFeedbackCompressor(BFloat16Compressor)
+    ef.compress(np.ones(4, np.float32), key="g")
+    ef.compress(np.ones(8, np.float32), key="g")  # shape change: no crash
+    ef.reset()
+    assert ef._residuals == {}
+
+
+def test_error_feedback_not_in_cast_namespace():
+    # stateful: must be instantiated explicitly, never passed as a
+    # namespace member where a stateless cast class is expected
+    assert not hasattr(Compression, "ef_bf16")
+    assert ErrorFeedbackCompressor is not None
+
+
+# ---------------------------------------------------------------------------
+# autotune categories are topology-derived
+# ---------------------------------------------------------------------------
+
+
+def test_categories_single_slice_excludes_hierarchical():
+    cats = build_categories(multislice=False, replay_enabled=True)
+    assert cats == [
+        {"cache_enabled": True, "hierarchical_allreduce": False}
+    ]
+
+
+def test_categories_multislice_includes_hierarchical():
+    cats = build_categories(multislice=True, replay_enabled=True)
+    assert {"cache_enabled": True, "hierarchical_allreduce": True} in cats
+
+
+def test_categories_incapable_plane_excludes_hierarchical():
+    cats = build_categories(
+        multislice=True, replay_enabled=False, hierarchical_capable=False
+    )
+    assert all(not c["hierarchical_allreduce"] for c in cats)
+    # cache-off explored when replay is off (the native engine's chain)
+    assert {"cache_enabled": False, "hierarchical_allreduce": False} in cats
+
+
+def test_categories_replay_excludes_cache_off():
+    cats = build_categories(multislice=True, replay_enabled=True)
+    assert all(c["cache_enabled"] for c in cats)
+
+
+# ---------------------------------------------------------------------------
+# slice-tagged straggler blame
+# ---------------------------------------------------------------------------
+
+
+def _blame(count, rank, slice_id=None):
+    tags = {"rank": str(rank)}
+    if slice_id is not None:
+        tags["slice"] = str(slice_id)
+    return {
+        "name": obs_straggler.PREFIX + "last_arrivals",
+        "type": "counter",
+        "value": count,
+        "tags": tags,
+    }
+
+
+def test_merge_blames_slice_verdict():
+    verdict = obs_straggler.merge_blames([
+        [_blame(3, 2, 1), _blame(2, 3, 1), _blame(1, 0, 0)],
+        [_blame(3, 2, 1)],
+    ])
+    assert verdict["rank"] == 2
+    assert verdict["slice"] == 1
+    assert verdict["slice_blames"] == {0: 1, 1: 5}
+    assert verdict["slice_share"] == pytest.approx(5 / 6)
+
+
+def test_merge_blames_without_slice_tags_has_no_slice_key():
+    verdict = obs_straggler.merge_blames([[_blame(3, 1)]])
+    assert verdict["rank"] == 1
+    assert "slice" not in verdict
+
+
+def test_slice_tag_empty_on_single_slice_topology():
+    assert obs_straggler._slice_tag(0) == {}
+
+
+def _live_payload(metrics):
+    """Compact delta payload (obs/stream.py wire schema) for the
+    aggregator tests."""
+    return {
+        "v": 1, "rank": 0, "epoch": 0, "seq": 0, "t": 1000.0,
+        "phase": "steady", "progress": 5, "full": True,
+        "metrics": list(metrics),
+    }
+
+
+def _compact(name, value, kind="c", **tags):
+    out = {"n": name, "k": kind, "v": value}
+    if tags:
+        out["g"] = {k: str(v) for k, v in tags.items()}
+    return out
+
+
+def test_digest_names_straggling_slice():
+    from horovod_tpu.obs import live as obs_live
+
+    agg = obs_live.LiveAggregator()
+    agg.ingest(_live_payload([
+        _compact(obs_straggler.PREFIX + "last_arrivals", 4,
+                 rank=2, slice=1),
+    ]))
+    d = agg.digest(1)
+    assert "straggler rank 2" in d
+    assert "slice 1 is the straggler" in d
+
+
+def test_fabric_digest_token_and_summary_section():
+    from horovod_tpu.obs import live as obs_live
+    from horovod_tpu.obs import summary as obs_summary
+
+    agg = obs_live.LiveAggregator()
+    agg.ingest(_live_payload([
+        _compact("engine.dcn_bytes", 48.0),
+        _compact("engine.ici_bytes", 96.0),
+        _compact("engine.dcn_compression_ratio", 2.0, kind="g"),
+    ]))
+    d = agg.digest(1)
+    assert "fabric dcn" in d and "dcn/ici 0.50" in d and "wire x2.0" in d
+    fabric = [
+        {"name": "engine.dcn_bytes", "type": "counter", "value": 48.0},
+        {"name": "engine.ici_bytes", "type": "counter", "value": 96.0},
+        {"name": "engine.dcn_compression_ratio", "type": "gauge",
+         "value": 2.0},
+    ]
+    section = obs_summary.fabric_section({"0": {"metrics": fabric}})
+    assert section is not None
+    assert "dcn 48" in section and "ici 96" in section
+    # single-slice job (no fabric counters): no section
+    assert obs_summary.fabric_section({"0": {"metrics": []}}) is None
+
+
+# ---------------------------------------------------------------------------
+# slice blacklist
+# ---------------------------------------------------------------------------
+
+
+def test_blacklist_slice_quorum_blacklists_whole_slice():
+    clock = [0.0]
+    bl = HostBlacklist(cooldown_base=10.0, clock=lambda: clock[0])
+    s1 = ["c", "d", "e"]
+    bl.record_failure("c", slice_id=1, slice_hosts=s1)
+    # 1/3 failed: no quorum yet — healthy members stay admissible
+    assert bl.is_admissible("d") and bl.is_admissible("e")
+    assert bl.blacklisted_slices() == []
+    bl.record_failure("d", slice_id=1, slice_hosts=s1)
+    # 2/3 failed: strict majority — the whole slice is out
+    assert not bl.is_admissible("e")
+    assert bl.blacklisted_slices() == [1]
+    # slice 0 hosts untouched
+    assert bl.is_admissible("a")
+    # cooldown elapses: implicit re-admission, slice drops off the list
+    clock[0] = 1000.0
+    assert bl.is_admissible("e")
+    assert bl.blacklisted_slices() == []
+
+
+def test_blacklist_two_host_slice_needs_both():
+    bl = HostBlacklist(cooldown_base=10.0, clock=lambda: 0.0)
+    bl.record_failure("a", slice_id=0, slice_hosts=["a", "b"])
+    assert bl.is_admissible("b")  # 1/2 is not a strict majority
+    bl.record_failure("b", slice_id=0, slice_hosts=["a", "b"])
+    assert bl.blacklisted_slices() == [0]
+
+
+def test_blacklist_slice_quorum_can_retrigger_after_readmission():
+    """A persistently bad slice must be holdable-out AGAIN after its
+    first wholesale hold expires — and only post-readmission failures
+    count toward the fresh quorum."""
+    clock = [0.0]
+    bl = HostBlacklist(cooldown_base=10.0, clock=lambda: clock[0])
+    hosts = ["a", "b"]
+    bl.record_failure("a", slice_id=0, slice_hosts=hosts)
+    bl.record_failure("b", slice_id=0, slice_hosts=hosts)
+    assert bl.blacklisted_slices() == [0]
+    clock[0] = 1000.0  # hold expired: clean window
+    assert bl.blacklisted_slices() == []
+    bl.record_failure("a", slice_id=0, slice_hosts=hosts)
+    # one fresh failure is not a majority — stale failures don't count
+    assert bl.blacklisted_slices() == []
+    bl.record_failure("b", slice_id=0, slice_hosts=hosts)
+    assert bl.blacklisted_slices() == [0]
+
+
+def test_blacklist_without_slice_info_unchanged():
+    bl = HostBlacklist(cooldown_base=10.0, clock=lambda: 0.0)
+    assert bl.record_failure("h") == 1
+    assert bl.blacklisted_slices() == []
+
+
+# ---------------------------------------------------------------------------
+# downgrade warnings (the silent no-op knob, fixed)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_warns_on_unsupported_hierarchical_request(
+    monkeypatch, hvd_caplog
+):
+    from horovod_tpu.runtime.engine import EagerEngine
+
+    monkeypatch.setenv("HVDTPU_HIERARCHICAL_ALLREDUCE", "1")
+    eng = EagerEngine()  # world=1: no plane, not capable
+    assert eng.hierarchical is False
+    assert eng._hier_capable is False
+    assert "downgrading to flat" in hvd_caplog.text
+
+
+def test_engine_rejects_unknown_dcn_compression(monkeypatch, hvd_caplog):
+    from horovod_tpu.runtime.engine import EagerEngine
+
+    monkeypatch.setenv("HVDTPU_DCN_COMPRESSION", "zstd")
+    eng = EagerEngine()
+    assert eng._dcn_wire is None
+    assert "HVDTPU_DCN_COMPRESSION" in hvd_caplog.text
+
+
+def test_slice_size_on_single_process_dev_topology(monkeypatch):
+    """process_count=1 with chip-level slices (the 8-device dev world
+    forced into 2): slice_size reports chips per slice, never 0."""
+    from horovod_tpu import basics
+
+    topo = basics.Topology(
+        process_rank=0, process_count=1, local_rank=0, local_size=1,
+        cross_rank=0, cross_size=1,
+        devices=tuple(range(8)), num_slices=2, slice_id=0,
+    )
+    monkeypatch.setattr(basics, "_topology", topo)
+    assert basics.slice_size() == 4
+
+
+def test_apply_params_cannot_unpin_hierarchical(monkeypatch):
+    """--hierarchical-allreduce pins the schedule: a tuned-params move
+    carrying hierarchical=False must not flip a pinned engine flat."""
+    from horovod_tpu.runtime.engine import EagerEngine
+    from horovod_tpu.runtime.autotune import TunedParams
+
+    eng = EagerEngine()
+    eng._hier_capable = True
+    eng._hier_pinned = True
+    eng.hierarchical = True
+    eng._apply_params(TunedParams(
+        fusion_bytes=1 << 20, cycle_s=0.005,
+        hierarchical_allreduce=False,
+    ))
+    assert eng.hierarchical is True
+    # unpinned engines follow the tuner
+    eng._hier_pinned = False
+    eng._apply_params(TunedParams(
+        fusion_bytes=1 << 20, cycle_s=0.005,
+        hierarchical_allreduce=False,
+    ))
+    assert eng.hierarchical is False
+
+
+def test_error_feedback_refuses_traced_input():
+    import jax
+
+    ef = ErrorFeedbackCompressor(BFloat16Compressor)
+
+    def f(x):
+        w, ctx = ef.compress(x, key="g")
+        return ef.decompress(w, ctx)
+
+    with pytest.raises(TypeError, match="cannot run inside jit"):
+        jax.jit(f)(np.ones(4, np.float32))
+
+
+def test_hierarchical_rejects_stateful_compressor_name():
+    with pytest.raises(ValueError, match="unknown dcn compression"):
+        hierarchical_allreduce(np.ones(4, np.float32),
+                               compression="ef_bf16")
+
+
+def test_cli_maps_num_slices_and_dcn_compression():
+    from horovod_tpu.run import config_parser
+    from horovod_tpu.run.runner import parse_args
+
+    args = parse_args([
+        "-np", "4", "--num-slices", "2", "--dcn-compression", "bf16",
+        "--hierarchical-allreduce", "python", "x.py",
+    ])
+    env = {}
+    config_parser.set_env_from_args(env, args)
+    assert env["HVDTPU_NUM_SLICES"] == "2"
+    assert env["HVDTPU_DCN_COMPRESSION"] == "bf16"
+    assert env["HVDTPU_HIERARCHICAL_ALLREDUCE"] == "1"
+
+
+# ---------------------------------------------------------------------------
+# 4-process forced-2x2 acceptance through the launcher
+# ---------------------------------------------------------------------------
+
+
+def _hier_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu._engine_registry import peek_engine
+    from horovod_tpu.obs import get_registry
+
+    hvd.init()
+    r = hvd.rank()
+    outs = []
+    for i in range(6):
+        out = hvd.allreduce(
+            np.arange(16, dtype=np.float32) * (i + 1) + r,
+            op=hvd.Sum, name=f"g{i}",
+        )
+        outs.append(np.asarray(out).tolist())
+    eng = peek_engine()
+    counters = {
+        m["name"]: m.get("value")
+        for m in get_registry().snapshot()
+        if not m.get("tags")
+    }
+    return {
+        "rank": r,
+        "slice": hvd.slice_id(),
+        "num_slices": hvd.num_slices(),
+        "hier": eng.hierarchical,
+        "capable": eng._hier_capable,
+        "outs": outs,
+        "dcn": counters.get("engine.dcn_bytes", 0),
+        "ici": counters.get("engine.ici_bytes", 0),
+        "ratio": counters.get("engine.dcn_compression_ratio", 0),
+        "stats": dict(eng.stats),
+    }
+
+
+_MS_ENV = {
+    "HVDTPU_EAGER_ENGINE": "python",
+    "HVDTPU_SLICE_SIZE": "2",
+    # one CPU device per worker keeps the 4-proc spawn light
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+@pytest.mark.multiprocess
+def test_hierarchical_engine_bitwise_equals_flat_4proc():
+    """Forced 2x2 world: the engine's hierarchical path produces
+    BITWISE-identical results to the flat path (integer-valued floats
+    sum exactly), DCN moved exactly 1/slice_procs of the ICI bytes, and
+    slice ids follow the contiguous-block rule."""
+    hier = hvdrun.run(_hier_fn, np=4, use_cpu=True, timeout=300,
+                      env={**_MS_ENV, "HVDTPU_HIERARCHICAL_ALLREDUCE": "1"})
+    flat = hvdrun.run(_hier_fn, np=4, use_cpu=True, timeout=300,
+                      env=dict(_MS_ENV))
+    for r, h in enumerate(hier):
+        assert h["num_slices"] == 2
+        assert h["slice"] == r // 2
+        assert h["capable"] and h["hier"]
+        assert h["outs"] == flat[r]["outs"], "hier != flat result"
+        assert h["dcn"] > 0 and h["ici"] > 0
+        assert h["dcn"] * 2 == h["ici"], (h["dcn"], h["ici"])
+    for f in flat:
+        # without the pin the engine stays flat (tuner off) and charges
+        # the full payload to DCN — the cost the schedule avoids
+        assert not f["hier"]
+        assert f["dcn"] > 0 and f["ici"] == 0
+    # single-slice world: NEITHER fabric counter moves, so the fabric
+    # digest token and summary section stay absent (documented contract)
+    single = hvdrun.run(
+        _hier_fn, np=2, use_cpu=True, timeout=300,
+        env={k: v for k, v in _MS_ENV.items()
+             if k != "HVDTPU_SLICE_SIZE"},
+    )
+    for s in single:
+        assert s["num_slices"] == 1
+        assert s["dcn"] == 0 and s["ici"] == 0
+
+
+@pytest.mark.multiprocess
+def test_hierarchical_compressed_dcn_wire_4proc():
+    hier = hvdrun.run(
+        _hier_fn, np=4, use_cpu=True, timeout=300,
+        env={
+            **_MS_ENV,
+            "HVDTPU_HIERARCHICAL_ALLREDUCE": "1",
+            "HVDTPU_DCN_COMPRESSION": "bf16",
+        },
+    )
+    flat = hvdrun.run(_hier_fn, np=4, use_cpu=True, timeout=300,
+                      env=dict(_MS_ENV))
+    for r, h in enumerate(hier):
+        assert h["ratio"] == 2.0  # f32 wire / bf16 DCN leg
+        # dcn bytes halve again: shard elements x 2B instead of x 4B
+        assert h["dcn"] * 4 == h["ici"], (h["dcn"], h["ici"])
+        ref = np.asarray(flat[r]["outs"], np.float64)
+        got = np.asarray(h["outs"], np.float64)
+        # slice-partial sums cross DCN in bf16: one cast round-trip
+        assert np.abs(got - ref).max() <= 2 ** -7 * np.abs(ref).max() * 2
+
+
+def _hier_replay_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu._engine_registry import peek_engine
+    from horovod_tpu.obs import get_registry
+
+    hvd.init()
+    ok = True
+    for i in range(60):
+        out = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name="grad")
+        ok = ok and float(np.asarray(out)[0]) == 4.0
+    eng = peek_engine()
+    counters = {m["name"]: m.get("value") for m in get_registry().snapshot()
+                if not m.get("tags")}
+    return {"ok": ok, "stats": dict(eng.stats), "hier": eng.hierarchical,
+            "dcn": counters.get("engine.dcn_bytes", 0),
+            "ici": counters.get("engine.ici_bytes", 0)}
+
+
+@pytest.mark.multiprocess
+def test_hierarchical_replay_epoch_4proc():
+    """Schedule replay composes with the hierarchical plane: the epoch
+    check lane rides the hierarchical first buffer (psum_scatter + DCN
+    psum + all_gather preserve a nonzero flag), negotiation is skipped
+    in steady state, and every result stays correct."""
+    results = hvdrun.run(
+        _hier_replay_fn, np=4, use_cpu=True, timeout=300,
+        env={
+            **_MS_ENV,
+            "HVDTPU_HIERARCHICAL_ALLREDUCE": "1",
+            "HVDTPU_SCHEDULE_REPLAY_CYCLES": "5",
+            "HVDTPU_CYCLE_TIME": "2",
+        },
+    )
+    for r in results:
+        assert r["ok"]
+        assert r["hier"]
+        assert r["stats"]["replay_epochs"] >= 1
+        assert r["stats"]["replay_cycles"] > 0
+        # replay appends the 1-elem flag lane (odd 9-elem buffers): the
+        # dcn == ici / slice_procs identity must hold EXACTLY through
+        # padded accounting
+        assert r["dcn"] > 0 and r["dcn"] * 2 == r["ici"], (
+            r["dcn"], r["ici"])
+
+
+def _slice_blame_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.obs import get_registry
+
+    hvd.init()
+    for i in range(12):
+        hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name=f"t{i}")
+    return {
+        "rank": hvd.rank(),
+        "metrics": get_registry().snapshot(),
+    }
+
+
+@pytest.mark.multiprocess
+def test_slice_tagged_straggler_blame_4proc():
+    """A seeded delay on rank 2 (slice 1): the controller's attribution
+    carries the slice tag, and the shared merger names slice 1 — the
+    verdict the live digest and --stats-summary print."""
+    results = hvdrun.run(
+        _slice_blame_fn, np=4, use_cpu=True, timeout=300,
+        env={
+            **_MS_ENV,
+            "HVDTPU_CYCLE_TIME": "2",
+            # repeated delays so the seeded straggler dominates ordinary
+            # startup skew (which can blame any slow-to-form rank once)
+            "HVDTPU_FAULT_SPEC":
+                "enqueue:rank=2:count=8:action=delay:400",
+        },
+    )
+    verdict = obs_straggler.merge_blames(
+        [r["metrics"] for r in results]
+    )
+    assert verdict is not None
+    assert verdict["rank"] == 2
+    assert verdict["slice"] == 1
+    assert verdict["slice_blames"].get(1, 0) >= 4
