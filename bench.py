@@ -293,7 +293,9 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                    attention: str = "flash", remat: bool = False,
                    flash_block_q: int = 512, flash_block_k: int = 256,
                    kv_heads: int = 0, pos_embedding: str = "learned",
-                   moe_experts: int = 0, attention_window: int = 0):
+                   moe_experts: int = 0, attention_window: int = 0,
+                   overlap_mode: str = "off",
+                   grad_bucket_mb: float = None):
     """GPT causal-LM training step (flash attention) — the long-context
     counterpart of the ResNet bench.  Returns ``(step, state, static)``
     like ``build_step``; throughput is reported in tokens/sec/chip."""
@@ -329,10 +331,8 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
     )
     params = model.init(jax.random.PRNGKey(0), tokens[:2, :-1])
     params = hvd.broadcast_parameters(params, root_rank=0)
-    tx = DistributedOptimizer(optax.adamw(1e-4))
-    opt_state = tx.init(params)
 
-    def local_step(params, opt_state, toks):
+    def make_loss_fn(toks):
         def loss_fn(p):
             if moe_experts:
                 logits, state = model.apply(
@@ -346,7 +346,49 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
                 logits, toks[:, 1:]
             ).mean() + aux
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return loss_fn
+
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops.collectives import shard_map_compat
+
+    mesh = hvd.mesh("flat")
+    if overlap_mode != "off":
+        # Backward-overlap plane: per-bucket collectives in the
+        # cotangent path (+ optional ZeRO-1 sharded update) instead of
+        # the end-of-step fused psum DistributedOptimizer runs.
+        from horovod_tpu.optim.overlap import OverlapPlan
+
+        plan = OverlapPlan(params, optax.adamw(1e-4), mode=overlap_mode,
+                           bucket_mb=grad_bucket_mb, mesh=mesh)
+        spec = plan.state_spec()
+
+        def local_step(ostate, toks):
+            body = plan.local_step(make_loss_fn(toks))
+            ostate, loss = body(ostate)
+            # Mean over the DP axis: out_specs P() presents the loss as
+            # replicated, so it must actually BE global (see below).
+            return ostate, jax.lax.pmean(loss, hvd.DP_AXIS)
+
+        step = jax.jit(
+            shard_map_compat(
+                local_step,
+                mesh=mesh,
+                in_specs=(spec, P(hvd.DP_AXIS)),
+                out_specs=(spec, P()),
+            ),
+            donate_argnums=(0,),
+        )
+        state = (plan.init(params), tokens)
+        return step, state, {"n_chips": n_chips,
+                             "global_batch": global_batch,
+                             "carry_len": 1}
+
+    tx = DistributedOptimizer(optax.adamw(1e-4))
+    opt_state = tx.init(params)
+
+    def local_step(params, opt_state, toks):
+        loss, grads = jax.value_and_grad(make_loss_fn(toks))(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         # Mean over the DP axis: out_specs P() presents the return value as
         # replicated, so the loss must actually BE global — otherwise the
@@ -355,11 +397,6 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
         loss = jax.lax.pmean(loss, hvd.DP_AXIS)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    from jax.sharding import PartitionSpec as P
-
-    from horovod_tpu.ops.collectives import shard_map_compat
-
-    mesh = hvd.mesh("flat")
     step = jax.jit(
         shard_map_compat(
             local_step,
@@ -370,11 +407,13 @@ def build_gpt_step(size: str, dtype: str, batch_size: int, seq_len: int,
         donate_argnums=(0, 1),
     )
     state = (params, opt_state, tokens)
-    return step, state, {"n_chips": n_chips, "global_batch": global_batch}
+    return step, state, {"n_chips": n_chips, "global_batch": global_batch,
+                         "carry_len": 2}
 
 
 def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 224,
-               s2d_stem: bool = False):
+               s2d_stem: bool = False, overlap_mode: str = "off",
+               grad_bucket_mb: float = None):
     """Build the benchmark's jitted training step and its initial state.
 
     Shared by bench.py (timing) and scripts/profile_bench.py (tracing) so the
@@ -430,12 +469,7 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
     batch_stats = variables.get("batch_stats", {})
     params = hvd.broadcast_parameters(params, root_rank=0)
 
-    tx = DistributedOptimizer(
-        optax.sgd(0.01, momentum=0.9), compression=hvd.Compression.none
-    )
-    opt_state = tx.init(params)
-
-    def local_step(params, batch_stats, opt_state, images, labels):
+    def make_loss_fn(batch_stats, images, labels):
         def loss_fn(p):
             logits, mutated = model.apply(
                 {"params": p, "batch_stats": batch_stats},
@@ -448,6 +482,52 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
             ).mean()
             return loss, dict(mutated).get("batch_stats", {})
 
+        return loss_fn
+
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops.collectives import shard_map_compat
+
+    mesh = hvd.mesh("flat")
+    if overlap_mode != "off":
+        # Backward-overlap plane (--overlap {bucket,bucket+zero1}): one
+        # fused collective per gradient bucket, emitted inside the
+        # backward; zero1 additionally shards the optimizer update.
+        from horovod_tpu.optim.overlap import OverlapPlan
+
+        plan = OverlapPlan(params, optax.sgd(0.01, momentum=0.9),
+                           mode=overlap_mode, bucket_mb=grad_bucket_mb,
+                           mesh=mesh)
+        spec = plan.state_spec()
+
+        def local_step(ostate, batch_stats, images, labels):
+            body = plan.local_step(
+                make_loss_fn(batch_stats, images, labels), has_aux=True
+            )
+            ostate, loss, new_stats = body(ostate)
+            return ostate, new_stats, loss
+
+        step = jax.jit(
+            shard_map_compat(
+                local_step,
+                mesh=mesh,
+                in_specs=(spec, P(), P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+                out_specs=(spec, P(), P()),
+            ),
+            donate_argnums=(0, 1),
+        )
+        state = (plan.init(params), batch_stats, images, labels)
+        return step, state, {"n_chips": n_chips,
+                             "global_batch": global_batch,
+                             "carry_len": 2}
+
+    tx = DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9), compression=hvd.Compression.none
+    )
+    opt_state = tx.init(params)
+
+    def local_step(params, batch_stats, opt_state, images, labels):
+        loss_fn = make_loss_fn(batch_stats, images, labels)
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params
         )
@@ -455,11 +535,6 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
 
-    from jax.sharding import PartitionSpec as P
-
-    from horovod_tpu.ops.collectives import shard_map_compat
-
-    mesh = hvd.mesh("flat")
     step = jax.jit(
         shard_map_compat(
             local_step,
@@ -470,7 +545,8 @@ def build_step(model_name: str, dtype: str, batch_size: int, image_size: int = 2
         donate_argnums=(0, 1, 2),
     )
     state = (params, batch_stats, opt_state, images, labels)
-    return step, state, {"n_chips": n_chips, "global_batch": global_batch}
+    return step, state, {"n_chips": n_chips, "global_batch": global_batch,
+                         "carry_len": 3}
 
 
 def _is_unavailable(exc: BaseException) -> bool:
@@ -650,7 +726,7 @@ def collect_engine_gauges() -> dict:
     try:
         from horovod_tpu.obs import get_registry
 
-        wanted_prefixes = ("autotune.",)
+        wanted_prefixes = ("autotune.", "overlap.")
         wanted_names = {
             "engine.negotiation_skip_rate",
             "engine.cache_hit_rate",
@@ -666,12 +742,32 @@ def collect_engine_gauges() -> dict:
             "engine.dcn_compression_ratio",
         }
         out = {}
+        bucket_bytes = []
         for m in get_registry().snapshot():
             name = m.get("name", "")
             if m.get("tags"):
+                # Per-bucket byte gauges are the one tagged family a
+                # BENCH record wants whole: the next TPU round needs to
+                # attribute an MFU delta to the bucket shape, not just
+                # the bucket count.
+                if name == "overlap.bucket_bytes":
+                    tag = m["tags"].get("bucket")
+                    if tag is not None and str(tag).isdigit():
+                        bucket_bytes.append((int(tag), m.get("value")))
                 continue
             if name in wanted_names or name.startswith(wanted_prefixes):
                 out[name] = m.get("value")
+        if bucket_bytes:
+            out["overlap_bucket_bytes"] = [
+                v for _, v in sorted(bucket_bytes)
+            ]
+        if "overlap.mode" in out:
+            try:
+                from horovod_tpu.optim.overlap import MODES
+
+                out["overlap_mode"] = MODES[int(out["overlap.mode"])]
+            except Exception:
+                pass
         return out
     except Exception:
         return {}
@@ -722,6 +818,17 @@ def main() -> int:
                         help="space-to-depth stem (MLPerf TPU recipe)")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (dev mode; numbers not comparable)")
+    parser.add_argument("--overlap", default=None,
+                        choices=["off", "bucket", "bucket+zero1"],
+                        help="backward-overlap gradient plane: bucket = "
+                        "in-backward bucketed allreduce, bucket+zero1 "
+                        "additionally reduce-scatter-shards the "
+                        "optimizer update (default: HVDTPU_OVERLAP or "
+                        "off)")
+    parser.add_argument("--grad-bucket-mb", type=float, default=None,
+                        help="gradient bucket size cap for --overlap "
+                        "(default: HVDTPU_GRAD_BUCKET_MB or 16; sweep "
+                        "candidates: autotune.grad_bucket_candidates)")
     parser.add_argument("--num-slices", type=int, default=0,
                         help="force a multislice partition "
                         "(HVDTPU_NUM_SLICES) so the record embeds the "
@@ -753,6 +860,13 @@ def main() -> int:
         # Before hvd.init(): the slice partition is resolved there.
         os.environ["HVDTPU_NUM_SLICES"] = str(args.num_slices)
 
+    if args.overlap is None:
+        args.overlap = os.environ.get("HVDTPU_OVERLAP", "off")
+        if args.overlap not in ("off", "bucket", "bucket+zero1"):
+            raise SystemExit(
+                f"HVDTPU_OVERLAP={args.overlap!r}: choices are off, "
+                f"bucket, bucket+zero1"
+            )
     is_gpt = args.model.startswith("gpt-")
     if args.batch_size is None:
         args.batch_size = 8 if is_gpt else 128
@@ -774,14 +888,17 @@ def main() -> int:
                 kv_heads=args.kv_heads, pos_embedding=args.pos_embedding,
                 moe_experts=args.moe_experts,
                 attention_window=args.attention_window,
+                overlap_mode=args.overlap,
+                grad_bucket_mb=args.grad_bucket_mb,
             )
-            carry, const = state[:-1], state[-1:]
         else:
             step, state, static = build_step(
                 args.model, args.dtype, args.batch_size, args.image_size,
-                s2d_stem=args.s2d_stem,
+                s2d_stem=args.s2d_stem, overlap_mode=args.overlap,
+                grad_bucket_mb=args.grad_bucket_mb,
             )
-            carry, const = state[:3], state[3:]
+        ncarry = static["carry_len"]
+        carry, const = state[:ncarry], state[ncarry:]
         n_chips = static["n_chips"]
         global_batch = static["global_batch"]
         # init+build done; compile gets its own (wide) window
@@ -790,6 +907,18 @@ def main() -> int:
         compiled = step.lower(*carry, *const).compile()
         # compile done; warmup window
         _touch_progress(next_window=300, phase="warmup")
+        # Donation audit: params/opt_state must stay aliased end-to-end
+        # through whichever step wrapper built the program (donation
+        # silently degrades to a copy on mismatch, so check the
+        # artifact).  Best-effort: never sinks the measurement.
+        try:
+            from horovod_tpu.optim.overlap import audit_donation
+
+            donation_audit = audit_donation(
+                compiled, len(jax.tree_util.tree_leaves(carry))
+            )
+        except Exception:
+            donation_audit = None
         try:
             flops_per_step_per_chip = float(
                 compiled.cost_analysis()["flops"]
@@ -856,6 +985,10 @@ def main() -> int:
         out["flops_per_image"] = round(
             flops_per_step_per_chip / args.batch_size / 1e9, 3
         )
+    if args.overlap != "off":
+        out["overlap_mode"] = args.overlap
+    if donation_audit is not None:
+        out["donation"] = donation_audit
     gauges = collect_engine_gauges()
     if gauges:
         out["engine_gauges"] = gauges

@@ -617,6 +617,108 @@ print(f"multislice gate OK: dcn/ici = {hier[0]['dcn']}/{hier[0]['ici']} "
 EOF
 rm -rf "$MS_TMP"
 
+# Overlap gate (ISSUE 9): the backward-overlap gradient plane on a
+# 4-device CPU mesh must (a) schedule per-bucket collectives INSIDE the
+# backward — inspector-verified >=2 gradient collectives before the
+# last backward compute op, while the off-mode module reads as one
+# monolithic end-of-backward psum — (b) produce training bitwise-equal
+# to off for both bucket and bucket+zero1, and (c) land a BENCH record
+# (degraded allowed on CPU) with the overlap stats embedded.
+echo "== overlap gate: in-backward bucketed collectives =="
+OV_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 300 python - <<'EOF'
+import jax, jax.numpy as jnp, numpy as np, optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.optim import overlap
+from horovod_tpu.ops.collectives import shard_map_compat
+
+mesh = Mesh(np.asarray(jax.devices(), dtype=object).reshape(4),
+            (hvd.DP_AXIS,))
+
+def init_params(key):
+    sizes = [32, 64, 37, 64, 10]
+    params = []
+    for i in range(4):
+        k, key = jax.random.split(key)
+        params.append({"w": jax.random.normal(k, (sizes[i], sizes[i+1])) * .1,
+                       "b": jnp.zeros(sizes[i+1])})
+    return params
+
+def loss_fn(params, x, y):
+    h = x
+    for i, layer in enumerate(params):
+        h = h @ layer["w"] + layer["b"]
+        if i < 3:
+            h = jax.nn.relu(h)
+    return jnp.mean((h - y) ** 2)
+
+params = init_params(jax.random.PRNGKey(0))
+x = jax.random.normal(jax.random.PRNGKey(1), (16, 32))
+y = jax.random.normal(jax.random.PRNGKey(2), (16, 10))
+tx = optax.sgd(0.05, momentum=0.9)
+
+results, reports = {}, {}
+for mode in overlap.MODES:
+    plan = overlap.OverlapPlan(params, tx, mode=mode, mesh=mesh,
+                               bucket_mb=8 / 1024.0)
+    spec = plan.state_spec()
+    step = jax.jit(shard_map_compat(
+        plan.local_step(loss_fn), mesh=mesh,
+        in_specs=(spec, P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+        out_specs=(spec, P()),
+    ), donate_argnums=(0,))
+    state = plan.init(params)
+    reports[mode] = overlap.inspect_schedule(step.lower(state, x, y))
+    for _ in range(4):
+        state, loss = step(state, x, y)
+    results[mode] = jax.tree_util.tree_leaves(plan.materialize(state))
+
+# (a) per-bucket collectives inside the backward, not one monolithic psum
+rep, rep_off = reports["bucket"], reports["off"]
+assert rep.gradient_collectives >= 3, rep.as_dict()
+assert rep.in_backward >= 2, rep.as_dict()
+assert rep_off.gradient_collectives == 1 and rep_off.monolithic, \
+    rep_off.as_dict()
+# (b) bitwise-equal training
+for mode in ("bucket", "bucket+zero1"):
+    for a, b in zip(results["off"], results[mode]):
+        assert bool(jnp.all(a == b)), f"{mode} diverged from off"
+print(f"overlap gate OK: bucket={rep.as_dict()} off={rep_off.as_dict()}, "
+      f"bucket/bucket+zero1 bitwise == off over 4 steps")
+EOF
+# (c) a BENCH record lands with the overlap stats embedded
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+HVDTPU_BENCH_RECORD_DIR="$OV_TMP" \
+    timeout 540 python bench.py --cpu --model resnet18 --image-size 64 \
+    --batch-size 2 --iters 2 --warmup 1 --overlap bucket \
+    --grad-bucket-mb 4 > "$OV_TMP/bench.out"
+python - "$OV_TMP" <<'EOF'
+import glob, json, sys
+
+recs = sorted(glob.glob(f"{sys.argv[1]}/BENCH_*.json"))
+assert recs, "overlap bench landed no BENCH record"
+doc = json.load(open(recs[-1]))
+parsed = doc.get("parsed") or {}
+gauges = parsed.get("engine_gauges") or {}
+assert parsed.get("overlap_mode") == "bucket", parsed
+assert gauges.get("overlap_mode") == "bucket", gauges
+assert gauges.get("overlap.buckets", 0) >= 2, gauges
+bb = gauges.get("overlap_bucket_bytes")
+assert bb and len(bb) == int(gauges["overlap.buckets"]), gauges
+assert parsed.get("donation", {}).get("ok") is True, parsed
+print(f"overlap bench record OK: {len(bb)} buckets, "
+      f"donation {parsed['donation']['donated']}/"
+      f"{parsed['donation']['expected']}")
+EOF
+rm -rf "$OV_TMP"
+
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must
 # recover via rollback + respawn (the example asserts it did).

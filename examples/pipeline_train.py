@@ -146,7 +146,11 @@ def main() -> int:
             in_specs=(P("pp"), P(), P(), P(), P()),
             out_specs=(P("pp"), P(), P(), P()),
             check_vma=True,
-        )
+        ),
+        # Donate the carried state: the input and output copies of the
+        # staged/replicated params and opt_state must not both stay
+        # live across a step (hvdtpu-lint HVD009).
+        donate_argnums=(0, 1, 2),
     )
 
     sched = f"circular x{circles}" if args.circles else "gpipe"

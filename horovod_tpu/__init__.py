@@ -82,6 +82,9 @@ def __getattr__(name):
         "broadcast_parameters",
         "broadcast_optimizer_state",
         "broadcast_object",
+        "sync_gradients",
+        "OverlapPlan",
+        "overlap",
     ):
         from . import optim  # noqa: PLC0415
 

@@ -607,6 +607,164 @@ def hvd008(model: ModuleModel) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# HVD009 — jit of a train step without buffer donation
+# ---------------------------------------------------------------------------
+
+# Argument names that mark a jitted function as carrying training state.
+_STATE_ARG_NAMES = {
+    "params", "param", "opt_state", "optimizer_state", "train_state",
+    "state", "weights",
+}
+# Wrappers whose first positional argument is the actual step function.
+_JIT_WRAPPER_NAMES = {"shard_map", "shard_map_compat", "partial", "remat",
+                      "checkpoint"}
+
+
+def _scope_then_module(scope: Optional[ast.AST],
+                       model: ModuleModel) -> List[ast.AST]:
+    """Search roots for name resolution: the jit call's enclosing
+    function first, then the module — a name bound in ANOTHER function
+    is a different variable entirely (resolving it would judge the jit
+    call against an unrelated same-named callable)."""
+    roots: List[ast.AST] = []
+    if scope is not None:
+        roots.append(scope)
+    roots.append(model.tree)
+    return roots
+
+
+def _find_binding(target: str, scope: Optional[ast.AST],
+                  model: ModuleModel) -> Optional[ast.AST]:
+    """The Assign value / def node `target` resolves to, scope-first."""
+    for root in _scope_then_module(scope, model):
+        module_level = root is model.tree
+        for node in ast.walk(root):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == target
+                for t in node.targets
+            ) and isinstance(node.value, (ast.Call, ast.Lambda)):
+                # At module level only accept top-level statements: an
+                # assignment inside some other function binds a
+                # different variable.
+                if module_level and node not in model.tree.body:
+                    continue
+                return node.value
+        for node in ast.walk(root):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == target:
+                return node
+    return None
+
+
+def _callee_arg_names(expr: ast.expr, model: ModuleModel,
+                      scope: Optional[ast.AST] = None
+                      ) -> Optional[List[str]]:
+    """Positional-argument names of the function a ``jax.jit`` call
+    wraps, looking through shard_map/partial wrappers and resolving
+    names scope-first (``scope`` = the jit call's enclosing function).
+    None = could not resolve (quiet)."""
+    for _ in range(4):  # bounded wrapper unwrap
+        if isinstance(expr, ast.Lambda):
+            return [a.arg for a in expr.args.args]
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names = [a.arg for a in expr.args.args]
+            if names and names[0] in ("self", "cls"):
+                return None  # method: not what jit wraps here
+            return names
+        if isinstance(expr, ast.Call):
+            name = astutil.call_name(expr)
+            if name in _JIT_WRAPPER_NAMES and expr.args:
+                expr = expr.args[0]
+                continue
+            return None
+        if isinstance(expr, ast.Name):
+            bound = _find_binding(expr.id, scope, model)
+            if bound is None:
+                return None
+            expr = bound
+            continue
+        return None
+    return None
+
+
+def _is_jax_jit_call(node: ast.Call, model: ModuleModel) -> bool:
+    if astutil.call_name(node) != "jit":
+        return False
+    recv = astutil.receiver_name(node)
+    if recv is not None:
+        return model.module_aliases.get(recv, recv) == "jax"
+    imported = model.from_imports.get("jit")
+    return imported is not None and imported[0] == "jax"
+
+
+@rule("HVD009", "undonated-train-step", SEV_WARNING,
+      "jax.jit of a step function carrying params/opt_state without "
+      "donate_argnums")
+def hvd009(model: ModuleModel) -> List[Finding]:
+    """A jitted train step whose arguments include params/opt_state but
+    whose ``jax.jit`` call passes no ``donate_argnums``/``donate_argnames``
+    keeps BOTH the input and output copies of the model state live
+    across every step: peak HBM grows by a full params+opt_state
+    replica, which is the difference between fitting a batch size and
+    OOMing — and on the ZeRO-sharded path it silently forfeits the
+    memory the sharding just bought.  (XLA only aliases input buffers
+    into outputs when the jit call donates them.)
+
+    Minimal failing example::
+
+        step = jax.jit(shard_map(local_step, mesh=mesh, ...))
+        # local_step(params, opt_state, batch): state copied every step
+
+    Fix: ``jax.jit(..., donate_argnums=(0, 1))`` for the state
+    arguments (then verify the aliasing took with
+    ``optim.overlap.audit_donation``), or baseline the site with a
+    reason (e.g. an eval-only apply where the state must survive)."""
+    out: List[Finding] = []
+    fmap = astutil.enclosing_function_map(model)
+    # Enclosing function per call node, for scope-first name resolution.
+    scopes: Dict[int, ast.AST] = {}
+
+    def index_scopes(node: ast.AST, scope: Optional[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = node
+        elif isinstance(node, ast.Call):
+            if scope is not None:
+                scopes[id(node)] = scope
+        for child in ast.iter_child_nodes(node):
+            index_scopes(child, scope)
+
+    index_scopes(model.tree, None)
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_jax_jit_call(node, model):
+            continue
+        kwarg_names = {kw.arg for kw in node.keywords}
+        if {"donate_argnums", "donate_argnames"} & kwarg_names:
+            continue
+        if None in kwarg_names:  # **kwargs splat: unknown, stay quiet
+            continue
+        if not node.args:
+            continue
+        arg_names = _callee_arg_names(node.args[0], model,
+                                      scope=scopes.get(id(node)))
+        if not arg_names:
+            continue
+        hits = sorted(set(arg_names) & _STATE_ARG_NAMES)
+        if not hits:
+            continue
+        out.append(make_finding(
+            "HVD009", model, node.lineno, node.col_offset,
+            f"jax.jit of a step taking {', '.join(hits)} without "
+            f"donate_argnums: input and output state copies both stay "
+            f"live, doubling peak state memory — donate the state "
+            f"arguments",
+            astutil.context_for_line(model, node.lineno, fmap),
+        ))
+    return out
+
+
 def _mentions_rank(expr: ast.expr) -> bool:
     for node in ast.walk(expr):
         if isinstance(node, ast.Call) and \

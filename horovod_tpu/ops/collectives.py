@@ -46,6 +46,8 @@ __all__ = [
     "broadcast_",
     "alltoall",
     "reducescatter",
+    "reduce_scatter_flat",
+    "all_gather_flat",
     "axis_rank",
     "axis_size",
 ]
@@ -456,6 +458,82 @@ def broadcast(
 def broadcast_(tensor, root_rank: int, **kwargs):
     """In-place-spelled alias; see :func:`allreduce_`."""
     return broadcast(tensor, root_rank, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# flat reduce-scatter / all-gather pair (the ZeRO-shape building blocks)
+# ---------------------------------------------------------------------------
+#
+# 1-D tiled scatter/gather with each other as VJP: the backward of
+# gathering shards into a full buffer is reduce-scattering the cotangent
+# (and vice versa).  This is what lets the overlap plane
+# (horovod_tpu.optim.overlap) express a ZeRO-1 step as "all-gather the
+# parameter shards in the forward" and get the per-bucket gradient
+# reduce-scatter emitted *inside the backward graph* for free — the
+# cotangent of each bucket's gather fires the moment that bucket's last
+# gradient materializes, which is the position XLA's latency-hiding
+# scheduler needs to overlap the wire with remaining backward compute.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _reduce_scatter_flat(x, axis_name):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+def _reduce_scatter_flat_fwd(x, axis_name):
+    return _reduce_scatter_flat(x, axis_name), None
+
+
+def _reduce_scatter_flat_bwd(axis_name, _, g):
+    # d(reduce_scatter)/dx: every rank's contribution to every element is
+    # weighted 1, so the cotangent of the owned shard broadcasts back to
+    # the full buffer — one tiled all-gather.
+    return (lax.all_gather(g, axis_name, axis=0, tiled=True),)
+
+
+_reduce_scatter_flat.defvjp(_reduce_scatter_flat_fwd, _reduce_scatter_flat_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _all_gather_flat(x, axis_name):
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def _all_gather_flat_fwd(x, axis_name):
+    return _all_gather_flat(x, axis_name), None
+
+
+def _all_gather_flat_bwd(axis_name, _, g):
+    # Reference allgather rule (mpi_ops.py:289-307) on the flat buffer:
+    # reduce the gathered cotangent and keep the own-rank chunk —
+    # psum_scatter does both in one collective.
+    return (lax.psum_scatter(g, axis_name, scatter_dimension=0, tiled=True),)
+
+
+_all_gather_flat.defvjp(_all_gather_flat_fwd, _all_gather_flat_bwd)
+
+
+def reduce_scatter_flat(flat, op: ReduceOp = Sum, *,
+                        axis_name: str = DP_AXIS):
+    """Reduce a 1-D buffer across the axis, keep this shard's tiled chunk
+    (``dim0`` must divide the axis size — pad first).  The element-wise
+    result is bitwise-identical to the matching slice of a full ``psum``,
+    which is what makes a reduce-scatter-sharded optimizer update provably
+    equivalent to the replicated one (tests/test_overlap.py)."""
+    if op not in (Sum, Average):
+        raise ValueError(f"reduce_scatter_flat supports Sum/Average, got {op!r}")
+    y = _reduce_scatter_flat(jnp.asarray(flat), axis_name)
+    if op == Average:
+        y = y / axis_size(axis_name)
+    return y
+
+
+def all_gather_flat(shard, *, axis_name: str = DP_AXIS):
+    """Concatenate each rank's 1-D shard along dim 0 (tiled), the exact
+    inverse of :func:`reduce_scatter_flat`'s slicing.  Its VJP is the
+    reduce-scatter of the cotangent, so gathering parameter shards in a
+    forward pass plants the gradient reduce-scatter inside the backward."""
+    return _all_gather_flat(jnp.asarray(shard), axis_name)
 
 
 # ---------------------------------------------------------------------------

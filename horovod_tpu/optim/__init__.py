@@ -6,9 +6,18 @@ where the reference intercepts per-parameter gradient hooks and fires
 ``allreduce_async_`` as each grad materializes, the TPU build expresses the
 same contract — "grads are globally reduced before the update" — as an
 **optax gradient transformation** that runs inside the jitted SPMD step.
-XLA then overlaps the psums with remaining backward compute automatically
-(the scheduling the reference's background thread + fusion buffer did by
-hand).
+
+Scheduling caveat: because the transform runs inside ``tx.update``, its
+psums sit *after* the whole backward pass in the compiled graph — XLA
+will not hoist them into the backward on its own, so the wire time of
+one end-of-step exchange is fully exposed.  The backward-overlap plane
+(:mod:`horovod_tpu.optim.overlap`) restores the reference's
+as-gradients-materialize overlap on the jit path: it plants one fused
+collective per size-bounded gradient bucket in the cotangent graph
+(``sync_gradients`` / ``OverlapPlan``), where the scheduler can hide it
+behind remaining backward compute, and optionally reduce-scatter-shards
+the optimizer update (ZeRO-1 shape).  Prefer it for throughput-critical
+training; this transform remains the simple, composable default.
 """
 
 from __future__ import annotations
@@ -39,7 +48,13 @@ __all__ = [
     "broadcast_parameters",
     "broadcast_optimizer_state",
     "broadcast_object",
+    "overlap",
+    "sync_gradients",
+    "OverlapPlan",
 ]
+
+from . import overlap  # noqa: E402  (backward-overlap gradient plane)
+from .overlap import OverlapPlan, sync_gradients  # noqa: E402
 
 
 def DistributedGradientTransform(
@@ -84,18 +99,18 @@ def DistributedGradientTransform(
     """
     if op not in (Average, Sum, Adasum):
         raise ValueError(f"DistributedGradientTransform supports Average/Sum/Adasum, got {op!r}")
-    if hierarchical_axes is not None:
-        if len(hierarchical_axes) != 2:
-            raise ValueError(
-                "hierarchical_axes must be (local_axis, cross_axis), got "
-                f"{hierarchical_axes!r}"
-            )
-        if gradient_predivide_factor != 1.0:
-            raise ValueError(
-                "gradient_predivide_factor is a flat-psum knob; the "
-                "hierarchical schedule applies its averaging once after "
-                "the cross-fabric phase"
-            )
+    if hierarchical_axes is not None and len(hierarchical_axes) != 2:
+        raise ValueError(
+            "hierarchical_axes must be (local_axis, cross_axis), got "
+            f"{hierarchical_axes!r}"
+        )
+    # NOTE: the gradient_predivide_factor x hierarchical incompatibility
+    # is validated at the first update_fn call (below), not here: a
+    # transform is often constructed generically (CLI-driven configs set
+    # both knobs) and never actually run on the hierarchical schedule —
+    # erroring at construction punished configurations that would never
+    # hit the incompatible path.  update_fn is where the schedule
+    # actually used is known.
 
     pre = 1.0
     post = 1.0
@@ -152,6 +167,12 @@ def DistributedGradientTransform(
             ctxs.append(c)
 
         if hierarchical_axes is not None:
+            if gradient_predivide_factor != 1.0:
+                raise ValueError(
+                    "gradient_predivide_factor is a flat-psum knob; the "
+                    "hierarchical schedule applies its averaging once "
+                    "after the cross-fabric phase"
+                )
             from ..parallel.hierarchical import (  # noqa: PLC0415
                 hierarchical_adasum,
                 hierarchical_allreduce,

@@ -1,0 +1,914 @@
+"""Backward-overlap gradient plane for the jit path.
+
+Horovod's core contribution is firing allreduce *as each gradient
+materializes during backward* (Sergeev & Del Balso 2018, §3: the
+background thread + fusion buffer overlap communication with the rest
+of the backward pass).  The jit path's ``DistributedGradientTransform``
+does the opposite: every psum runs inside ``tx.update`` *after* the
+whole backward completes, serializing one giant end-of-step exchange
+behind all compute.  This module restores the reference's overlap — as
+graph structure instead of a runtime thread, which is exactly GSPMD's
+static-schedule model (PAPERS.md):
+
+* :func:`sync_gradients` — ``value_and_grad`` whose cotangent path
+  carries one fused collective per size-bounded gradient *bucket*
+  (``--grad-bucket-mb``, default 16), planted with ``jax.custom_vjp``
+  identity taps so each bucket's psum is emitted the moment its last
+  gradient is produced.  XLA's scheduler then interleaves the wire with
+  the remaining backward compute (tests assert this from the compiled
+  HLO, not from hope).
+
+* :class:`OverlapPlan` — the full step builder.  Mode ``"bucket"`` is
+  the tap plane above plus a plain optax update; mode
+  ``"bucket+zero1"`` additionally shards the optimizer over the data
+  axis (ZeRO-1 shape): parameters are *carried as 1/world flat shards*,
+  all-gathered per bucket in the forward (so the VJP plants a per-bucket
+  reduce-scatter in the backward), updated on the shard (optimizer
+  memory and update flops ÷ world), and re-enter the next step still
+  sharded — per-step wire cost identical to one allreduce.  On a
+  two-fabric mesh the cross-slice legs ride DCN on 1/local_size of the
+  bytes (optionally compressed), composing with the PR-8 hierarchical
+  plane.
+
+* :func:`inspect_schedule` — compiled-HLO proof.  Parses
+  ``.lower(...).compile().as_text()`` (the *scheduled* module), locates
+  every gradient collective and every compute op, and reports how many
+  collectives land strictly inside the backward.  CI gates on this, so
+  "the buckets overlap" is a checked property of the artifact, not a
+  claim about the compiler.
+
+* :func:`donated_params` / :func:`audit_donation` — donation audit:
+  params/opt_state must stay donated end-to-end through the wrapper
+  (an undonated step doubles peak parameter memory and, on the ZeRO
+  path, silently forfeits the memory the sharding just bought).
+
+Equivalence contract: ``off``, ``bucket`` and ``bucket+zero1`` produce
+bitwise-identical losses/params on the same mesh — a psum is element-
+wise, so re-bucketing only regroups independent reductions, and a
+reduce-scatter shard is bitwise-equal to the matching slice of the full
+psum (tests/test_overlap.py pins this, including odd-sized leaves that
+straddle bucket boundaries and an N→M bucket-count change).  The ZeRO
+path additionally requires an *element-wise* optimizer (sgd/momentum/
+adam...); transforms that couple elements across leaves (global-norm
+clipping) would need their norms reduced across shards and are
+rejected by documentation, not detection — see docs/performance.md.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ..basics import DP_AXIS
+from ..ops.collectives import (
+    Average,
+    ReduceOp,
+    Sum,
+    all_gather_flat,
+    axis_size,
+    shard_map_compat,
+)
+
+__all__ = [
+    "MODES",
+    "Bucket",
+    "BucketLayout",
+    "build_layout",
+    "sync_gradients",
+    "OverlapPlan",
+    "ScheduleReport",
+    "inspect_schedule",
+    "donated_params",
+    "audit_donation",
+]
+
+MODES = ("off", "bucket", "bucket+zero1")
+
+_MODE_IDS = {m: i for i, m in enumerate(MODES)}
+
+
+# ---------------------------------------------------------------------------
+# bucket layout
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One fused gradient bucket: a contiguous run of parameter leaves
+    in reverse-topological order, single dtype, concatenated flat."""
+
+    index: int
+    leaf_indices: Tuple[int, ...]   # positions in the params flatten order
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    dtype: Any
+    pad: int                        # zeros appended so shard_ways divides
+
+    @property
+    def size(self) -> int:
+        return sum(self.sizes)
+
+    @property
+    def padded_size(self) -> int:
+        return self.size + self.pad
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * jnp.dtype(self.dtype).itemsize
+
+
+@dataclass(frozen=True)
+class BucketLayout:
+    """Static bucket assignment for a parameter pytree.  Pure data —
+    everything here is derivable from shapes/dtypes, so every rank
+    computes the identical layout (the SPMD analog of the reference's
+    negotiated fusion bins, controller.cc:640-761)."""
+
+    buckets: Tuple[Bucket, ...]
+    treedef: Any
+    num_leaves: int
+    bucket_bytes: int
+    shard_ways: int
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(b.nbytes for b in self.buckets)
+
+
+def build_layout(params, bucket_bytes: int, *,
+                 shard_ways: int = 1) -> BucketLayout:
+    """Assign parameter leaves to size-bounded buckets in
+    reverse-topological order.
+
+    "Reverse-topological" is approximated by the reverse of the pytree
+    flatten order: frameworks register layers input→output, so reversed
+    leaves are produced-first in the backward pass — the same heuristic
+    PyTorch DDP buckets by (reversed ``model.parameters()``).  A bucket
+    closes when adding the next leaf would exceed ``bucket_bytes`` or
+    change dtype (flat buffers cannot mix dtypes without a cast); a
+    single leaf larger than the cap gets its own bucket — like the
+    reference's fusion bins, one tensor is never split across buckets.
+
+    ``shard_ways`` > 1 (the ZeRO path) pads each bucket with zeros to a
+    multiple of the shard count so tiled scatter/gather divide evenly.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise ValueError("cannot build a bucket layout over an empty pytree")
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    shapes, dtypes = [], []
+    for i, leaf in enumerate(leaves):
+        dt = jnp.result_type(leaf)
+        if not jnp.issubdtype(dt, jnp.inexact):
+            raise ValueError(
+                f"parameter leaf {i} has non-float dtype {dt}; the overlap "
+                f"plane differentiates the loss w.r.t. every leaf, so "
+                f"params must be all-float (move counters/ints out of the "
+                f"params pytree)"
+            )
+        shapes.append(tuple(jnp.shape(leaf)))
+        dtypes.append(dt)
+
+    buckets: List[Bucket] = []
+    run: List[int] = []
+    run_bytes = 0
+
+    def close(run: List[int]) -> None:
+        if not run:
+            return
+        sizes = tuple(int(np.prod(shapes[i], dtype=np.int64)) if shapes[i]
+                      else 1 for i in run)
+        total = sum(sizes)
+        pad = (-total) % shard_ways
+        buckets.append(Bucket(
+            index=len(buckets),
+            leaf_indices=tuple(run),
+            shapes=tuple(shapes[i] for i in run),
+            sizes=sizes,
+            dtype=dtypes[run[0]],
+            pad=pad,
+        ))
+
+    for i in reversed(range(len(leaves))):
+        nbytes = (int(np.prod(shapes[i], dtype=np.int64)) if shapes[i]
+                  else 1) * jnp.dtype(dtypes[i]).itemsize
+        if run and (dtypes[i] != dtypes[run[0]]
+                    or run_bytes + nbytes > bucket_bytes):
+            close(run)
+            run, run_bytes = [], 0
+        run.append(i)
+        run_bytes += nbytes
+    close(run)
+    return BucketLayout(
+        buckets=tuple(buckets),
+        treedef=treedef,
+        num_leaves=len(leaves),
+        bucket_bytes=int(bucket_bytes),
+        shard_ways=int(shard_ways),
+    )
+
+
+def _bucket_concat(pieces: Sequence, bucket: Bucket):
+    """Ravel+concat a bucket's leaves (bucket order), zero-padded."""
+    flat = (jnp.ravel(pieces[0]) if len(pieces) == 1
+            else jnp.concatenate([jnp.ravel(p) for p in pieces]))
+    if bucket.pad:
+        flat = jnp.pad(flat, (0, bucket.pad))
+    return flat
+
+
+def _bucket_split(flat, bucket: Bucket) -> List:
+    """Inverse of :func:`_bucket_concat`: strip pad, slice, reshape."""
+    out, off = [], 0
+    for shape, size in zip(bucket.shapes, bucket.sizes):
+        out.append(lax.dynamic_slice_in_dim(flat, off, size).reshape(shape))
+        off += size
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reduction schedules (flat and two-fabric)
+# ---------------------------------------------------------------------------
+
+
+def _reduce_flat(flat, op, axis_name, hierarchical_axes, dcn_compression):
+    """One bucket's full reduce: flat psum, or the 3-phase two-fabric
+    schedule (scatter ICI → exchange DCN → gather ICI) when a
+    hierarchical mesh is given."""
+    if hierarchical_axes is not None:
+        from ..parallel.hierarchical import (  # noqa: PLC0415
+            hierarchical_allreduce,
+        )
+
+        local_ax, cross_ax = hierarchical_axes
+        return hierarchical_allreduce(
+            flat, op, local_axis=local_ax, cross_axis=cross_ax,
+            compression=dcn_compression,
+        )
+    y = lax.psum(flat, axis_name)
+    if op == Average:
+        y = y / axis_size(axis_name)
+    return y
+
+
+def _scatter_flat(flat, op, axis_name, hierarchical_axes, dcn_compression):
+    """One bucket's reduce-scatter: this rank's 1/shard_ways chunk of
+    the fully-reduced buffer.  Bitwise-equal to slicing
+    :func:`_reduce_flat`'s result (the ZeRO equivalence argument)."""
+    if hierarchical_axes is not None:
+        from ..parallel.hierarchical import (  # noqa: PLC0415
+            hierarchical_reduce_scatter,
+        )
+
+        local_ax, cross_ax = hierarchical_axes
+        return hierarchical_reduce_scatter(
+            flat, op, local_axis=local_ax, cross_axis=cross_ax,
+            compression=dcn_compression,
+        )
+    shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                             tiled=True)
+    if op == Average:
+        shard = shard / axis_size(axis_name)
+    return shard
+
+
+def _gather_flat(shard, axis_name, hierarchical_axes):
+    if hierarchical_axes is not None:
+        from ..parallel.hierarchical import (  # noqa: PLC0415
+            hierarchical_all_gather,
+        )
+
+        local_ax, cross_ax = hierarchical_axes
+        return hierarchical_all_gather(
+            shard, local_axis=local_ax, cross_axis=cross_ax
+        )
+    return all_gather_flat(shard, axis_name=axis_name)
+
+
+# ---------------------------------------------------------------------------
+# in-backward bucketed sync (mode "bucket")
+# ---------------------------------------------------------------------------
+
+
+def _make_bucket_tap(bucket: Bucket, reduce_fn):
+    """Identity on the bucket's leaves whose VJP reduces the fused
+    cotangent buffer.  Reverse-mode AD runs this rule once, at the point
+    in the cotangent graph where the *last* of the bucket's gradients
+    has been produced — which is exactly where the reference's hook
+    fires ``allreduce_async_`` — so the scheduler sees the collective
+    with the remaining backward compute still ahead of it."""
+
+    @jax.custom_vjp
+    def tap(*xs):
+        return xs
+
+    def fwd(*xs):
+        return xs, None
+
+    def bwd(_, cts):
+        flat = _bucket_concat(cts, bucket)
+        red = reduce_fn(flat)
+        return tuple(_bucket_split(red, bucket))
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+def _tap_params(params, layout: BucketLayout, reduce_fn):
+    """Thread every parameter leaf through its bucket's tap."""
+    leaves = jax.tree_util.tree_flatten(params)[0]
+    out = list(leaves)
+    for b in layout.buckets:
+        tapped = _make_bucket_tap(b, reduce_fn)(
+            *[leaves[i] for i in b.leaf_indices]
+        )
+        for i, t in zip(b.leaf_indices, tapped):
+            out[i] = t
+    return jax.tree_util.tree_unflatten(layout.treedef, out)
+
+
+def sync_gradients(
+    loss_fn: Callable,
+    params,
+    *args,
+    op: ReduceOp = Average,
+    axis_name: str = DP_AXIS,
+    bucket_mb: Optional[float] = None,
+    layout: Optional[BucketLayout] = None,
+    has_aux: bool = False,
+    hierarchical_axes: Optional[tuple] = None,
+    dcn_compression=None,
+):
+    """``value_and_grad(loss_fn)(params, *args)`` with in-backward
+    bucketed gradient sync — call inside ``shard_map`` over
+    ``axis_name`` (or the two-fabric mesh).  Returns ``(loss, grads)``
+    (``((loss, aux), grads)`` with ``has_aux``) where ``grads`` is
+    already globally reduced, one fused collective per bucket emitted
+    inside the backward graph.
+
+    ``bucket_mb`` caps each bucket (default: ``--grad-bucket-mb`` /
+    HVDTPU_GRAD_BUCKET_MB / 16 MB); pass a prebuilt ``layout`` to skip
+    re-planning (and to share one layout with an :class:`OverlapPlan`).
+    """
+    if op not in (Average, Sum):
+        raise ValueError(f"sync_gradients supports Average/Sum, got {op!r}")
+    if layout is None:
+        from ..runtime.autotune import (  # noqa: PLC0415
+            resolve_grad_bucket_bytes,
+        )
+
+        layout = build_layout(params, resolve_grad_bucket_bytes(bucket_mb))
+
+    def reduce_fn(flat):
+        return _reduce_flat(flat, op, axis_name, hierarchical_axes,
+                            dcn_compression)
+
+    def tapped_loss(p, *a):
+        return loss_fn(_tap_params(p, layout, reduce_fn), *a)
+
+    return jax.value_and_grad(tapped_loss, has_aux=has_aux)(params, *args)
+
+
+# ---------------------------------------------------------------------------
+# the full step builder
+# ---------------------------------------------------------------------------
+
+
+class OverlapPlan:
+    """One planned configuration of the overlap plane for a given
+    parameter pytree: bucket layout + mode + reduce schedule + optax
+    transform.  Build once per model, then wrap :meth:`local_step` in
+    ``shard_map``/``jit`` with :meth:`state_spec` (donating the state —
+    see :func:`audit_donation`).
+
+    State layout by mode (``state = (model, opt_state)``):
+
+    * ``off`` / ``bucket`` — ``model`` is the replicated params pytree,
+      ``opt_state = tx.init(params)``; spec ``P()``.
+    * ``bucket+zero1`` — ``model`` is the list of flat per-bucket
+      parameter buffers, globally sharded over the data axis (each
+      device holds 1/world), and ``opt_state = tx.init(<own shard>)``;
+      spec from :meth:`state_spec`.  :meth:`materialize` reassembles
+      the params pytree outside the step.
+    """
+
+    def __init__(
+        self,
+        params,
+        tx: optax.GradientTransformation,
+        *,
+        mode: str = "bucket",
+        op: ReduceOp = Average,
+        axis_name: str = DP_AXIS,
+        bucket_mb: Optional[float] = None,
+        hierarchical_axes: Optional[tuple] = None,
+        dcn_compression=None,
+        mesh=None,
+        publish_metrics: bool = True,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if op not in (Average, Sum):
+            raise ValueError(f"OverlapPlan supports Average/Sum, got {op!r}")
+        if hierarchical_axes is not None and len(hierarchical_axes) != 2:
+            raise ValueError(
+                "hierarchical_axes must be (local_axis, cross_axis), got "
+                f"{hierarchical_axes!r}"
+            )
+        self.mode = mode
+        self.op = op
+        self.tx = tx
+        self.axis_name = axis_name
+        self.hierarchical_axes = (tuple(hierarchical_axes)
+                                  if hierarchical_axes else None)
+        self.dcn_compression = dcn_compression
+        self._mesh = mesh
+
+        from ..runtime.autotune import (  # noqa: PLC0415
+            resolve_grad_bucket_bytes,
+        )
+
+        bucket_bytes = resolve_grad_bucket_bytes(bucket_mb)
+        shard_ways = self._shard_ways() if mode == "bucket+zero1" else 1
+        self.layout = build_layout(params, bucket_bytes,
+                                   shard_ways=shard_ways)
+        if publish_metrics:
+            self._publish_metrics()
+
+    # ------------------------------------------------------------ topology
+
+    def _shard_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the ZeRO shards split dim 0 over, scatter-major:
+        the local (ICI) axis varies slowest — matching
+        hierarchical_reduce_scatter's local-then-cross slicing."""
+        if self.hierarchical_axes is not None:
+            local_ax, cross_ax = self.hierarchical_axes
+            return (local_ax, cross_ax)
+        return (self.axis_name,)
+
+    def _require_mesh(self):
+        if self._mesh is None:
+            from ..basics import mesh as build_mesh  # noqa: PLC0415
+
+            self._mesh = build_mesh("flat")
+        return self._mesh
+
+    def _shard_ways(self) -> int:
+        mesh = self._require_mesh()
+        ways = 1
+        for ax in self._shard_axes():
+            ways *= mesh.shape[ax]
+        return ways
+
+    # ------------------------------------------------------------- metrics
+
+    def _publish_metrics(self) -> None:
+        try:
+            from ..obs import get_registry  # noqa: PLC0415
+
+            reg = get_registry()
+            reg.gauge("overlap.mode").set(_MODE_IDS[self.mode])
+            reg.gauge("overlap.buckets").set(len(self.layout.buckets))
+            reg.gauge("overlap.grad_bucket_mb").set(
+                self.layout.bucket_bytes / 1048576
+            )
+            reg.gauge("overlap.total_grad_bytes").set(
+                self.layout.total_bytes
+            )
+            for b in self.layout.buckets:
+                reg.gauge("overlap.bucket_bytes",
+                          bucket=str(b.index)).set(b.nbytes)
+        except Exception:
+            # Metrics are observability, not correctness: a plan built in
+            # a stripped environment (no obs plane) must still train.
+            pass
+
+    # -------------------------------------------------------------- state
+
+    def init(self, params):
+        """Initial ``(model, opt_state)`` for :meth:`local_step`.
+        Call with concrete (host) params, outside jit.  The state holds
+        COPIES of the caller's leaves: the step is meant to be jitted
+        with the state donated, and donating aliased buffers would
+        delete the caller's params out from under a later re-init
+        (same hazard class as ckpt's copy-on-flatten)."""
+        if self.mode != "bucket+zero1":
+            params = jax.tree_util.tree_map(jnp.array, params)
+            return (params, self.tx.init(params))
+        leaves = jax.tree_util.tree_flatten(params)[0]
+        buffers = [
+            _bucket_concat([leaves[i] for i in b.leaf_indices], b)
+            for b in self.layout.buckets
+        ]
+        opt_state = self._init_sharded_opt(buffers)
+        return (buffers, opt_state)
+
+    def _shard_structs(self) -> List[jax.ShapeDtypeStruct]:
+        ways = self.layout.shard_ways
+        return [
+            jax.ShapeDtypeStruct((b.padded_size // ways,), b.dtype)
+            for b in self.layout.buckets
+        ]
+
+    def _opt_state_spec(self):
+        """PartitionSpec tree for the sharded optimizer state: shard-
+        shaped leaves split over the shard axes, scalars (step counts)
+        replicated.  Derived from ``eval_shape`` of ``tx.init`` on the
+        shard shapes, so it is correct for any element-wise optimizer,
+        not just the ones we tested."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        shapes = jax.eval_shape(self.tx.init, self._shard_structs())
+        axes = self._shard_axes()
+        return jax.tree_util.tree_map(
+            lambda s: P(axes) if getattr(s, "ndim", 0) >= 1 else P(),
+            shapes,
+        )
+
+    def _init_sharded_opt(self, buffers):
+        """``tx.init`` of each rank's own shard, assembled into the
+        globally-sharded state — run through a one-time shard_map so the
+        per-rank slice is exactly what ``local_step`` will update (any
+        optimizer init, not just zeros-like, lands on the right rank).
+        """
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        mesh = self._require_mesh()
+        axes = self._shard_axes()
+        init = shard_map_compat(
+            lambda bufs: self.tx.init(list(bufs)),
+            mesh=mesh,
+            in_specs=(tuple(P(axes) for _ in buffers),),
+            out_specs=self._opt_state_spec(),
+        )
+        return jax.jit(init)(tuple(buffers))
+
+    def state_spec(self):
+        """PartitionSpec pytree for the ``(model, opt_state)`` state —
+        hand it to shard_map's in/out specs for the state argument."""
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        if self.mode != "bucket+zero1":
+            return P()
+        axes = self._shard_axes()
+        return ([P(axes) for _ in self.layout.buckets],
+                self._opt_state_spec())
+
+    def materialize(self, state):
+        """Full params pytree from a step state (host-side; no
+        collectives — ZeRO buffers are globally addressable arrays)."""
+        model, _ = state
+        if self.mode != "bucket+zero1":
+            return model
+        leaves: List[Any] = [None] * self.layout.num_leaves
+        for b, buf in zip(self.layout.buckets, model):
+            for i, piece in zip(b.leaf_indices,
+                                _bucket_split(jnp.asarray(buf), b)):
+                leaves[i] = piece
+        return jax.tree_util.tree_unflatten(self.layout.treedef, leaves)
+
+    def rebucket(self, state, new_plan: "OverlapPlan"):
+        """Carry a ZeRO state across an N→M bucket-layout change
+        (re-tuned ``--grad-bucket-mb``, elastic world resize): params
+        re-shard exactly; optimizer-state leaves are re-grouped by
+        matching each run of per-bucket arrays against the old layout's
+        buffer shapes.  Works for any optax state whose array leaves
+        parallel the bucket list (sgd/momentum/adam/adamw...); anything
+        stranger raises rather than guessing."""
+        if self.mode != "bucket+zero1" or new_plan.mode != "bucket+zero1":
+            raise ValueError("rebucket is only meaningful between "
+                             "bucket+zero1 plans")
+        if new_plan.layout.num_leaves != self.layout.num_leaves:
+            raise ValueError("rebucket requires the same parameter tree")
+        _, opt_state = state
+        # Re-shard the params directly (what _regroup does for state
+        # fields): going through new_plan.init would also build — and
+        # immediately discard — a full sharded optimizer state.
+        leaves = jax.tree_util.tree_flatten(self.materialize(state))[0]
+        new_buffers = [
+            _bucket_concat([leaves[i] for i in b.leaf_indices], b)
+            for b in new_plan.layout.buckets
+        ]
+
+        old_shapes = [((b.padded_size,), jnp.dtype(b.dtype))
+                      for b in self.layout.buckets]
+        n_old = len(self.layout.buckets)
+        # The state's treedef changes with the bucket count (its inner
+        # lists are per-bucket); the new structure is what tx.init on
+        # the NEW layout's shards would produce.
+        new_treedef = jax.tree_util.tree_structure(
+            jax.eval_shape(new_plan.tx.init, new_plan._shard_structs())
+        )
+        leaves, _ = jax.tree_util.tree_flatten(opt_state)
+        out: List[Any] = []
+        i = 0
+        while i < len(leaves):
+            leaf = leaves[i]
+            if getattr(leaf, "ndim", 0) == 0:
+                out.append(leaf)
+                i += 1
+                continue
+            run = leaves[i:i + n_old]
+            if [(jnp.shape(l), jnp.result_type(l)) for l in run] \
+                    != old_shapes:
+                raise ValueError(
+                    "optimizer state does not parallel the bucket list; "
+                    "re-initialize it for the new layout instead"
+                )
+            out.extend(self._regroup(run, new_plan))
+            i += n_old
+        return (new_buffers,
+                jax.tree_util.tree_unflatten(new_treedef, out))
+
+    def _regroup(self, per_bucket: Sequence, new_plan: "OverlapPlan"):
+        """Reassemble one state field from old buckets, split per new."""
+        leaves: List[Any] = [None] * self.layout.num_leaves
+        for b, buf in zip(self.layout.buckets, per_bucket):
+            for i, piece in zip(b.leaf_indices,
+                                _bucket_split(jnp.asarray(buf), b)):
+                leaves[i] = piece
+        return [
+            _bucket_concat([leaves[i] for i in b.leaf_indices], b)
+            for b in new_plan.layout.buckets
+        ]
+
+    # ---------------------------------------------------------------- step
+
+    def local_step(self, loss_fn: Callable, *, has_aux: bool = False):
+        """The per-device train-step body: ``fn(state, *batch) ->
+        (state, loss[, aux])`` where ``loss_fn(params, *batch)`` returns
+        the local scalar loss (or ``(loss, aux)``).  Wrap the result in
+        shard_map over the plan's mesh/axes and jit it with the state
+        donated."""
+        if self.mode == "bucket+zero1":
+            return self._zero1_step(loss_fn, has_aux)
+        return self._replicated_step(loss_fn, has_aux)
+
+    def _grads_off(self, loss_fn, params, args, has_aux):
+        """End-of-backward fused reduce (the status quo this plane is
+        measured against): full value_and_grad, then one concat psum per
+        dtype — the single giant exchange XLA cannot start until the
+        whole backward has finished."""
+        val, grads = jax.value_and_grad(loss_fn, has_aux=has_aux)(
+            params, *args
+        )
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        by_dtype: dict = {}
+        for i, leaf in enumerate(leaves):
+            by_dtype.setdefault(jnp.result_type(leaf), []).append(i)
+        out = list(leaves)
+        for idxs in by_dtype.values():
+            flat = (jnp.ravel(leaves[idxs[0]]) if len(idxs) == 1
+                    else jnp.concatenate(
+                        [jnp.ravel(leaves[i]) for i in idxs]))
+            red = _reduce_flat(flat, self.op, self.axis_name,
+                               self.hierarchical_axes,
+                               self.dcn_compression)
+            off = 0
+            for i in idxs:
+                n = leaves[i].size
+                out[i] = lax.dynamic_slice_in_dim(red, off, n).reshape(
+                    jnp.shape(leaves[i])
+                )
+                off += n
+        return val, jax.tree_util.tree_unflatten(treedef, out)
+
+    def _replicated_step(self, loss_fn, has_aux):
+        def step(state, *args):
+            params, opt_state = state
+            if self.mode == "bucket":
+                val, grads = sync_gradients(
+                    loss_fn, params, *args,
+                    op=self.op, axis_name=self.axis_name,
+                    layout=self.layout, has_aux=has_aux,
+                    hierarchical_axes=self.hierarchical_axes,
+                    dcn_compression=self.dcn_compression,
+                )
+            else:
+                val, grads = self._grads_off(loss_fn, params, args,
+                                             has_aux)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            loss, aux = (val if has_aux else (val, None))
+            out = ((params, opt_state), loss)
+            return out + ((aux,) if has_aux else ())
+
+        return step
+
+    def _zero1_step(self, loss_fn, has_aux):
+        hier = self.hierarchical_axes
+
+        def gather_with_scatter_vjp(shard):
+            """Forward: reassemble the bucket's full flat buffer from
+            the shards.  VJP: the bucket's gradient reduce-scatter —
+            emitted inside the backward at this bucket's position, with
+            the cross-slice leg on DCN when the mesh is two-fabric."""
+
+            @jax.custom_vjp
+            def gather(s):
+                return _gather_flat(s, self.axis_name, hier)
+
+            def fwd(s):
+                return gather(s), None
+
+            def bwd(_, g):
+                return (_scatter_flat(g, self.op, self.axis_name, hier,
+                                      self.dcn_compression),)
+
+            gather.defvjp(fwd, bwd)
+            return gather(shard)
+
+        def shard_loss(shards, *args):
+            leaves: List[Any] = [None] * self.layout.num_leaves
+            for b, s in zip(self.layout.buckets, shards):
+                full = gather_with_scatter_vjp(s)
+                for i, piece in zip(b.leaf_indices, _bucket_split(full, b)):
+                    leaves[i] = piece
+            params = jax.tree_util.tree_unflatten(self.layout.treedef,
+                                                  leaves)
+            return loss_fn(params, *args)
+
+        def step(state, *args):
+            shards, opt_state = state
+            shards = list(shards)
+            val, gshards = jax.value_and_grad(
+                shard_loss, has_aux=has_aux
+            )(shards, *args)
+            updates, opt_state = self.tx.update(gshards, opt_state, shards)
+            shards = optax.apply_updates(shards, updates)
+            loss, aux = (val if has_aux else (val, None))
+            out = ((shards, opt_state), loss)
+            return out + ((aux,) if has_aux else ())
+
+        return step
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO schedule inspector
+# ---------------------------------------------------------------------------
+
+# Reduce-class collectives carry gradients; gathers are the ZeRO forward
+# leg (or parameter broadcast) and don't prove backward overlap.
+_REDUCE_OPS = ("all-reduce-start", "all-reduce", "reduce-scatter")
+_GATHER_OPS = ("all-gather-start", "all-gather")
+_COMPUTE_OPS = ("fusion", "dot", "convolution", "custom-call")
+
+_OP_RE = re.compile(
+    r"=\s+(?:\()?(\w+)\[([0-9,]*)\][^=]*?\s"
+    r"(all-reduce-start|all-reduce|reduce-scatter|all-gather-start|"
+    r"all-gather|fusion|dot|convolution|custom-call)\("
+)
+
+
+@dataclass
+class ScheduleReport:
+    """What the scheduled module actually does with the gradient
+    collectives.  ``in_backward`` counts reduce-class collectives that
+    appear strictly before the last compute op preceding the *final*
+    gradient collective — i.e. collectives with backward work scheduled
+    after them to hide behind.  A monolithic end-of-backward reduce
+    scores 0 there by construction."""
+
+    collectives: List[dict]
+    gradient_collectives: int
+    gather_collectives: int
+    compute_ops: int
+    in_backward: int
+    monolithic: bool
+
+    def as_dict(self) -> dict:
+        return {
+            "gradient_collectives": self.gradient_collectives,
+            "gather_collectives": self.gather_collectives,
+            "compute_ops": self.compute_ops,
+            "in_backward": self.in_backward,
+            "monolithic": self.monolithic,
+        }
+
+
+def _entry_lines(text: str) -> List[str]:
+    """The entry computation's instruction lines, in schedule order
+    (compiled modules print ``is_scheduled=true``; instruction order IS
+    the sequence the backend executes)."""
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if line.startswith("ENTRY "):
+            body = []
+            for l in lines[i + 1:]:
+                if l.startswith("}"):
+                    break
+                body.append(l)
+            return body
+    return lines
+
+
+def inspect_schedule(compiled_or_text, *,
+                     min_elements: int = 2) -> ScheduleReport:
+    """Parse a compiled step's HLO and report where its gradient
+    collectives sit relative to backward compute.
+
+    Accepts a compiled executable (``fn.lower(...).compile()``), a
+    lowered object, or the ``as_text()`` string.  ``min_elements``
+    filters scalar control collectives (loss pmean, epoch-check lanes)
+    out of the gradient count.
+    """
+    if hasattr(compiled_or_text, "compile"):
+        compiled_or_text = compiled_or_text.compile()
+    text = (compiled_or_text if isinstance(compiled_or_text, str)
+            else compiled_or_text.as_text())
+
+    ops: List[Tuple[str, int]] = []  # (category, elements)
+    collectives: List[dict] = []
+    for line in _entry_lines(text):
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        dtype, dims, opcode = m.groups()
+        elements = int(np.prod([int(d) for d in dims.split(",") if d],
+                               dtype=np.int64)) if dims else 1
+        if opcode in _REDUCE_OPS and elements >= min_elements:
+            cat = "reduce"
+        elif opcode in _GATHER_OPS and elements >= min_elements:
+            cat = "gather"
+        elif opcode in _COMPUTE_OPS:
+            cat = "compute"
+        else:
+            cat = "other"
+        ops.append((cat, elements))
+        if cat in ("reduce", "gather"):
+            collectives.append({
+                "index": len(ops) - 1,
+                "opcode": opcode,
+                "dtype": dtype,
+                "elements": elements,
+            })
+
+    reduce_idx = [i for i, (c, _) in enumerate(ops) if c == "reduce"]
+    compute_idx = [i for i, (c, _) in enumerate(ops) if c == "compute"]
+    in_backward = 0
+    if reduce_idx and compute_idx:
+        last_reduce = reduce_idx[-1]
+        pre = [i for i in compute_idx if i < last_reduce]
+        if pre:
+            anchor = pre[-1]
+            in_backward = sum(1 for i in reduce_idx if i < anchor)
+    return ScheduleReport(
+        collectives=collectives,
+        gradient_collectives=len(reduce_idx),
+        gather_collectives=sum(1 for c, _ in ops if c == "gather"),
+        compute_ops=len(compute_idx),
+        in_backward=in_backward,
+        monolithic=in_backward == 0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+
+def donated_params(compiled_or_text) -> set:
+    """Flattened parameter indices the compiled module aliases to
+    outputs (``input_output_alias``) — the buffers XLA will actually
+    reuse in place.  Donation silently degrades to a copy when shapes/
+    layouts mismatch, so tests assert on THIS, not on having passed
+    ``donate_argnums``."""
+    if hasattr(compiled_or_text, "compile"):
+        compiled_or_text = compiled_or_text.compile()
+    text = (compiled_or_text if isinstance(compiled_or_text, str)
+            else compiled_or_text.as_text())
+    start = text.find("input_output_alias={")
+    if start == -1:
+        return set()
+    i = start + len("input_output_alias=")
+    depth = 0
+    for j in range(i, len(text)):
+        if text[j] == "{":
+            depth += 1
+        elif text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                inner = text[i + 1:j]
+                return {int(m) for m in
+                        re.findall(r"\(\s*(\d+)\s*,", inner)}
+    return set()
+
+
+def audit_donation(compiled_or_text, n_state_leaves: int) -> dict:
+    """Donation report for a compiled train step: did at least the
+    state's leaves get aliased end-to-end?  Returns
+    ``{"donated": int, "expected": int, "ok": bool}``."""
+    donated = donated_params(compiled_or_text)
+    return {
+        "donated": len(donated),
+        "expected": int(n_state_leaves),
+        "ok": len(donated) >= int(n_state_leaves),
+    }

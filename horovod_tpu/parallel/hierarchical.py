@@ -26,7 +26,12 @@ from jax import lax
 from ..basics import CROSS_AXIS, LOCAL_AXIS
 from ..ops.collectives import Average, ReduceOp, Sum, axis_size
 
-__all__ = ["hierarchical_allreduce", "hierarchical_adasum"]
+__all__ = [
+    "hierarchical_allreduce",
+    "hierarchical_adasum",
+    "hierarchical_reduce_scatter",
+    "hierarchical_all_gather",
+]
 
 
 def _resolve_compressor(compression):
@@ -54,6 +59,59 @@ def _resolve_compressor(compression):
             )
         return comp
     return compression
+
+
+def hierarchical_reduce_scatter(
+    flat,
+    op: ReduceOp = Sum,
+    *,
+    local_axis: str = LOCAL_AXIS,
+    cross_axis: str = CROSS_AXIS,
+    compression=None,
+):
+    """Reduce a 1-D buffer over BOTH fabrics, keep this rank's
+    1/(local*cross) shard: psum_scatter on ICI, then psum_scatter of the
+    slice-partial shard on DCN — so the cross-slice leg moves only
+    1/local_size of the bytes, and on a compressed wire when one is
+    configured.  ``flat.size`` must divide local*cross (pad first).
+
+    This is the scatter half of the ZeRO-1 schedule composed with the
+    two-fabric plane: the element-wise result equals the matching slice
+    of :func:`hierarchical_allreduce` exactly (uncompressed)."""
+    if op not in (Average, Sum):
+        raise ValueError(
+            f"hierarchical_reduce_scatter supports Average/Sum, got {op!r}"
+        )
+    comp = _resolve_compressor(compression)
+    x = jnp.asarray(flat)
+    shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
+    if comp is not None:
+        wire, ctx = comp.compress(shard)
+        shard = comp.decompress(
+            lax.psum_scatter(wire, cross_axis, scatter_dimension=0,
+                             tiled=True),
+            ctx,
+        )
+    else:
+        shard = lax.psum_scatter(shard, cross_axis, scatter_dimension=0,
+                                 tiled=True)
+    if op == Average:
+        shard = shard / (axis_size(local_axis) * axis_size(cross_axis))
+    return shard
+
+
+def hierarchical_all_gather(
+    shard,
+    *,
+    local_axis: str = LOCAL_AXIS,
+    cross_axis: str = CROSS_AXIS,
+):
+    """Inverse of :func:`hierarchical_reduce_scatter`'s slicing: gather
+    the cross-fabric chunks back into the slice-local shard (1/local of
+    the bytes on DCN), then gather the local shards on ICI."""
+    x = jnp.asarray(shard)
+    x = lax.all_gather(x, cross_axis, axis=0, tiled=True)
+    return lax.all_gather(x, local_axis, axis=0, tiled=True)
 
 
 def hierarchical_allreduce(

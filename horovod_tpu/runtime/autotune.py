@@ -50,6 +50,46 @@ from ..utils import env as envmod
 FUSION_BOUNDS_MB = (1.0, 128.0)
 CYCLE_BOUNDS_MS = (1.0, 50.0)
 
+# Gradient-bucket size for the jit path's backward-overlap plane
+# (optim/overlap.py) — the in-backward analog of fusion_mb.  It is a
+# tuning CATEGORY, not a live GP dimension: the bucket boundaries are
+# baked into the compiled XLA program, so every move costs a full
+# recompile (minutes on TPU) where a fusion_mb move costs one
+# negotiation cycle.  The candidate chain below is the offline sweep
+# (bench.py --grad-bucket-mb) a deployment walks once per model shape;
+# too small → per-collective launch latency dominates, too large → the
+# last bucket's wire time has no backward compute left to hide behind
+# (docs/performance.md "overlap").
+GRAD_BUCKET_BOUNDS_MB = (2.0, 64.0)
+DEFAULT_GRAD_BUCKET_MB = envmod.DEFAULT_GRAD_BUCKET_MB
+
+
+def grad_bucket_candidates() -> List[float]:
+    """The geometric bucket-size chain (MB) an offline sweep explores —
+    one octave apart inside GRAD_BUCKET_BOUNDS_MB, like the categorical
+    chains build_categories() emits for the engine knobs."""
+    out, mb = [], GRAD_BUCKET_BOUNDS_MB[0]
+    while mb <= GRAD_BUCKET_BOUNDS_MB[1]:
+        out.append(mb)
+        mb *= 2
+    return out
+
+
+def resolve_grad_bucket_bytes(cli_mb: Optional[float] = None) -> int:
+    """The ONE resolution path for the bucket-size knob (CLI flag over
+    HVDTPU_GRAD_BUCKET_MB over the 16 MB default), shared by bench.py
+    and optim/overlap.py so the two can never disagree about what a
+    given run used."""
+    mb = (
+        float(cli_mb)
+        if cli_mb is not None
+        else envmod.env_float(envmod.GRAD_BUCKET_MB,
+                              DEFAULT_GRAD_BUCKET_MB)
+    )
+    if mb <= 0:
+        raise ValueError(f"grad bucket size must be positive, got {mb} MB")
+    return int(mb * 1024 * 1024)
+
 def build_categories(
     *,
     multislice: bool = False,

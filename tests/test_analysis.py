@@ -180,6 +180,24 @@ FIXTURES = {
             hvd.barrier()
         """,
     ),
+    "HVD009": (
+        """
+        import jax
+
+        def local_step(params, opt_state, batch):
+            return params, opt_state
+
+        step = jax.jit(local_step)
+        """,
+        """
+        import jax
+
+        def local_step(params, opt_state, batch):
+            return params, opt_state
+
+        step = jax.jit(local_step, donate_argnums=(0, 1))
+        """,
+    ),
     "HVDC101": (
         """
         import threading
@@ -489,6 +507,78 @@ def test_hvd005_function_scope_ok(tmp_path):
             return hvd.rank()
     """)
     assert not _new(findings, "HVD005")
+
+
+def test_hvd009_resolves_through_shard_map_wrapper(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def local_step(params, opt_state, xb):
+            return params, opt_state
+
+        step = jax.jit(shard_map(local_step, mesh=None,
+                                 in_specs=(), out_specs=()))
+    """)
+    assert _new(findings, "HVD009")
+
+
+def test_hvd009_quiet_on_stateless_apply_and_donate_argnames(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import jax
+
+        apply = jax.jit(lambda p, xb: p @ xb)
+
+        def local_step(params, opt_state, xb):
+            return params, opt_state
+
+        step = jax.jit(local_step, donate_argnames=("params",))
+    """)
+    assert not _new(findings, "HVD009")
+
+
+def test_hvd009_resolution_is_scope_first(tmp_path):
+    # Two builders bind the same name to different callables: each jit
+    # call must be judged against ITS OWN function's binding — the
+    # stateless apply stays quiet, the train step fires.
+    findings = _lint_source(tmp_path, """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def build_eval():
+            step = shard_map(lambda p_, xb: p_, mesh=None,
+                             in_specs=(), out_specs=())
+            return jax.jit(step)
+
+        def build_train():
+            def local(params, opt_state, xb):
+                return params, opt_state
+            step = shard_map(local, mesh=None, in_specs=(), out_specs=())
+            return jax.jit(step)
+    """)
+    hits = _new(findings, "HVD009")
+    assert len(hits) == 1, [f.message for f in hits]
+    assert "params" in hits[0].message
+
+
+def test_hvd009_name_does_not_resolve_to_same_named_method(tmp_path):
+    # Regression: `init = shard_map(lambda bufs: ..., ...)` then
+    # jax.jit(init) must not resolve `init` to an unrelated class's
+    # `init(self, params)` method and convict the lambda.
+    findings = _lint_source(tmp_path, """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        class Plan:
+            def init(self, params):
+                return params
+
+        def build():
+            init = shard_map(lambda bufs: bufs, mesh=None,
+                             in_specs=(), out_specs=())
+            return jax.jit(init)
+    """)
+    assert not _new(findings, "HVD009")
 
 
 def test_hvdc105_stored_exception_ok(tmp_path):
