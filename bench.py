@@ -635,6 +635,142 @@ def _arm_watchdog(args) -> None:
     threading.Thread(target=_fire, daemon=True).start()
 
 
+def _serve_bench(args) -> int:
+    """``--serve``: open-loop serving benchmark through the
+    continuous-batching plane (horovod_tpu/serve/).
+
+    A deterministic Poisson arrival process (seeded exponential gaps at
+    ``--serve-rate`` req/s) submits ``--serve-requests`` mixed-length
+    prompts AT SCHEDULE — open-loop, so queueing under load is measured
+    instead of hidden by back-pressure — while a fine-grained poller
+    stamps each request's first token and completion on the client
+    clock.  The record lands ttft/tpot percentiles and end-to-end
+    tokens/sec; on CPU it is a degraded trajectory placeholder like
+    every other CPU bench number (write_degraded_record via
+    _auto_record)."""
+    import threading
+
+    from horovod_tpu.serve import ServeJob
+
+    _touch_progress(next_window=max(args.watchdog_secs, 300),
+                    phase="serve")
+    on_cpu = args.cpu or jax.devices()[0].platform == "cpu"
+    overrides = dict(
+        num_layers=2, num_heads=4, emb_dim=64, max_len=256,
+        vocab_size=512, attention_impl="reference", dtype=jnp.float32,
+    )
+    spec = {"size": "nano", "overrides": overrides, "seed": 0,
+            "num_slots": args.serve_slots, "idle_secs": 0.005}
+    n_req = args.serve_requests
+    rng = np.random.RandomState(42)
+    gaps = rng.exponential(1.0 / args.serve_rate, n_req)
+    prompts = [rng.randint(0, 512, rng.randint(4, 13)).tolist()
+               for _ in range(n_req)]
+    budgets = [int(rng.randint(4, 13)) for _ in range(n_req)]
+
+    job = ServeJob(
+        spec, np=args.serve_np,
+        env={"JAX_PLATFORMS": "cpu"} if on_cpu else None,
+        timeout=max(_budget_left(args) - 60, 120),
+    ).start()
+    submit_t: dict = {}
+    rids: list = []
+
+    def _submitter():
+        t = time.perf_counter()
+        for i in range(n_req):
+            t += gaps[i]
+            now = time.perf_counter()
+            if t > now:
+                time.sleep(t - now)
+            rid = job.client.submit(prompts[i],
+                                    max_new_tokens=budgets[i])
+            submit_t[rid] = time.perf_counter()
+            rids.append(rid)
+
+    try:
+        sub = threading.Thread(target=_submitter, daemon=True)
+        t_start = time.perf_counter()
+        sub.start()
+        first_t: dict = {}
+        done: dict = {}
+        deadline = time.monotonic() + max(_budget_left(args) - 90, 90)
+        while len(done) < n_req:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"serve bench: {len(done)}/{n_req} requests "
+                    f"finished before the budget ran out"
+                )
+            for rid in list(rids):
+                if rid in done:
+                    continue
+                doc = job.client.poll(rid)
+                if doc is None:
+                    continue
+                if doc.get("tokens") and rid not in first_t:
+                    first_t[rid] = time.perf_counter()
+                if doc.get("done"):
+                    done[rid] = (time.perf_counter(),
+                                 len(doc.get("tokens", [])))
+            time.sleep(0.003)
+        sub.join(timeout=10)
+        t_end = max(t for t, _ in done.values())
+        total_tokens = sum(n for _, n in done.values())
+        ttft = [
+            (first_t[r] - submit_t[r]) * 1000.0
+            for r in rids if r in first_t
+        ]
+        tpot = [
+            (done[r][0] - first_t[r]) / max(done[r][1] - 1, 1) * 1000.0
+            for r in rids if r in first_t and done[r][1] > 1
+        ]
+        results, _ejob = job.stop()
+    finally:
+        job.shutdown()
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 2) if xs else None
+
+    throughput = total_tokens / max(t_end - t_start, 1e-9)
+    out = {
+        "metric": "serve_nano_tokens_per_sec",
+        "value": round(throughput, 2),
+        "unit": "tokens/sec",
+        "device": jax.devices()[0].device_kind,
+        "serve": {
+            "np": args.serve_np,
+            "slots": args.serve_slots,
+            "requests": n_req,
+            "arrival_rate_per_sec": args.serve_rate,
+            "total_tokens": total_tokens,
+            "ttft_ms": {"p50": pct(ttft, 50), "p90": pct(ttft, 90),
+                        "p99": pct(ttft, 99)},
+            "tpot_ms": {"p50": pct(tpot, 50), "p90": pct(tpot, 90),
+                        "p99": pct(tpot, 99)},
+        },
+    }
+    ranks = sorted(results or {})
+    if ranks:
+        out["serve"]["completed_per_rank"] = {
+            str(r): results[r]["completed"] for r in ranks
+        }
+        # Continuous batching actually happened: admissions that entered
+        # while other slots were mid-decode (max across ranks — the
+        # counts are identical by the schedule invariant).
+        out["serve"]["admitted_while_busy"] = max(
+            results[r].get("admitted_while_busy", 0) for r in ranks
+        )
+    if on_cpu:
+        out["degraded"] = True
+        _auto_record("cpu fallback: numbers not comparable to TPU "
+                     "records", rc=0, phase="serve-cpu-fallback",
+                     parsed=out)
+    attach_regression(out)
+    _watchdog_disarm.set()
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def attach_regression(out: dict, record_dir: str = None,
                       threshold_pct: float = 5.0) -> dict:
     """Regression gate against the driver's ``BENCH_*.json`` records.
@@ -834,6 +970,21 @@ def main() -> int:
                         "(HVDTPU_NUM_SLICES) so the record embeds the "
                         "per-fabric byte counters; 0 = discovered "
                         "topology")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving-plane benchmark: open-loop "
+                             "arrivals through the continuous-batching "
+                             "scheduler; lands ttft/tpot percentiles "
+                             "and tokens/sec instead of a training "
+                             "step time")
+    parser.add_argument("--serve-np", type=int, default=1,
+                        help="serving ranks (elastic fleet size)")
+    parser.add_argument("--serve-slots", type=int, default=4,
+                        help="decode slot pool size per rank")
+    parser.add_argument("--serve-requests", type=int, default=16,
+                        help="requests in the open-loop arrival trace")
+    parser.add_argument("--serve-rate", type=float, default=4.0,
+                        help="mean arrival rate, requests/sec "
+                             "(seeded exponential gaps)")
     parser.add_argument("--attempts", type=int, default=4,
                         help="retries (fresh process) on tunnel UNAVAILABLE")
     parser.add_argument("--watchdog-secs", type=int, default=780,
@@ -859,6 +1010,17 @@ def main() -> int:
     if args.num_slices > 0:
         # Before hvd.init(): the slice partition is resolved there.
         os.environ["HVDTPU_NUM_SLICES"] = str(args.num_slices)
+
+    if args.serve:
+        _arm_watchdog(args)
+        try:
+            return _serve_bench(args)
+        except Exception as exc:
+            # The serve round still lands a record — same dark-
+            # trajectory rule as the training path.
+            _auto_record(f"{type(exc).__name__}: {exc}"[:2000], rc=1,
+                         phase="serve")
+            raise
 
     if args.overlap is None:
         args.overlap = os.environ.get("HVDTPU_OVERLAP", "off")

@@ -10,7 +10,8 @@ from .inception import InceptionV3  # noqa: F401
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
 from .simple import MLP, ConvNet  # noqa: F401
 from .decode import (  # noqa: F401
-    decode_step, generate, init_cache, prefill,
+    assign_slot, decode_step, generate, init_cache, prefill,
+    prefill_scan, reset_slot,
 )
 from .transformer import GPT, GPT_CONFIGS, TransformerConfig, gpt  # noqa: F401
 from .vgg import VGG16, VGG19  # noqa: F401

@@ -187,6 +187,9 @@ class LiveAggregator:
         ckpt = self._ckpt_part(views)
         if ckpt:
             parts.append(ckpt)
+        serve = self._serve_part(views)
+        if serve:
+            parts.append(serve)
         return "live[" + time.strftime("%H:%M:%S") + "] " + " | ".join(parts)
 
     @staticmethod
@@ -295,6 +298,39 @@ class LiveAggregator:
                 token += f" (worst p50 {push_p50:.0f}ms)"
             bits.append(token)
         return "ckpt " + " ".join(bits)
+
+    @staticmethod
+    def _serve_part(views) -> Optional[str]:
+        """One digest token for the serving plane (serve/): queue
+        depth, live slots, throughput and first-token latency — the
+        autoscaling quartet — absent on jobs that never served.  Worst
+        (max) per-rank queue/latency: the digest exists to surface the
+        pressure, not to average it away."""
+        depth = slots = None
+        tps = 0.0
+        ttft = None
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                if name == "serve.queue_depth":
+                    v = float(m["value"])
+                    depth = v if depth is None else max(depth, v)
+                elif name == "serve.active_slots":
+                    v = float(m["value"])
+                    slots = v if slots is None else max(slots, v)
+                elif name == "serve.tokens_per_sec":
+                    tps = max(tps, float(m["value"]))
+                elif name == "serve.ttft_ms" and m.get("count"):
+                    p50 = m.get("p50")
+                    if p50 is not None:
+                        ttft = p50 if ttft is None else max(ttft, p50)
+        if depth is None and slots is None:
+            return None
+        token = (f"serve q={int(depth or 0)} "
+                 f"slots={int(slots or 0)} {tps:.0f} tok/s")
+        if ttft is not None:
+            token += f" ttft p50 {ttft:.0f}ms"
+        return token
 
     # ---------------------------------------------------------- history
 

@@ -222,6 +222,52 @@ def ckpt_section(dumps: Dict[str, dict]) -> Optional[str]:
     return "\n".join(rows)
 
 
+def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job serving-plane report: per-rank admission/eviction
+    traffic, replay count, and the latency distributions the SLO
+    conversation needs (ttft/tpot percentiles, tokens/sec).  None when
+    no rank served — training jobs see no new output."""
+    rows = []
+    for label in sorted(dumps, key=_rank_sort_key):
+        vals = {}
+        hists = {}
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if name in ("serve.admitted", "serve.evicted",
+                        "serve.rejected", "serve.replayed",
+                        "serve.steps", "serve.tokens_per_sec",
+                        "serve.admitted_while_busy"):
+                vals[name] = float(m["value"])
+            elif name in ("serve.ttft_ms", "serve.tpot_ms") \
+                    and m.get("count"):
+                hists[name] = m
+        if not vals and not hists:
+            continue
+        row = (
+            f"rank {label}: admitted {int(vals.get('serve.admitted', 0))}"
+            f" (mid-decode "
+            f"{int(vals.get('serve.admitted_while_busy', 0))})"
+            f", evicted {int(vals.get('serve.evicted', 0))}"
+            f", rejected {int(vals.get('serve.rejected', 0))}"
+        )
+        if vals.get("serve.replayed"):
+            row += f", replayed {int(vals['serve.replayed'])}"
+        if vals.get("serve.steps"):
+            row += f", steps {int(vals['serve.steps'])}"
+        if vals.get("serve.tokens_per_sec"):
+            row += f", {vals['serve.tokens_per_sec']:.1f} tok/s"
+        for name, short in (("serve.ttft_ms", "ttft"),
+                            ("serve.tpot_ms", "tpot")):
+            m = hists.get(name)
+            if m is not None:
+                row += (
+                    f", {short} p50 {m.get('p50') or 0:.3g}ms "
+                    f"p99 {m.get('p99') or 0:.3g}ms"
+                )
+        rows.append(row)
+    return "\n".join(rows) if rows else None
+
+
 def _rank_sort_key(label: str):
     """Rank-label ordering shared by the summary table's columns and
     the ckpt section's rows: numeric ranks first (numerically, with

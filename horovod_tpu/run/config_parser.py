@@ -85,6 +85,10 @@ _ARG_ENV_MAP = {
         "autotune.drift-samples",
     ),
     "log_level": (envmod.LOG_LEVEL, "logging.level"),
+    "serve_model": (envmod.SERVE_MODEL, "serve.model"),
+    "serve_slots": (envmod.SERVE_SLOTS, "serve.slots"),
+    "serve_max_len": (envmod.SERVE_MAX_LEN, "serve.max-len"),
+    "serve_seed": (envmod.SERVE_SEED, "serve.seed"),
 }
 
 

@@ -210,6 +210,42 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "epoch opens (default 50).",
     )
 
+    serve = parser.add_argument_group("serving")
+    serve.add_argument(
+        "--serve", action="store_true", dest="serve",
+        help="Serving mode: implies --elastic, arms the request ingest "
+             "pump on the rendezvous store (clients submit over the "
+             "signed KV protocol, horovod_tpu.serve.ServeClient), and "
+             "defaults the worker command to `python -m "
+             "horovod_tpu.serve` — a continuous-batching inference "
+             "fleet where a dead rank respawns and replays its "
+             "in-flight requests instead of dropping traffic.",
+    )
+    serve.add_argument(
+        "--serve-model", action=_StoreOverrideAction, dest="serve_model",
+        default=None,
+        help="gpt() model family entry every serving rank builds "
+             "(HVDTPU_SERVE_MODEL, default nano).",
+    )
+    serve.add_argument(
+        "--serve-slots", type=int, action=_StoreOverrideAction,
+        dest="serve_slots", default=None,
+        help="Decode slot pool size per rank — the max simultaneous "
+             "in-flight requests (HVDTPU_SERVE_SLOTS, default 4).",
+    )
+    serve.add_argument(
+        "--serve-max-len", type=int, action=_StoreOverrideAction,
+        dest="serve_max_len", default=None,
+        help="Slot KV-cache length in tokens (HVDTPU_SERVE_MAX_LEN; "
+             "default: the model's max_len).",
+    )
+    serve.add_argument(
+        "--serve-seed", type=int, action=_StoreOverrideAction,
+        dest="serve_seed", default=None,
+        help="Params init seed — identical on every rank by "
+             "construction (HVDTPU_SERVE_SEED, default 0).",
+    )
+
     ckpt = parser.add_argument_group("checkpointing")
     ckpt.add_argument(
         "--ckpt-dir", action=_StoreOverrideAction, dest="ckpt_dir",
@@ -409,6 +445,7 @@ def check_build() -> str:
         "    [X] multi-slice two-fabric collectives (ICI scatter + DCN "
         "exchange, --num-slices / --dcn-compression)",
         "    [X] adasum",
+        "    [X] serving plane (continuous-batching inference, --serve)",
     ]
     return "\n".join(lines)
 
@@ -953,6 +990,7 @@ def launch_elastic_job(
     output_filename: Optional[str] = None,
     live_stats_secs: Optional[float] = None,
     live_history: Optional[str] = None,
+    serve_ingest: bool = False,
 ) -> ElasticJobResult:
     """Elastic counterpart of :func:`launch_job`: per-rank failure
     detection (exit code + KV heartbeat + collective-path progress
@@ -1037,6 +1075,22 @@ def launch_elastic_job(
         base_env, np, kv_server=kv_server, kv_addr=kv_addr,
         live_stats_secs=live_stats_secs, live_history=live_history,
     )
+
+    # Serving mode (--serve): the request front end rides the SAME
+    # rendezvous store — the launcher-resident ingest pump totally
+    # orders client submissions into the durable log rank 0 drains.
+    ingest_pump = None
+    if serve_ingest:
+        from ..serve.frontend import IngestPump  # noqa: PLC0415
+
+        ingest_pump = IngestPump(kv_server)
+        ingest_pump.start()
+        print(
+            f"[serve] ingest endpoint http://{kv_addr} "
+            f"(signed KV protocol, scope serve/ — "
+            f"horovod_tpu.serve.ServeClient)",
+            flush=True,
+        )
 
     from ..obs import get_registry  # noqa: PLC0415
     from ..obs.progress import ProgressPolicy  # noqa: PLC0415
@@ -1309,6 +1363,11 @@ def launch_elastic_job(
         procs.terminate()
         raise
     finally:
+        if ingest_pump is not None:
+            try:
+                ingest_pump.stop()
+            except Exception:  # pragma: no cover - defensive
+                pass
         # Drain the final live round while the store is still up.
         _stop_live_plane(live_plane, None)
         if owns_server:
@@ -1356,8 +1415,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if command and command[0] == "--":
         command = command[1:]
     if not command:
-        print("error: no command given", file=sys.stderr)
-        return 2
+        if getattr(args, "serve", False):
+            # Serving mode ships its own worker; -np 2 --serve alone is
+            # a complete invocation.
+            command = [sys.executable, "-m", "horovod_tpu.serve"]
+        else:
+            print("error: no command given", file=sys.stderr)
+            return 2
     if args.verbose and not args.log_level:
         args.log_level = "debug"
     if args.log_level:
@@ -1387,7 +1451,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         env[envmod.METRICS_DUMP] = summary_tmp
     try:
         LOG.info("launching %d processes: %s", args.np, " ".join(command))
-        if getattr(args, "elastic", False):
+        if getattr(args, "elastic", False) or getattr(args, "serve", False):
             launch_elastic_job(
                 command,
                 args.np,
@@ -1425,6 +1489,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 output_filename=args.output_filename,
                 live_stats_secs=getattr(args, "live_stats_secs", None),
                 live_history=getattr(args, "live_history_file", None),
+                serve_ingest=getattr(args, "serve", False),
             )
             return 0
         launch_job(
@@ -1485,3 +1550,7 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if ckpt is not None:
         print("\n== checkpoint / recovery ==")
         print(ckpt)
+    serve = obs_summary.serve_section(dumps)
+    if serve is not None:
+        print("\n== serving plane ==")
+        print(serve)

@@ -1,0 +1,35 @@
+"""Serving plane: continuous-batching inference on the training engine.
+
+The "millions of users" half of the north star (ROADMAP item 2), built
+out of the pieces the training stack already trusts:
+
+* :mod:`.scheduler` — pure iteration-level admit/evict state machine
+  (Orca-style) over a fixed slot pool; every rank derives the identical
+  schedule (the serving HVD001 invariant).
+* :mod:`.engine`    — compiled slot engine over the slot-based KV cache
+  (models/decode.py): one ``decode_step`` shape for a churning mix,
+  bucketed one-shot prefill for admissions.
+* :mod:`.frontend`  — request ingest + token streaming over the
+  launcher's HMAC-signed KV store; the launcher-resident ingest pump
+  totally orders arrivals into a durable log.
+* :mod:`.service`   — the SPMD serving loop on the elastic launcher
+  (dead ranks respawn and replay in-flight requests from the durable
+  log; zero dropped requests) and the :class:`ServeJob` python driver.
+* :mod:`.longctx`   — sequence-sharded slot caches for long-context
+  requests (Ulysses all-to-all prefill, flash-merge decode).
+
+Quick start::
+
+    from horovod_tpu.serve import ServeJob
+    job = ServeJob({"size": "nano", "num_slots": 4}, np=2).start()
+    rid = job.client.submit([5, 17, 3], max_new_tokens=8)
+    print(job.client.result(rid)["tokens"])
+    job.stop()
+"""
+
+from .engine import SlotEngine  # noqa: F401
+from .frontend import IngestPump, ServeClient, validate_request  # noqa: F401
+from .scheduler import (  # noqa: F401
+    ActiveSlot, Admission, Eviction, Request, SlotScheduler,
+)
+from .service import DEFAULT_SPEC, ServeJob, serve_worker  # noqa: F401

@@ -1,0 +1,121 @@
+"""Serving worker CLI: one rank of a continuous-batching fleet.
+
+Spawned by ``hvdrun --elastic --serve`` (which also arms the ingest
+pump on its rendezvous store)::
+
+    hvdrun --elastic --serve -np 2 -- \\
+        python -m horovod_tpu.serve --size nano --slots 4
+
+Mirrors ``elastic/worker.py``'s lifecycle (death hooks first, heartbeat
+immediately, epoch-qualified error publishing) with the function baked
+in instead of fetched: the serving loop :func:`~.service.serve_worker`.
+Model geometry comes from flags, each overridable by the HVDTPU_SERVE_*
+env the launcher forwards — so one ``--serve`` invocation configures
+the whole fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+
+from ..utils import env as envmod
+
+
+def parse_spec(argv=None) -> dict:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serve",
+        description="One serving rank (run under hvdrun --elastic --serve).",
+    )
+    p.add_argument("--size", default=None,
+                   help="gpt() model family entry (default nano)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="decode slot pool size (default 4)")
+    p.add_argument("--max-len", type=int, default=None,
+                   help="slot cache length (default: the model's max_len)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="params init seed, identical on every rank")
+    p.add_argument("--attention", default="reference",
+                   choices=["reference", "flash"],
+                   help="attention implementation for the served model "
+                        "(default reference: runs on every backend; "
+                        "flash is the TPU fast path)")
+    args = p.parse_args(argv)
+
+    import os  # noqa: PLC0415
+
+    def pick(flag, env_name, cast, default):
+        if flag is not None:
+            return flag
+        raw = os.environ.get(env_name)
+        return cast(raw) if raw not in (None, "") else default
+
+    spec = {
+        "size": pick(args.size, envmod.SERVE_MODEL, str, "nano"),
+        "num_slots": pick(args.slots, envmod.SERVE_SLOTS, int, 4),
+        "seed": pick(args.seed, envmod.SERVE_SEED, int, 0),
+        "overrides": {"attention_impl": args.attention},
+    }
+    max_len = pick(args.max_len, envmod.SERVE_MAX_LEN, int, 0)
+    if max_len:
+        spec["max_len"] = max_len
+    return spec
+
+
+def main(argv=None) -> int:
+    # Same death-path arming as elastic/worker.py: everything after
+    # this point leaves a black box if it dies.
+    from ..obs import flightrec  # noqa: PLC0415
+
+    flightrec.install_death_hooks()
+    spec = parse_spec(argv)
+
+    from ..elastic.context import ElasticContext, context  # noqa: PLC0415
+    from ..elastic.exceptions import HorovodShutdownError  # noqa: PLC0415
+    from .service import serve_worker  # noqa: PLC0415
+
+    ctx = context()
+    if not isinstance(ctx, ElasticContext):
+        print(
+            "python -m horovod_tpu.serve must be spawned by the elastic "
+            "launcher (hvdrun --elastic --serve); HVDTPU_ELASTIC_KV is "
+            "unset", file=sys.stderr,
+        )
+        return 2
+    ctx.start_heartbeat()
+    flush_trigger = "explicit"
+    try:
+        summary = serve_worker(spec)
+        print(json.dumps({"serve_summary": summary}), flush=True)
+        return 0
+    except HorovodShutdownError as exc:
+        # Outlived the retry budget / dropped from the world: exit like
+        # a crash so the launcher's monitor decides, not this rank.
+        flightrec.record_exception(exc, where="serve.worker")
+        flush_trigger = "exception"
+        return 1
+    except BaseException as exc:
+        flightrec.record_exception(exc, where="serve.worker")
+        flush_trigger = "exception"
+        import cloudpickle  # noqa: PLC0415
+
+        try:
+            ctx.kv.put(
+                "elastic", f"error_{ctx.rank}_{ctx.epoch}",
+                cloudpickle.dumps(traceback.format_exc()),
+            )
+        except Exception:
+            pass
+        return 1
+    finally:
+        ctx.stop_heartbeat()
+        try:
+            flightrec.flush(flush_trigger)
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,158 @@
+"""Slot engine: the compiled-model half of the serving plane.
+
+Wraps the slot-based decode primitives (models/decode.py) for the
+continuous-batching loop: ONE jitted ``decode_step`` over the whole
+slot pool (shape never changes, so it compiles once), plus one jitted
+``assign_slot`` per prompt-length *bucket* (prompts are right-padded to
+the next power of two, so admission compiles O(log max_len) variants,
+not one per prompt length).
+
+Determinism contract (the serving HVD001 invariant): given the same
+config, params, and the same sequence of admit/step/evict calls, every
+rank's engine produces bit-identical tokens — the scheduler feeds every
+rank the same calls, and XLA's decode math is deterministic per
+backend.  Greedy decoding only: sampling would need a per-request PRNG
+stream replicated across ranks and replayed across elastic epochs,
+which is future work (docs/inference.md, honest limits).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decode import assign_slot, decode_step, init_cache
+
+__all__ = ["SlotEngine", "prompt_bucket"]
+
+_MIN_BUCKET = 8
+
+
+def prompt_bucket(n: int, cache_len: int) -> int:
+    """Pad target for an ``n``-token prefill: the next power of two
+    (floor ``_MIN_BUCKET``), clamped to the cache length."""
+    if n > cache_len:
+        raise ValueError(
+            f"prompt of {n} tokens exceeds the {cache_len}-token cache"
+        )
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return min(b, cache_len)
+
+
+class SlotEngine:
+    """A fixed pool of decode slots over one model.
+
+    ``admit`` prefills a request into one slot (other slots' caches are
+    bitwise untouched — pinned by tests/test_decode.py); ``step`` runs
+    one decode iteration for the ACTIVE slots only (frozen rows ride
+    along masked).  Eviction needs no engine call: an evicted slot is
+    simply excluded from the next step's mask and overwritten by the
+    next admission.
+    """
+
+    def __init__(self, cfg, params, num_slots: int,
+                 max_len: Optional[int] = None):
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.cache = init_cache(cfg, num_slots, max_len)
+        self.cache_len = int(self.cache["k"].shape[2])
+        # Serving context cap: never beyond the model's trained context
+        # (a learned-positions model NaN-poisons past max_len, and the
+        # prefill forward rejects prompts beyond it), and never beyond
+        # the slot — admission buckets and request validation both
+        # bound against THIS, so an oversized cache can't admit a
+        # request whose power-of-two bucket trips the forward's
+        # max_len guard and crash-loops the fleet.
+        self.serve_len = min(self.cache_len, int(cfg.max_len))
+        # Current input token per slot (the last token emitted there).
+        self._cur = np.zeros(num_slots, np.int32)
+
+        def _assign(params, cache, slot, tokens, length):
+            cache, last = assign_slot(cfg, params, cache, slot,
+                                      tokens, length)
+            return cache, jnp.argmax(last).astype(jnp.int32)
+
+        # One jitted assign serves every bucket: jax.jit's own trace
+        # cache keys on the padded shape, so power-of-two padding alone
+        # bounds compiles at O(log max_len).
+        self._assign_compiled = jax.jit(_assign, donate_argnums=(1,))
+
+        def _step(params, cache, tokens, mask):
+            logits, cache = decode_step(cfg, params, cache, tokens,
+                                        write_mask=mask)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        # The cache is the big state (L·b·S·kv — the whole point of the
+        # slot pool); donate it so each step updates in place instead of
+        # keeping input and output pools both live.
+        self._step_compiled = jax.jit(_step, donate_argnums=(1,))
+
+    # --------------------------------------------------------- admission
+
+    def admit(self, slot: int, prompt: Sequence[int],
+              resume: Sequence[int] = ()) -> Optional[int]:
+        """Prefill ``prompt`` (plus already-emitted ``resume`` tokens on
+        elastic replay) into ``slot``.
+
+        Fresh request: returns its FIRST generated token (greedy pick at
+        the prompt's last position).  Replay: the resume tokens were
+        already emitted to the client, so nothing new is generated here
+        — the slot is rebuilt to the exact cache state the dead world
+        held and returns None.
+        """
+        if resume:
+            seq = list(prompt) + list(resume[:-1])
+            cur = int(resume[-1])
+        else:
+            seq = list(prompt)
+            cur = None
+        bucket = prompt_bucket(len(seq), self.serve_len)
+        padded = np.zeros(bucket, np.int32)
+        padded[:len(seq)] = seq
+        self.cache, first = self._assign_compiled(
+            self.params, self.cache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(padded), jnp.asarray(len(seq), jnp.int32),
+        )
+        if cur is not None:
+            self._cur[slot] = cur
+            return None
+        tok = int(first)
+        self._cur[slot] = tok
+        return tok
+
+    # ------------------------------------------------------------ decode
+
+    def step(self, active: Iterable[int]) -> Dict[int, int]:
+        """One decode iteration: every slot in ``active`` consumes its
+        current token and emits the next; all other slots are frozen.
+        Returns ``{slot: token}`` for the active slots."""
+        slots: List[int] = sorted(active)
+        if not slots:
+            return {}
+        mask = np.zeros(self.num_slots, bool)
+        mask[slots] = True
+        toks, self.cache = self._step_compiled(
+            self.params, self.cache, jnp.asarray(self._cur),
+            jnp.asarray(mask),
+        )
+        toks = np.asarray(toks)
+        out = {}
+        for s in slots:
+            self._cur[s] = toks[s]
+            out[s] = int(toks[s])
+        return out
+
+    # ------------------------------------------------------------- reset
+
+    def reset(self) -> None:
+        """Drop every slot (elastic epoch rebuild): fresh zero cache,
+        zero cursors.  Compiled functions are retained — recovery pays
+        re-prefill, never re-compile."""
+        self.cache = init_cache(self.cfg, self.num_slots, self.cache_len)
+        self._cur[:] = 0

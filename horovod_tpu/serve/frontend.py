@@ -1,0 +1,234 @@
+"""Request front end: HTTP ingest on the launcher, streaming results.
+
+The serving plane reuses the launcher's HMAC-signed KV store
+(run/rendezvous.py) as its wire — the same plumbing that already
+carries rendezvous, heartbeats, live telemetry and checkpoint replicas.
+Three key families under the ``serve`` scope:
+
+* ``serve/req/<rid>``  — client submissions (signed PUT).  The HTTP
+  surface deliberately has no listing verb, so workers cannot drain
+  this directly; the launcher-resident :class:`IngestPump` (which owns
+  the store in-process, like the live aggregator) scans it and...
+* ``serve/log/<n>``    — ...rewrites each submission into a totally
+  ordered, immutable ingest log.  Rank 0 of the serving world drains
+  the log by sequence number and broadcasts each step's schedule to
+  its peers, so every rank admits identical requests in identical
+  order (the HVD001 invariant).  The log also IS the durable request
+  record elastic recovery replays from.
+* ``serve/out/<rid>``  — per-request streaming state, written by the
+  serving leader after every step: tokens emitted so far, done flag,
+  admission/finish bookkeeping.  Clients poll it (signed GET) to
+  stream tokens as they are generated.
+
+``serve/stop`` is the drain sentinel: the leader folds it into the
+step schedule, finishes everything in flight, and the world exits
+cleanly.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import uuid
+from typing import List, Optional, Sequence
+
+from ..run.rendezvous import KVStoreClient
+from ..utils.logging import get_logger
+
+LOG = get_logger("serve.frontend")
+
+SCOPE = "serve"
+REQ_PREFIX = SCOPE + "/req/"
+
+__all__ = ["ServeClient", "IngestPump", "validate_request", "SCOPE"]
+
+
+def validate_request(doc: dict, serve_len: int,
+                     vocab_size: Optional[int] = None) -> Optional[str]:
+    """Reject reason for an ingest-log entry, or None when servable.
+    Pure — every rank applies it to the same log entry and reaches the
+    same verdict (a rank-divergent reject would desync the schedule).
+
+    ``serve_len`` is the engine's serving context cap
+    (``min(cache_len, cfg.max_len)``): bounding against the raw cache
+    length alone would let an oversized cache admit a prompt whose
+    prefill bucket trips the model's own max_len guard.  ``vocab_size``
+    rejects out-of-vocab ids — the embedding gather would otherwise
+    silently CLAMP them (JAX's default), returning deterministic
+    garbage where this module's contract is a loud reject."""
+    prompt = doc.get("prompt")
+    if not isinstance(prompt, (list, tuple)) or not prompt:
+        return "empty or malformed prompt"
+    if not all(isinstance(t, int) and t >= 0 for t in prompt):
+        return "prompt tokens must be non-negative ints"
+    if vocab_size is not None and any(t >= vocab_size for t in prompt):
+        return f"prompt token out of vocab (>= {vocab_size})"
+    mnt = doc.get("max_new_tokens", 0)
+    if not isinstance(mnt, int) or mnt < 1:
+        return "max_new_tokens must be >= 1"
+    if len(prompt) + mnt > serve_len:
+        return (
+            f"prompt ({len(prompt)}) + max_new_tokens ({mnt}) exceeds "
+            f"the {serve_len}-token serving context"
+        )
+    return None
+
+
+class ServeClient:
+    """Client half of the front end: submit prompts, stream tokens.
+
+    Talks the signed KV protocol (the secret travels via
+    ``HVDTPU_SECRET`` or the constructor), so any process holding the
+    per-job secret can drive a serving job — the CI gates, bench.py's
+    open-loop generator, and operator tooling all use this class.
+    """
+
+    def __init__(self, addr: str, secret: Optional[str] = None):
+        self._kv = KVStoreClient(addr, secret)
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               rid: Optional[str] = None) -> str:
+        """Enqueue one generation request; returns its request id."""
+        rid = rid or uuid.uuid4().hex[:16]
+        doc = {
+            "rid": rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "eos_id": None if eos_id is None else int(eos_id),
+        }
+        self._kv.put(SCOPE, f"req/{rid}", pickle.dumps(doc))
+        return rid
+
+    def poll(self, rid: str) -> Optional[dict]:
+        """Streaming state ``{"tokens", "done", ...}`` or None before
+        the first token lands."""
+        raw = self._kv.get(SCOPE, f"out/{rid}")
+        return None if raw is None else pickle.loads(raw)
+
+    def result(self, rid: str, timeout: float = 120.0) -> dict:
+        """Block until the request finishes; raises RuntimeError when
+        the server rejected it (the reject reason is in the doc)."""
+        deadline = time.monotonic() + timeout
+        delay = 0.02
+        while time.monotonic() < deadline:
+            doc = self.poll(rid)
+            if doc is not None and doc.get("done"):
+                if doc.get("error"):
+                    raise RuntimeError(
+                        f"request {rid} rejected: {doc['error']}"
+                    )
+                return doc
+            time.sleep(delay)
+            delay = min(delay * 2, 0.25)
+        raise TimeoutError(f"request {rid} not finished within {timeout}s")
+
+    def stop(self) -> None:
+        """Raise the drain sentinel: in-flight and queued requests
+        complete, then the serving world exits."""
+        self._kv.put(SCOPE, "stop", b"1")
+
+
+class IngestPump:
+    """Launcher-resident ingest thread: scans ``serve/req/*`` on the
+    in-process store (the listing the HTTP surface deliberately lacks)
+    and appends each submission to the totally ordered ``serve/log/<n>``
+    the serving leader drains.
+
+    Ordering within one scan round is by request id — arrival order
+    inside a round is not observable from a dict snapshot, and a
+    deterministic tiebreak beats a racy one.  Arrival wall time is
+    stamped here (the launcher's clock), which is what ttft is measured
+    against.
+    """
+
+    def __init__(self, server, interval: float = 0.02):
+        self._server = server
+        self._kv = KVStoreClient(f"127.0.0.1:{server.port}",
+                                 server.secret)
+        self.interval = max(float(interval), 0.005)
+        self._next = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def ingested(self) -> int:
+        return self._next
+
+    def round(self) -> int:
+        """Move every pending submission into the log; returns how many.
+        Also garbage-collects dead-epoch serving scopes (see
+        :meth:`_gc_stale_epochs`) — the pump is the one serving
+        component with in-process listing access to the store."""
+        self._gc_stale_epochs()
+        pending = self._server.scan(REQ_PREFIX)
+        moved = 0
+        for key in sorted(pending):
+            try:
+                doc = pickle.loads(pending[key])
+                rid = doc["rid"]
+            except Exception:
+                LOG.warning("dropping malformed submission %s", key)
+                self._server.discard([key])
+                continue
+            doc["arrival"] = time.time()
+            doc["n"] = self._next
+            self._kv.put(SCOPE, f"log/{self._next}", pickle.dumps(doc))
+            self._next += 1
+            moved += 1
+            self._server.discard([key])
+            LOG.debug("ingested request %s as log/%d", rid, doc["n"])
+        return moved
+
+    def _gc_stale_epochs(self) -> None:
+        """Drop schedule/recovery keys from epochs older than the
+        current rendezvous epoch.  The leader's in-band GC only trims
+        its OWN epoch's trailing window; every world break would
+        otherwise permanently leak the dead epoch's remaining sched
+        pickles and recovery doc — unbounded launcher memory on a
+        long-lived fleet with periodic rank churn.  Old-epoch keys are
+        immutable and unreadable by design (survivors and respawns
+        alike rebuild from the NEW epoch's recovery doc), so deleting
+        them can never race a reader."""
+        raw = self._server.scan("elastic/epoch")
+        try:
+            current = int(raw["elastic/epoch"])
+        except (KeyError, ValueError):
+            return  # no elastic world yet (or a non-elastic store)
+        doomed = []
+        for key in self._server.scan("serve_e"):
+            scope = key.split("/", 1)[0]
+            try:
+                epoch = int(scope[len("serve_e"):])
+            except ValueError:
+                continue
+            if epoch < current:
+                doomed.append(key)
+        if doomed:
+            self._server.discard(doomed)
+            LOG.debug("GC'd %d stale-epoch serving keys", len(doomed))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="hvdtpu_serve_ingest", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.round()
+            except Exception as exc:  # pragma: no cover - defensive
+                LOG.warning("ingest round failed: %s", exc)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        try:
+            self.round()  # drain what arrived before the stop
+        except Exception:  # pragma: no cover - defensive
+            pass

@@ -1,0 +1,135 @@
+"""Long-context serving: sequence-sharded slot caches over the mesh.
+
+A slot whose context exceeds one device's memory shards its K/V cache
+along the SEQUENCE axis across a mesh axis — each device holds a
+contiguous ``[b, S/P, hkv, hd]`` chunk.  Two attention schedules serve
+that layout (both pinned against the replicated reference math by
+tests/test_serve.py on the 8-device CPU mesh):
+
+* :func:`ulysses_prefill_attention` — the prompt phase.  Queries exist
+  at every position, so Jacobs et al.'s Ulysses reshard applies
+  directly: all-to-all seq→heads, full-sequence attention on a head
+  shard, all-to-all back (PAPERS.md; delegates to the existing
+  ``parallel.ring_attention.ulysses_attention`` so serving and training
+  share one implementation).
+
+* :func:`sharded_decode_attention` — the decode phase.  One query per
+  step makes the Ulysses reshard degenerate (an all-to-all of the whole
+  cache per token), so the decode step instead computes flash-style
+  partial softmax statistics ``(m, l, o)`` over the LOCAL cache chunk
+  and merges them across the axis — the same online-softmax algebra
+  ring_attention uses within a device, lifted to one collective
+  exchange per step.  Bytes on the wire per step: O(b·h·hd), not
+  O(S) — the cache never moves.
+
+The default serving engine replicates slots (engine.py); this module is
+the layout the engine grows into when a deployment pins
+``HVDTPU_SERVE_SEQ_SHARDS`` — docs/inference.md states the integration
+status honestly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["sharded_decode_attention", "ulysses_prefill_attention"]
+
+
+def ulysses_prefill_attention(q, k, v, axis_name: str, *,
+                              causal: bool = True):
+    """Prefill attention for sequence-sharded prompts: ``q/k/v
+    [b, s_local, h, hd]`` sharded along dim 1 inside ``shard_map``.
+    One all-to-all turns the layout into full-sequence × heads/P,
+    attention runs per head shard, and a second all-to-all restores
+    sequence sharding.
+
+    Same schedule as ``parallel.ring_attention.ulysses_attention`` with
+    the inner softmax math shared (``local_attention``); the axis-size
+    probe is spelled ``psum(1)`` so the serving path runs on the pinned
+    jax version the training-side copy has drifted past.
+    """
+    from ..parallel.ring_attention import local_attention  # noqa: PLC0415
+
+    size = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % size != 0:
+        raise ValueError(
+            f"ulysses_prefill_attention requires heads ({h}) divisible "
+            f"by the '{axis_name}' axis size ({size})"
+        )
+
+    def seq_to_heads(x):
+        # [b, s/P, h, d] -> [b, s, h/P, d]
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    out = local_attention(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal
+    )
+    return heads_to_seq(out)
+
+
+def sharded_decode_attention(cfg, q, k_shard, v_shard, pos, axis_name: str):
+    """One decode query per slot against a SEQUENCE-SHARDED slot cache.
+
+    ``q [b, h, hd]`` (replicated), ``k_shard/v_shard [b, S/P, hkv, hd]``
+    (this device's contiguous chunk), ``pos [b]`` per-slot GLOBAL write
+    positions.  Call inside ``shard_map`` over ``axis_name``; returns
+    the replicated ``[b, h, hd]`` attention output, bitwise-stable in
+    the same sense as the replicated path (fp32 softmax math).
+
+    Masking matches ``models.decode._attend_cached`` exactly: a chunk
+    position's GLOBAL index ``offset + i`` is masked when it exceeds
+    the slot's ``pos`` (and when it falls below the sliding-window
+    band's lower edge).  A fully-masked chunk contributes ``l = 0`` and
+    drops out of the merged softmax.
+    """
+    b, h, hd = q.shape
+    s_local = k_shard.shape[1]
+    group = h // cfg.kv_heads
+    idx = lax.axis_index(axis_name)
+    offset = idx * s_local
+
+    qg = q.reshape(b, cfg.kv_heads, group, hd).astype(jnp.float32)
+    kf = k_shard.astype(jnp.float32)
+    vf = v_shard.astype(jnp.float32)
+    st = jnp.einsum("bkgd,bskd->bkgs", qg, kf) * (hd ** -0.5)
+    gidx = (offset + jnp.arange(s_local))[None, None, None, :]
+    pb = pos[:, None, None, None]
+    mask = gidx > pb
+    if cfg.attention_window is not None:
+        mask = mask | (gidx < pb - (cfg.attention_window - 1))
+    st = jnp.where(mask, -jnp.inf, st)
+
+    # Flash-style partial statistics over the local chunk.  -inf rows
+    # (everything masked) yield m=-inf; exp(st - m) would be NaN, so
+    # clamp the subtrahend — their l is exactly 0 and the merge ignores
+    # them.
+    m = jnp.max(st, axis=-1)                                   # [b,k,g]
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.exp(st - safe_m[..., None])
+    e = jnp.where(jnp.isfinite(st), e, 0.0)
+    l = jnp.sum(e, axis=-1)                                    # [b,k,g]
+    o = jnp.einsum("bkgs,bskd->bkgd", e, vf)                   # [b,k,g,d]
+
+    # Cross-shard merge: rescale every chunk's (l, o) to the global max
+    # and reduce.  One pmax + two psums of O(b·h·hd) per step.  The
+    # pmax must see only CONTRIBUTING chunks' maxima: a fully-masked
+    # chunk's clamped m=0.0 would otherwise dominate whenever every
+    # real score is far below zero, underflowing every scale factor
+    # and silently zeroing the output.
+    gm = lax.pmax(jnp.where(l > 0, safe_m, -jnp.inf), axis_name)
+    safe_gm = jnp.where(jnp.isfinite(gm), gm, 0.0)
+    scale = jnp.where(l > 0, jnp.exp(safe_m - safe_gm), 0.0)
+    gl = lax.psum(l * scale, axis_name)
+    go = lax.psum(o * scale[..., None], axis_name)
+    out = go / jnp.maximum(gl, 1e-30)[..., None]
+    return out.reshape(b, h, hd)

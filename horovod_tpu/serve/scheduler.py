@@ -1,0 +1,228 @@
+"""Continuous-batching scheduler core: iteration-level admit/evict over
+a fixed pool of batch slots.
+
+Orca-style scheduling (Yu et al., OSDI '22) reduced to its SPMD
+essentials: between decode steps, queued requests are admitted into
+free slots (FCFS, lowest-numbered slot first) and finished sequences
+(EOS or token budget) are evicted immediately, their slots recycled —
+so ONE compiled ``decode_step`` shape serves a churning request mix
+without recompilation.
+
+This module is deliberately a **pure state machine**: no jax, no
+networking, no clocks, no rank awareness.  Every rank of the serving
+world runs its own instance and feeds it the SAME inputs in the SAME
+order (new requests from the rank-0 schedule broadcast, token
+observations from the deterministic decode math) — so every rank
+derives an identical admit/evict schedule.  That is the serving plane's
+HVD001 invariant: a rank-divergent schedule here is exactly the
+divergent-collective deadlock class hvdtpu-lint checks for on the
+training side, which is why nothing in this file may consult
+``hvd.rank()``, a wall clock, or an unordered dict iteration.  Unit
+tests drive the decision table directly (tests/test_serve.py), and the
+multi-rank determinism test replays one trace through N instances.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Request", "ActiveSlot", "Admission", "Eviction",
+           "SlotScheduler"]
+
+
+@dataclass(frozen=True)
+class Request:
+    """One generation request.  ``arrival`` is informational (latency
+    accounting) — scheduling NEVER reads it; order of arrival is fixed
+    by the ingest log's sequence numbers, not by clocks."""
+
+    rid: str
+    prompt: Tuple[int, ...]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    arrival: float = 0.0
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError(f"request {self.rid!r} has an empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.rid!r}: max_new_tokens must be >= 1"
+            )
+
+
+@dataclass
+class ActiveSlot:
+    """One slot's live request plus its emission progress."""
+
+    req: Request
+    slot: int
+    emitted: List[int] = field(default_factory=list)
+    # Serving-step index the admission happened at (scheduling never
+    # reads it; the frontend publishes it so tests and operators can
+    # SEE continuous admission — requests entering mid-stream).
+    admitted_step: int = 0
+
+    @property
+    def done(self) -> bool:
+        if len(self.emitted) >= self.req.max_new_tokens:
+            return True
+        return bool(
+            self.emitted
+            and self.req.eos_id is not None
+            and self.emitted[-1] == self.req.eos_id
+        )
+
+
+@dataclass(frozen=True)
+class Admission:
+    slot: int
+    req: Request
+    resume: Tuple[int, ...]  # already-emitted tokens (elastic replay)
+
+
+@dataclass(frozen=True)
+class Eviction:
+    slot: int
+    rid: str
+    reason: str  # "eos" | "budget"
+    tokens: Tuple[int, ...]
+    admitted_step: int = 0
+
+
+class SlotScheduler:
+    """The per-rank scheduling state machine.
+
+    Lifecycle per decode step::
+
+        sched.enqueue(req)            # rank-0-broadcast new arrivals
+        admits = sched.admit()        # queued -> free slots, FCFS
+        ... engine prefills each admission, decodes active slots ...
+        sched.record(slot, token)     # one emitted token per live slot
+        evicts = sched.evict_finished()
+
+    Deterministic by construction: the queue is FCFS, free slots are
+    handed out in ascending slot order, and eviction order is ascending
+    slot order.
+    """
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.num_slots = num_slots
+        self.queue: Deque[Tuple[Request, Tuple[int, ...]]] = deque()
+        self.active: Dict[int, ActiveSlot] = {}
+
+    # ------------------------------------------------------------ intake
+
+    def enqueue(self, req: Request,
+                resume: Sequence[int] = ()) -> None:
+        """Append to the FCFS queue.  ``resume``: tokens the request
+        already emitted before a world break — the admission carries
+        them so the engine re-prefills ``prompt + resume`` instead of
+        restarting the generation (zero dropped requests on respawn).
+        A request whose resume already satisfies its stop condition
+        must not be re-admitted; the caller detects that via
+        :meth:`ActiveSlot.done` semantics replicated here."""
+        self.queue.append((req, tuple(resume)))
+
+    # --------------------------------------------------------- admission
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.num_slots) if s not in self.active]
+
+    def admit(self, step: int = 0) -> List[Admission]:
+        """Admit queued requests into free slots: FCFS, lowest slot
+        first.  Mutates the schedule and returns the admissions in
+        order.  ``step`` is recorded on the slot for observability
+        only — it never influences the decision."""
+        out: List[Admission] = []
+        for slot in self.free_slots():
+            if not self.queue:
+                break
+            req, resume = self.queue.popleft()
+            self.active[slot] = ActiveSlot(req=req, slot=slot,
+                                           emitted=list(resume),
+                                           admitted_step=step)
+            out.append(Admission(slot=slot, req=req, resume=resume))
+        return out
+
+    # ---------------------------------------------------------- progress
+
+    def record(self, slot: int, token: int) -> None:
+        """Record one emitted token for a live slot."""
+        act = self.active.get(slot)
+        if act is None:
+            raise KeyError(f"slot {slot} has no active request")
+        if act.done:
+            raise ValueError(
+                f"slot {slot} ({act.req.rid}) is finished; the engine "
+                f"must not emit past the stop condition"
+            )
+        act.emitted.append(int(token))
+
+    def evict_finished(self) -> List[Eviction]:
+        """Evict every finished slot (ascending order), freeing it for
+        the next step's admissions."""
+        out: List[Eviction] = []
+        for slot in sorted(self.active):
+            act = self.active[slot]
+            if not act.done:
+                continue
+            reason = (
+                "eos"
+                if act.req.eos_id is not None
+                and act.emitted
+                and act.emitted[-1] == act.req.eos_id
+                else "budget"
+            )
+            out.append(Eviction(slot=slot, rid=act.req.rid,
+                                reason=reason,
+                                tokens=tuple(act.emitted),
+                                admitted_step=act.admitted_step))
+            del self.active[slot]
+        return out
+
+    # ------------------------------------------------------------- views
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def active_slots(self) -> int:
+        return len(self.active)
+
+    def idle(self) -> bool:
+        return not self.queue and not self.active
+
+    def snapshot(self) -> List[dict]:
+        """In-flight then queued requests as plain dicts (ascending
+        slot order, then queue order) — introspection/debugging view.
+        NOTE: elastic recovery does NOT flow through this method; the
+        authoritative replay is service._build_recovery(), which joins
+        the durable KV ingest log with the published token streams (a
+        respawned leader has no in-memory scheduler to snapshot)."""
+        return [
+            {
+                "rid": act.req.rid,
+                "prompt": list(act.req.prompt),
+                "max_new_tokens": act.req.max_new_tokens,
+                "eos_id": act.req.eos_id,
+                "arrival": act.req.arrival,
+                "emitted": list(act.emitted),
+            }
+            for _, act in sorted(self.active.items())
+        ] + [
+            {
+                "rid": req.rid,
+                "prompt": list(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "arrival": req.arrival,
+                "emitted": list(resume),
+            }
+            for req, resume in self.queue
+        ]

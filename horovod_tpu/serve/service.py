@@ -1,0 +1,539 @@
+"""The serving loop and job driver: continuous batching on the elastic
+launcher.
+
+Topology: every rank of the serving world runs the SAME model
+replicated over the SAME slot pool and derives an IDENTICAL admit/evict
+schedule — rank 0 of the current world (the *leader*, lowest live rank)
+is the only rank that reads the ingest log and the only rank that
+writes result streams, and it broadcasts each step's schedule through
+an epoch-scoped KV key its peers block on.  Identical schedule + the
+deterministic decode math = identical tokens on every rank, which is
+what makes a dead rank REPLACEABLE: the respawned incarnation rebuilds
+the same state from the durable request log and token streams, and no
+in-flight request is dropped.
+
+Elastic recovery rides the PR-1 machinery unchanged: the launcher
+detects the dead rank, mints a fresh rendezvous epoch, respawns the
+rank via the same ``elastic.worker`` entry; survivors notice the epoch
+bump (every KV wait is epoch-watched) and re-rendezvous.  At each epoch
+start the leader republishes a *recovery doc* — the ingest-log replay
+of every not-yet-finished request, with the tokens already streamed to
+clients — and every rank rebuilds its scheduler and re-prefills its
+slots from it.  Tokens already delivered are never re-emitted;
+generation resumes mid-stream, bitwise on course.
+
+Observability rides the PR-2/3 planes: ``serve.*`` instruments land in
+the per-rank metrics registry, stream to the launcher's ``/metrics``
+endpoint when live stats are armed, show in the live digest, and
+aggregate into ``--stats-summary``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..elastic.exceptions import HorovodShutdownError
+from ..obs import get_registry
+from ..obs import flightrec as obs_flightrec
+from ..obs import progress as obs_progress
+from ..testing.faults import maybe_fail
+from ..utils.logging import get_logger
+from .frontend import SCOPE, IngestPump, ServeClient, validate_request
+from .scheduler import Request, SlotScheduler
+
+LOG = get_logger("serve")
+
+__all__ = ["serve_worker", "ServeJob", "DEFAULT_SPEC"]
+
+# How many trailing step-schedule keys the leader keeps before deleting
+# (authenticated DELETE): an unbounded schedule history would grow the
+# launcher's store forever on a long-lived serving job.  The window
+# must comfortably exceed the worst leader-vs-peer step lag (peers
+# have no back-pressure on the leader): a peer whose next schedule key
+# was already GC'd can only time out and force a world re-formation.
+_SCHED_KEEP = 256
+
+DEFAULT_SPEC: Dict[str, Any] = {
+    "size": "nano",          # gpt(<size>) model family entry
+    "overrides": {},         # TransformerConfig overrides
+    "seed": 0,               # params init seed (identical on every rank)
+    "num_slots": 4,
+    "max_len": None,         # slot cache length (default cfg.max_len)
+    "idle_secs": 0.01,       # leader pacing when nothing is in flight
+    "stream_every": 4,       # publish token streams every N tokens
+}
+
+
+def _epoch_scope(epoch: int) -> str:
+    return f"serve_e{epoch}"
+
+
+def _fetch(ctx, scope: str, key: str, what: str) -> bytes:
+    """Poll one serving key; a rendezvous-epoch bump mid-wait means the
+    world broke — surface it as the shutdown signal the outer loop
+    turns into re-rendezvous + replay."""
+    deadline = time.monotonic() + ctx.timeout
+    while True:
+        raw = ctx.kv.get(scope, key)
+        if raw is not None:
+            return raw
+        if ctx.current_epoch() > ctx.epoch:
+            raise HorovodShutdownError(
+                f"world re-formed while waiting for {what}"
+            )
+        if time.monotonic() > deadline:
+            raise HorovodShutdownError(
+                f"timed out waiting for {what} — a peer likely died "
+                f"without the launcher re-forming the world yet"
+            )
+        time.sleep(0.005)
+
+
+def _build_recovery(kv) -> dict:
+    """Replay the durable request record: the full ingest log joined
+    with each request's streamed tokens.  Only the leader runs this —
+    peers adopt its published doc, so a log entry racing in mid-scan
+    can never split the world's view."""
+    docs = []
+    n = 0
+    while True:
+        raw = kv.get(SCOPE, f"log/{n}")
+        if raw is None:
+            break
+        docs.append(pickle.loads(raw))
+        n += 1
+    inflight = []
+    for doc in docs:
+        out_raw = kv.get(SCOPE, f"out/{doc['rid']}")
+        emitted: List[int] = []
+        if out_raw is not None:
+            out = pickle.loads(out_raw)
+            if out.get("done"):
+                continue  # finished (or rejected) before the break
+            emitted = list(out.get("tokens", []))
+        entry = dict(doc)
+        entry["emitted"] = emitted
+        inflight.append(entry)
+    return {"log_next": n, "inflight": inflight}
+
+
+def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
+                 admitted_step: int, error: Optional[str] = None,
+                 finished_step: Optional[int] = None,
+                 reason: Optional[str] = None) -> None:
+    doc = {
+        "rid": rid,
+        "tokens": list(tokens),
+        "done": done,
+        "epoch": epoch,
+        "admitted_step": admitted_step,
+    }
+    if error is not None:
+        doc["error"] = error
+    if finished_step is not None:
+        doc["finished_step"] = finished_step
+    if reason is not None:
+        doc["reason"] = reason
+    kv.put(SCOPE, f"out/{rid}", pickle.dumps(doc))
+
+
+def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
+    """One rendezvous epoch of the serving loop.  Returns the per-rank
+    summary dict on a clean drain (``serve/stop``), raises
+    HorovodShutdownError on a world break (the caller re-enters)."""
+    reg = get_registry()
+    epoch = ctx.rendezvous()
+    leader = ctx.world[0]
+    is_leader = ctx.rank == leader
+    scope = _epoch_scope(epoch)
+
+    # Epoch-start recovery broadcast: the leader's replay of the durable
+    # request record IS the schedule seed — every rank (survivor or
+    # fresh respawn) rebuilds the identical scheduler state from it.
+    if is_leader:
+        rec = _build_recovery(ctx.kv)
+        ctx.kv.put(scope, "recovery", pickle.dumps(rec))
+    else:
+        rec = pickle.loads(_fetch(ctx, scope, "recovery",
+                                  f"recovery doc for epoch {epoch}"))
+    sched = SlotScheduler(spec["num_slots"])
+    engine.reset()
+    log_next = rec["log_next"]
+    replayed = 0
+    for entry in rec["inflight"]:
+        reason = validate_request(entry, engine.serve_len,
+                                  engine.cfg.vocab_size)
+        if reason is not None:
+            # Same accounting as the live path: a reject during replay
+            # must show in serve.rejected too, or the runbook's
+            # "rejected climbing" check misses exactly the rejects that
+            # coincide with world breaks.
+            reg.counter("serve.rejected").inc()
+            if is_leader:
+                _publish_out(ctx.kv, entry["rid"], tokens=(), done=True,
+                             epoch=epoch, admitted_step=0, error=reason)
+            continue
+        req = Request(
+            rid=entry["rid"], prompt=tuple(entry["prompt"]),
+            max_new_tokens=entry["max_new_tokens"],
+            eos_id=entry.get("eos_id"),
+            arrival=entry.get("arrival", 0.0),
+        )
+        sched.enqueue(req, resume=entry.get("emitted", ()))
+        if entry.get("emitted"):
+            replayed += 1
+    if replayed:
+        reg.counter("serve.replayed").inc(replayed)
+        obs_flightrec.record(
+            "init", name="serve_replay", cycle=epoch,
+            detail=f"{replayed} in-flight requests replayed",
+        )
+        LOG.info("epoch %d: replaying %d in-flight requests", epoch,
+                 replayed)
+
+    step = 0
+    epoch_t0 = time.monotonic()
+    epoch_tokens = 0
+    idle_secs = float(spec.get("idle_secs", 0.01))
+    stream_every = max(int(spec.get("stream_every", 4)), 1)
+    while True:
+        step += 1
+        # Deterministic chaos: the serving analog of the elastic
+        # collective's step-boundary injection point — same spec
+        # grammar, same epoch-0 default that keeps respawns convergent.
+        maybe_fail("worker_exit", step=step, rank=ctx.rank)
+        if ctx.world_changed():
+            raise HorovodShutdownError(
+                f"epoch advanced past {epoch} (a peer died); "
+                f"re-forming the serving world"
+            )
+
+        # -- schedule broadcast (leader reads the log; peers follow) --
+        if is_leader:
+            new_entries = []
+            while True:
+                raw = ctx.kv.get(SCOPE, f"log/{log_next}")
+                if raw is None:
+                    break
+                new_entries.append(pickle.loads(raw))
+                log_next += 1
+            stop = ctx.kv.get(SCOPE, "stop") is not None
+            sdoc = {"new": new_entries, "stop": stop}
+            ctx.kv.put(scope, f"sched/{step}", pickle.dumps(sdoc))
+            if step > _SCHED_KEEP:
+                ctx.kv.delete(scope, f"sched/{step - _SCHED_KEEP}")
+        else:
+            sdoc = pickle.loads(_fetch(ctx, scope, f"sched/{step}",
+                                       f"schedule for step {step}"))
+
+        for entry in sdoc["new"]:
+            reason = validate_request(entry, engine.serve_len,
+                                      engine.cfg.vocab_size)
+            if reason is not None:
+                reg.counter("serve.rejected").inc()
+                if is_leader:
+                    _publish_out(ctx.kv, entry["rid"], tokens=(),
+                                 done=True, epoch=epoch,
+                                 admitted_step=0, error=reason)
+                continue
+            sched.enqueue(Request(
+                rid=entry["rid"], prompt=tuple(entry["prompt"]),
+                max_new_tokens=entry["max_new_tokens"],
+                eos_id=entry.get("eos_id"),
+                arrival=entry.get("arrival", 0.0),
+            ))
+
+        # -- admissions: queued -> free slots, prefill each ----------
+        busy_before = sched.active_slots
+        admissions = sched.admit(step)
+        for adm in admissions:
+            tok = engine.admit(adm.slot, adm.req.prompt, adm.resume)
+            if tok is None:
+                continue  # replay rebuild; its tokens already streamed
+            sched.record(adm.slot, tok)
+            epoch_tokens += 1
+            # Dedup by rid, like evictions: a request admitted just
+            # before a world break whose first out doc never landed is
+            # re-admitted as fresh on replay, and survivors' counters
+            # persist across epochs — without the set, admitted/ttft
+            # would over-count exactly the break-coincident requests.
+            if adm.req.rid in totals["admitted_rids"]:
+                continue
+            totals["admitted_rids"].add(adm.req.rid)
+            reg.counter("serve.admitted").inc()
+            if busy_before > 0:
+                # The continuous-batching moment: this request entered
+                # while other slots were mid-decode.
+                reg.counter("serve.admitted_while_busy").inc()
+            if adm.req.arrival:
+                reg.histogram("serve.ttft_ms").observe(
+                    max(time.time() - adm.req.arrival, 0.0) * 1000.0
+                )
+        evictions = sched.evict_finished()
+
+        # -- one decode iteration over the live slots ----------------
+        active = sorted(sched.active)
+        if active:
+            t0 = time.monotonic()
+            toks = engine.step(active)
+            step_ms = (time.monotonic() - t0) * 1000.0
+            for slot in active:
+                sched.record(slot, toks[slot])
+                reg.histogram("serve.tpot_ms").observe(step_ms)
+            epoch_tokens += len(active)
+            evictions += sched.evict_finished()
+
+        # -- stream results (leader only writes; peers computed the
+        # identical tokens and discard them) -------------------------
+        if is_leader:
+            for slot in sorted(sched.active):
+                act = sched.active[slot]
+                n = len(act.emitted)
+                # Batched streaming: republishing the full token list
+                # every step is O(T^2) signed bytes per request.  The
+                # first token goes out immediately (ttft is real), then
+                # every stream_every-th; eviction publishes the rest.
+                # A world break between publishes costs at most
+                # stream_every tokens of deterministic recompute.
+                if n <= 1 or n % stream_every == 0:
+                    _publish_out(ctx.kv, act.req.rid,
+                                 tokens=act.emitted, done=False,
+                                 epoch=epoch,
+                                 admitted_step=act.admitted_step)
+        for ev in evictions:
+            if is_leader:
+                _publish_out(ctx.kv, ev.rid, tokens=ev.tokens,
+                             done=True, epoch=epoch,
+                             admitted_step=ev.admitted_step,
+                             finished_step=step, reason=ev.reason)
+            # Dedup by rid: a request a peer finished just before a
+            # world break (its done doc never published) is replayed
+            # and finished AGAIN on that peer — without the set, its
+            # completed/evicted accounting would diverge from the
+            # other ranks'.
+            if ev.rid not in totals["done_rids"]:
+                totals["done_rids"].add(ev.rid)
+                reg.counter("serve.evicted").inc()
+                totals["completed"] += 1
+
+        # -- gauges + progress beat ----------------------------------
+        reg.gauge("serve.queue_depth").set(sched.queue_depth)
+        reg.gauge("serve.active_slots").set(sched.active_slots)
+        elapsed = max(time.monotonic() - epoch_t0, 1e-6)
+        reg.gauge("serve.tokens_per_sec").set(epoch_tokens / elapsed)
+        reg.counter("serve.steps").inc()
+        totals["tokens"] += len(active) + sum(
+            1 for a in admissions if not a.resume
+        )
+        obs_progress.tick()
+
+        if sdoc["stop"] and sched.idle():
+            LOG.info("serving drained at epoch %d step %d", epoch, step)
+            return {
+                "rank": ctx.rank,
+                "epoch": epoch,
+                "steps": step,
+                "completed": totals["completed"],
+                "tokens": totals["tokens"],
+                "admitted_while_busy": int(
+                    reg.counter("serve.admitted_while_busy").value
+                ),
+            }
+        if not active and not admissions and not sdoc["new"] and is_leader:
+            # Idle pacing: peers are paced by the schedule fetch; the
+            # leader throttles itself so an empty queue costs a few
+            # KV gets per idle_secs, not a busy loop.
+            time.sleep(idle_secs)
+
+
+def serve_worker(spec: Optional[dict] = None):
+    """The per-rank serving entry: run continuous-batching inference
+    until the drain sentinel, surviving world re-formations.
+
+    Launch with :class:`ServeJob` (python API), ``hvdrun --elastic
+    --serve`` (CLI), or any elastic launcher wiring that serves this
+    function.  Requires the elastic context (the request plane IS the
+    launcher's KV store)."""
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    from .. import elastic  # noqa: PLC0415
+    from ..models.transformer import gpt  # noqa: PLC0415
+    from .engine import SlotEngine  # noqa: PLC0415
+
+    merged = dict(DEFAULT_SPEC)
+    merged.update(spec or {})
+    spec = merged
+    ctx = elastic.context()
+    if not hasattr(ctx, "kv"):
+        raise RuntimeError(
+            "serve_worker needs the elastic launcher (the request log "
+            "and result streams live in its KV store); run it via "
+            "ServeJob or `hvdrun --elastic --serve`"
+        )
+
+    obs_progress.set_phase("compile")
+    import jax  # noqa: PLC0415
+
+    model = gpt(spec["size"], **spec.get("overrides", {}))
+    dummy = jnp.zeros((1, min(8, model.cfg.max_len)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(spec["seed"]), dummy)
+    engine = SlotEngine(model.cfg, params, spec["num_slots"],
+                        spec.get("max_len"))
+    totals = {"completed": 0, "tokens": 0, "done_rids": set(),
+              "admitted_rids": set()}
+    while True:
+        try:
+            return _serve_epoch(ctx, engine, spec, totals)
+        except HorovodShutdownError as exc:
+            LOG.warning("serving world broke (%s); re-forming", exc)
+            ctx.notify_world_broken()
+            reg = get_registry()
+            reg.counter("serve.world_breaks").inc()
+            continue
+
+
+class ServeJob:
+    """Python-API driver: one object that owns the launcher side of a
+    serving job — KV store, ingest pump, elastic worker fleet — and
+    hands back a :class:`ServeClient` for submitting and streaming.
+
+    ::
+
+        job = ServeJob({"size": "nano", "num_slots": 4}, np=2,
+                       env={"JAX_PLATFORMS": "cpu"})
+        job.start()
+        rid = job.client.submit([5, 17, 3], max_new_tokens=8)
+        tokens = job.client.result(rid)["tokens"]
+        job.stop()
+
+    The elastic fleet runs ``serve_worker`` through the standard
+    ``elastic.worker`` entry, so rank death -> blacklist -> respawn ->
+    replay all behave exactly as a training job's would.
+    """
+
+    def __init__(self, spec: Optional[dict] = None, np: int = 1, *,
+                 env: Optional[Dict[str, str]] = None,
+                 max_retries: int = 3,
+                 min_workers: Optional[int] = None,
+                 heartbeat_timeout: float = 60.0,
+                 progress_timeout: float = 300.0,
+                 blacklist_cooldown: float = 0.5,
+                 live_stats_secs: Optional[float] = None,
+                 live_history: Optional[str] = None,
+                 timeout: Optional[float] = None):
+        from ..run.rendezvous import KVStoreServer  # noqa: PLC0415
+
+        self.spec = dict(DEFAULT_SPEC)
+        self.spec.update(spec or {})
+        self.np = np
+        self._env = dict(env or {})
+        self._launch_kw = dict(
+            max_retries=max_retries, min_workers=min_workers,
+            heartbeat_timeout=heartbeat_timeout,
+            progress_timeout=progress_timeout,
+            blacklist_cooldown=blacklist_cooldown,
+            live_stats_secs=live_stats_secs, live_history=live_history,
+            job_timeout=timeout,
+        )
+        self._server = KVStoreServer()
+        self._server.start()
+        self._pump = IngestPump(self._server)
+        self.addr = f"127.0.0.1:{self._server.port}"
+        self.client = ServeClient(self.addr, self._server.secret)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._results: Optional[Dict[int, Any]] = None
+        self._job = None
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def secret(self) -> str:
+        return self._server.secret
+
+    def start(self) -> "ServeJob":
+        import cloudpickle  # noqa: PLC0415
+
+        from ..run.api import _pickle_func  # noqa: PLC0415
+        from ..run.rendezvous import KVStoreClient  # noqa: PLC0415
+        from ..run.runner import launch_elastic_job  # noqa: PLC0415
+
+        kv = KVStoreClient(self.addr, self._server.secret)
+        kv.put("elastic", "func",
+               _pickle_func(serve_worker, (self.spec,), {}))
+        self._pump.start()
+
+        def _run():
+            try:
+                job = launch_elastic_job(
+                    [sys.executable, "-m", "horovod_tpu.elastic.worker"],
+                    self.np, kv_server=self._server, env=self._env,
+                    **self._launch_kw,
+                )
+                results: Dict[int, Any] = {}
+                for rank in job.world:
+                    blob = kv.wait("elastic", f"result_{rank}",
+                                   timeout=30)
+                    ok, value = cloudpickle.loads(blob)
+                    if not ok:  # pragma: no cover - monitor aborts first
+                        raise RuntimeError(f"rank {rank} raised:\n{value}")
+                    results[rank] = value
+                self._results = results
+                self._job = job
+            except BaseException as exc:  # surfaced by stop()/wait()
+                self._error = exc
+
+        self._thread = threading.Thread(
+            target=_run, name="hvdtpu_serve_job", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 180.0) -> Tuple[Dict[int, Any], Any]:
+        """Drain and tear down: raise the stop sentinel, wait for the
+        fleet to finish, return ``(per_rank_results, ElasticJobResult)``.
+        """
+        self.client.stop()
+        return self.wait(timeout)
+
+    def wait(self, timeout: float = 180.0) -> Tuple[Dict[int, Any], Any]:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"serving fleet did not drain within {timeout}s"
+                )
+            self._thread = None
+        try:
+            if self._error is not None:
+                raise self._error
+            return self._results or {}, self._job
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        """Release launcher-side resources (idempotent)."""
+        try:
+            self._pump.stop()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        try:
+            self._server.stop()
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+    def __enter__(self) -> "ServeJob":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        try:
+            if exc[0] is None:
+                self.stop()
+        finally:
+            self.shutdown()

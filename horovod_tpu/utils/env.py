@@ -107,6 +107,15 @@ CKPT_REPLICA_CHUNK_KB = "HVDTPU_CKPT_REPLICA_CHUNK_KB"
 DEFAULT_REPLICA_CHUNK_KB = 1024
 CKPT_COMMIT_TIMEOUT = "HVDTPU_CKPT_COMMIT_TIMEOUT_SECS"
 DEFAULT_CKPT_COMMIT_TIMEOUT = 120.0
+# Serving plane (serve/): fleet-wide model geometry the `hvdrun
+# --elastic --serve` launcher forwards to every serving rank (the
+# python -m horovod_tpu.serve worker reads them as flag fallbacks).
+# SERVE_SEED must be identical on every rank — the replicated-params
+# determinism the identical-schedule invariant rests on.
+SERVE_MODEL = "HVDTPU_SERVE_MODEL"
+SERVE_SLOTS = "HVDTPU_SERVE_SLOTS"
+SERVE_MAX_LEN = "HVDTPU_SERVE_MAX_LEN"
+SERVE_SEED = "HVDTPU_SERVE_SEED"
 
 
 def resolve_rank(default=None):
