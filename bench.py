@@ -265,19 +265,10 @@ def _enable_compile_cache() -> None:
 
 _enable_compile_cache()
 
-# Peak dense-matmul FLOP/s per chip (bf16 on MXU; fp32 runs at 1/4 via
-# bf16x3 passes or worse). Sources: public TPU spec sheets.
-PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# Peak dense-matmul FLOP/s per chip: ONE table, shared with the live
+# MFU profiler (obs/profile.py) so the bench headline and the perf.mfu
+# gauge can never disagree about a chip's peak.
+from horovod_tpu.obs.profile import PEAK_FLOPS  # noqa: E402
 
 
 def peak_flops_per_chip(device, dtype: str) -> float:
@@ -754,6 +745,13 @@ def _serve_bench(args) -> int:
         out["serve"]["completed_per_rank"] = {
             str(r): results[r]["completed"] for r in ranks
         }
+        # Decode-step MFU from the serving ranks' own cost_analysis()
+        # accounting (estimate-flagged on CPU) — the leader's view; the
+        # numbers are near-identical across ranks by the identical-
+        # schedule invariant.
+        perf = results[ranks[0]].get("perf")
+        if perf:
+            out["perf"] = perf
         # Continuous batching actually happened: admissions that entered
         # while other slots were mid-decode (max across ranks — the
         # counts are identical by the schedule invariant).
@@ -862,7 +860,7 @@ def collect_engine_gauges() -> dict:
     try:
         from horovod_tpu.obs import get_registry
 
-        wanted_prefixes = ("autotune.", "overlap.")
+        wanted_prefixes = ("autotune.", "overlap.", "perf.")
         wanted_names = {
             "engine.negotiation_skip_rate",
             "engine.cache_hit_rate",
@@ -1081,12 +1079,17 @@ def main() -> int:
             )
         except Exception:
             donation_audit = None
-        try:
-            flops_per_step_per_chip = float(
-                compiled.cost_analysis()["flops"]
-            )
-        except Exception:
-            flops_per_step_per_chip = float("nan")
+        from horovod_tpu.obs.profile import (  # noqa: PLC0415
+            flops_from_compiled,
+        )
+
+        # flops_from_compiled, not cost_analysis()["flops"]: newer jax
+        # returns a list-of-dicts and the bare subscript would silently
+        # demote every record to the analytic fallback.
+        _ca_flops = flops_from_compiled(compiled)
+        flops_per_step_per_chip = (
+            float(_ca_flops) if _ca_flops is not None else float("nan")
+        )
         step = compiled
 
         loss = None
@@ -1129,6 +1132,28 @@ def main() -> int:
     peak = peak_flops_per_chip(jax.devices()[0], args.dtype)
     achieved_flops_per_chip = flops_per_step_per_chip * args.iters / elapsed
     mfu = achieved_flops_per_chip / peak
+
+    # The live MFU accountant (obs/profile.py): same division, but
+    # published as perf.* gauges and embedded estimate-flagged in the
+    # record — cost_analysis() FLOPs when the backend exposes them,
+    # the analytic per-model formula otherwise, so even a CPU run
+    # exercises the full MFU pipeline end-to-end.
+    from horovod_tpu.obs.profile import (  # noqa: PLC0415
+        MFUProfiler, analytic_step_flops,
+    )
+
+    prof_flops = (flops_per_step_per_chip
+                  if np.isfinite(flops_per_step_per_chip) else None)
+    prof_source = "cost_analysis"
+    if prof_flops is None:
+        prof_flops = analytic_step_flops(
+            args.model, args.batch_size,
+            args.seq_len if is_gpt else None, args.image_size,
+        )
+        prof_source = "analytic"
+    profiler = MFUProfiler(prof_flops, jax.devices()[0].device_kind,
+                           args.dtype, source=prof_source)
+    profiler.observe(elapsed / args.iters)
     unit = "tokens/sec/chip" if is_gpt else "images/sec/chip"
     out = {
         "metric": f"{args.model}_{args.dtype}_{unit.replace('/', '_per_')}",
@@ -1142,6 +1167,9 @@ def main() -> int:
         ),
         "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
         "device": jax.devices()[0].device_kind,
+        # Always present, estimate-flagged off-TPU: the record-embedded
+        # view of the live perf.* gauges (obs/profile.py).
+        "perf": profiler.summary(),
     }
     if not is_gpt and np.isfinite(flops_per_step_per_chip):
         out["flops_per_image"] = round(

@@ -95,7 +95,7 @@ if [ "${1:-full}" = "quick" ]; then
         -x -q
     echo "== quick tier: observability plane =="
     python -m pytest tests/test_obs.py tests/test_obs_live.py \
-        tests/test_postmortem.py -x -q
+        tests/test_postmortem.py tests/test_trace.py -x -q
     echo "== quick tier: unit + multiprocess suite minus -m full =="
     # test_elastic.py / test_obs*.py and the injection case already ran
     # above — don't pay for the multiprocess chaos cases twice per commit.
@@ -105,6 +105,7 @@ if [ "${1:-full}" = "quick" ]; then
         --ignore=tests/test_obs.py \
         --ignore=tests/test_obs_live.py \
         --ignore=tests/test_postmortem.py \
+        --ignore=tests/test_trace.py \
         --deselect "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks"
     exit 0
 fi
@@ -841,6 +842,74 @@ print(f"serve bench record OK: {parsed['value']} tok/s, "
       f"ttft p50 {serve['ttft_ms']['p50']}ms")
 EOF
 rm -rf "$SV_TMP"
+
+# Trace gate (ISSUE 11): request-level tracing + the live MFU
+# profiler.  The unit suite + hvdtpu-lint over the new obs files, a
+# 2-proc training smoke through the real launcher CLI with --trace
+# (engine negotiate/execute spans from BOTH ranks must land on the
+# merged waterfall, and the launcher's end-of-job merge must write a
+# schema-valid decomposition report), and the 2-proc serve chaos
+# acceptance: leader killed mid-stream, the replayed request's spans
+# from both incarnations appear stitched by epoch, every decomposed
+# ttft's components sum to the histogram's sample within 5%, and the
+# per-rank record embeds a cost_analysis()-derived perf.mfu
+# (estimate-flagged on CPU).
+echo "== trace gate: unit suite + lint over the tracing/profiler surface =="
+python -m pytest tests/test_trace.py -x -q -m "not multiprocess"
+python -m horovod_tpu.analysis horovod_tpu/obs/trace.py \
+    horovod_tpu/obs/trace_merge.py horovod_tpu/obs/profile.py \
+    --baseline horovod_tpu/analysis/baseline.json
+echo "== trace gate: 2-proc launcher smoke with --trace -> engine lanes =="
+TR_TMP=$(mktemp -d)
+cat > "$TR_TMP/worker.py" <<'EOF'
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+for i in range(4):
+    hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name=f"t{i}")
+hvd.shutdown()
+EOF
+# both engines must land engine-lane spans: the python engine records
+# the negotiate/execute split, the native engine per-op
+# enqueue->completion spans (its negotiation runs inside the C++ lib)
+for ENGINE in python auto; do
+    rm -f "$TR_TMP"/spans.*.json "$TR_TMP"/trace_*.json
+    JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    HVDTPU_EAGER_ENGINE="$ENGINE" \
+        timeout 300 python -m horovod_tpu.run -np 2 --trace "$TR_TMP/" \
+        python "$TR_TMP/worker.py"
+    python - "$TR_TMP" "$ENGINE" <<'EOF'
+import glob, json, sys
+
+d, engine = sys.argv[1], sys.argv[2]
+rank_files = glob.glob(f"{d}/spans.*rank*.json")
+assert len(rank_files) >= 2, f"expected 2 per-rank span files: {rank_files}"
+wf = json.load(open(f"{d}/trace_waterfall.json"))
+xs = [e for e in wf if e.get("ph") == "X"]
+assert {e["args"]["rank"] for e in xs} >= {"0", "1"}, (
+    "waterfall is missing a rank's spans")
+lanes = {m["args"]["name"] for m in wf
+         if m.get("ph") == "M" and m["name"] == "process_name"}
+assert "engine" in lanes, f"no engine step lane, lanes={lanes}"
+want = ("negotiate", "execute") if engine == "python" else \
+    ("negotiate", "execute", "collective")
+assert any(e["name"] in want for e in xs), "no engine-lane spans"
+rep = json.load(open(f"{d}/trace_report.json"))
+assert rep["schema"] == "hvdtpu-trace-report-v1", rep["schema"]
+assert rep["missing_ranks"] == [], rep["missing_ranks"]
+print(f"trace gate OK ({engine} engine): {len(xs)} spans across "
+      f"lanes {sorted(lanes)}")
+EOF
+done
+rm -rf "$TR_TMP"
+echo "== trace gate: 2-proc serve chaos -> stitched waterfall + ttft decomposition + mfu =="
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 420 python -m pytest \
+    "tests/test_trace.py::test_trace_acceptance_leader_kill_waterfall_and_mfu" \
+    -x -q
 
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must

@@ -29,14 +29,26 @@ quantitative):
   flushed on every death path (signals, excepthooks, exit), and the
   launcher-side analyzer that correlates all ranks' rings into a
   root-cause verdict when the job dies.
+* **request-level tracing** (obs/trace.py worker+launcher side,
+  obs/trace_merge.py consumer) — Dapper-style spans keyed by request
+  id (and by step for training), deterministically sampled, dumped per
+  rank over the shared pathspec rules and merged into a per-request
+  Chrome-trace waterfall plus a ttft/tpot latency-decomposition
+  report.
+* **MFU profiler** (obs/profile.py) — model-FLOPs accounting
+  (compiled ``cost_analysis()`` with analytic fallbacks) over measured
+  step time, published live as ``perf.mfu`` / ``perf.model_tflops`` /
+  ``perf.step_ms`` gauges.
 
 See docs/observability.md and docs/postmortem.md.
 """
 
 from . import flightrec  # noqa: F401
+from . import profile  # noqa: F401
 from . import progress  # noqa: F401
 from . import straggler  # noqa: F401
 from . import stream  # noqa: F401
+from . import trace  # noqa: F401
 from .registry import (  # noqa: F401
     METRICS_DUMP_ENV,
     Counter,
@@ -64,8 +76,10 @@ __all__ = [
     "dump_flight_recorder",
     "install_death_hooks",
     "flightrec",
+    "profile",
     "progress",
     "straggler",
     "stream",
+    "trace",
     "set_phase",
 ]

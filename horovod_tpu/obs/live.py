@@ -71,6 +71,43 @@ def _prom_name(name: str) -> str:
     return "hvdtpu_" + out
 
 
+# Exposition HELP text for the series operators actually alert on; the
+# rest get an honest generic line.  Keyed by instrument name (pre-
+# prom-mangling) so the map reads like the metric docs.
+_METRIC_HELP = {
+    "serve.ttft_ms": "Time to first token per request, milliseconds",
+    "serve.tpot_ms": "Per-decode-step latency per emitted token, "
+                     "milliseconds",
+    "serve.tokens_per_sec": "Sliding wall-clock window token "
+                            "throughput (shared timestamps with the "
+                            "trace plane's decode spans)",
+    "serve.queue_depth": "Requests admitted to the log but not yet in "
+                         "a decode slot",
+    "serve.active_slots": "Decode slots currently generating",
+    "perf.mfu": "Model FLOP/s utilization: model FLOPs per step over "
+                "measured step time over device peak (see "
+                "perf.mfu_estimate)",
+    "perf.mfu_estimate": "1 when perf.mfu's device peak is an "
+                         "estimate (CPU/unknown chip), 0 on known TPUs",
+    "perf.model_tflops": "Achieved model TFLOP/s from the compiled "
+                         "artifact's cost analysis",
+    "perf.step_ms": "Last measured step time, milliseconds",
+    "engine.cycle_time_ms": "Background negotiation-loop cycle time, "
+                            "milliseconds",
+    "engine.negotiation_ms": "Control-plane exchange time per cycle, "
+                             "milliseconds",
+}
+
+
+def _prom_help(name: str, kind: str) -> str:
+    text = _METRIC_HELP.get(
+        name, f"horovod_tpu {kind} {name} (per-rank instrument, "
+              f"obs/registry.py)"
+    )
+    # Exposition escaping for HELP: backslash and newline only.
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
 class LiveAggregator:
     """Merged job-level view of every rank's streamed snapshots.
     Thread-safe: the HTTP handler renders from scraper threads while the
@@ -190,6 +227,9 @@ class LiveAggregator:
         serve = self._serve_part(views)
         if serve:
             parts.append(serve)
+        perf = self._perf_part(views)
+        if perf:
+            parts.append(perf)
         return "live[" + time.strftime("%H:%M:%S") + "] " + " | ".join(parts)
 
     @staticmethod
@@ -332,6 +372,37 @@ class LiveAggregator:
             token += f" ttft p50 {ttft:.0f}ms"
         return token
 
+    @staticmethod
+    def _perf_part(views) -> Optional[str]:
+        """One digest token for the MFU profiler (obs/profile.py):
+        where the FLOPs are going, live — absent on jobs that never
+        armed a profiler.  Min across ranks (the fleet is only as fast
+        as its slowest chip), tilde-marked when the device peak is an
+        estimate (CPU dev mode): an estimated MFU must never read like
+        a measured one."""
+        mfu = None
+        estimate = False
+        step_ms = None
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                if name == "perf.mfu":
+                    v = float(m["value"])
+                    mfu = v if mfu is None else min(mfu, v)
+                elif name == "perf.mfu_estimate" and float(m["value"]):
+                    estimate = True
+                elif name == "perf.step_ms":
+                    v = float(m["value"])
+                    step_ms = v if step_ms is None else max(step_ms, v)
+        if mfu is None:
+            return None
+        token = f"mfu {'~' if estimate else ''}{mfu:.2f}"
+        if estimate:
+            token += " (est)"
+        if step_ms is not None:
+            token += f" step {step_ms:.0f}ms"
+        return token
+
     # ---------------------------------------------------------- history
 
     def history_row(self, expected_ranks: Optional[int] = None) -> dict:
@@ -390,6 +461,10 @@ class LiveAggregator:
             entries = by_name[name]
             kind = entries[0][0]["type"]
             prom = _prom_name(name)
+            # HELP before TYPE before samples, once per family: real
+            # scrapers warn on bare samples, and a second HELP/TYPE for
+            # the same name is a hard parse error.
+            lines.append(f"# HELP {prom} " + _prom_help(name, kind))
             lines.append(
                 f"# TYPE {prom} "
                 + {"counter": "counter", "gauge": "gauge",
@@ -418,14 +493,22 @@ class LiveAggregator:
                     )
         # Aggregator-level meta series: scrapers get liveness and the
         # straggler verdict without re-deriving them from raw counters.
+        lines.append("# HELP hvdtpu_live_ranks_reporting Ranks whose "
+                     "live stream has reported at least once")
         lines.append("# TYPE hvdtpu_live_ranks_reporting gauge")
         lines.append(f"hvdtpu_live_ranks_reporting {len(merged)}")
+        lines.append("# HELP hvdtpu_live_straggler_rank Rank the "
+                     "shared straggler attribution currently blames "
+                     "(-1 = none)")
         lines.append("# TYPE hvdtpu_live_straggler_rank gauge")
         lines.append(
             "hvdtpu_live_straggler_rank "
             + (str(strag["rank"]) if strag else "-1")
         )
         now = time.monotonic()
+        lines.append("# HELP hvdtpu_live_update_age_seconds Seconds "
+                     "since each rank's newest incarnation last "
+                     "streamed a snapshot")
         lines.append("# TYPE hvdtpu_live_update_age_seconds gauge")
         for rank, view in merged.items():
             lines.append(

@@ -21,6 +21,7 @@ __all__ = [
     "format_summary_table",
     "straggler_section",
     "fabric_section",
+    "perf_section",
     "summarize",
 ]
 
@@ -264,6 +265,35 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
                     f", {short} p50 {m.get('p50') or 0:.3g}ms "
                     f"p99 {m.get('p99') or 0:.3g}ms"
                 )
+        rows.append(row)
+    return "\n".join(rows) if rows else None
+
+
+def perf_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job MFU report (obs/profile.py gauges): per-rank model
+    FLOP/s utilization, achieved TFLOP/s and step time — estimate-
+    marked when the device peak was a guess (CPU dev mode), so a
+    placeholder number can never read like a hardware claim.  None when
+    no rank armed a profiler."""
+    rows = []
+    for label in sorted(dumps, key=_rank_sort_key):
+        vals = {}
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if name in ("perf.mfu", "perf.model_tflops", "perf.step_ms",
+                        "perf.mfu_estimate"):
+                vals[name] = float(m["value"])
+        if "perf.mfu" not in vals:
+            continue
+        est = bool(vals.get("perf.mfu_estimate"))
+        row = (f"rank {label}: mfu {'~' if est else ''}"
+               f"{vals['perf.mfu']:.3f}"
+               + (" (peak is an estimate — not a hardware claim)"
+                  if est else ""))
+        if vals.get("perf.model_tflops") is not None:
+            row += f", {vals['perf.model_tflops']:.3g} TFLOP/s"
+        if vals.get("perf.step_ms") is not None:
+            row += f", step {vals['perf.step_ms']:.3g}ms"
         rows.append(row)
     return "\n".join(rows) if rows else None
 

@@ -486,6 +486,25 @@ class OverlapPlan:
             for b in self.layout.buckets:
                 reg.gauge("overlap.bucket_bytes",
                           bucket=str(b.index)).set(b.nbytes)
+            import time  # noqa: PLC0415
+
+            from ..obs import trace as obs_trace  # noqa: PLC0415
+
+            if obs_trace.enabled():
+                # Bucket-layout annotation on the trace plane: the
+                # per-bucket wire time itself lives inside the compiled
+                # program (inspect_schedule proves the overlap from the
+                # HLO), but the merged waterfall still needs the layout
+                # — one instant span per bucket keyed by index — so an
+                # engine/step lane can be read against the bucket
+                # shapes that produced it.
+                t = time.time()
+                for b in self.layout.buckets:
+                    obs_trace.add_span(
+                        "overlap", f"bucket{b.index}", t, t,
+                        bucket=b.index, bytes=b.nbytes,
+                        leaves=len(b.sizes), mode=self.mode,
+                    )
         except Exception:
             # Metrics are observability, not correctness: a plan built in
             # a stripped environment (no obs plane) must still train.

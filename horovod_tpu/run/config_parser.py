@@ -49,6 +49,8 @@ _ARG_ENV_MAP = {
     "flightrec_dump": (envmod.FLIGHTREC_DUMP, "metrics.flightrec-dump"),
     "live_stats_secs": (envmod.LIVE_STATS, "metrics.live-stats-secs"),
     "alert_skew_ms": (envmod.ALERT_SKEW, "metrics.alert-skew-ms"),
+    "trace": (envmod.TRACE, "trace.target"),
+    "trace_sample_rate": (envmod.TRACE_SAMPLE_RATE, "trace.sample-rate"),
     "no_stall_check": (envmod.STALL_CHECK_DISABLE, "stall-check.disable"),
     "stall_check_warning_time_seconds": (
         envmod.STALL_CHECK_TIME,

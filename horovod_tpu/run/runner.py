@@ -347,6 +347,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "collective's first-to-last rank arrival skew exceeds this "
              "many milliseconds (default 0 = accumulate silently).",
     )
+    obs_group.add_argument(
+        "--trace", action=_StoreOverrideAction, dest="trace",
+        default=None, metavar="TARGET",
+        help="Request-level distributed tracing (HVDTPU_TRACE): each "
+             "rank dumps its span ring to a file derived from TARGET "
+             "(directory, {rank} template, or plain path getting a "
+             "rank tag).  At job end the launcher merges every rank's "
+             "spans (its own ingest-side spans included) into a "
+             "per-request Chrome-trace waterfall plus a ttft/tpot "
+             "latency-decomposition report.",
+    )
+    obs_group.add_argument(
+        "--trace-sample-rate", type=float, action=_StoreOverrideAction,
+        dest="trace_sample_rate", default=None,
+        help="Fraction of requests traced (HVDTPU_TRACE_SAMPLE_RATE, "
+             "default 1.0).  The verdict is a pure function of the "
+             "request id, so every rank samples the identical set.",
+    )
 
     stall = parser.add_argument_group("stall check")
     stall.add_argument(
@@ -839,6 +857,7 @@ def launch_job(
         # round (workers flush at exit) before the server goes away.
         _stop_live_plane(live_plane, live_server)
         merged = _merge_rank_timelines(base_env)
+        _merge_rank_traces(base_env, np)
         # On abnormal end the dead ranks' flight recorders already
         # flushed (signal handlers ran during wait()'s terminate);
         # correlate them into postmortem.json and print the verdict.
@@ -850,6 +869,18 @@ def launch_job(
             ),
             timeline_path=merged,
         )
+
+
+def _arm_launcher_trace_env(env: Dict[str, str]) -> None:
+    """The launcher is a span producer too (ingest pump, client result
+    fetches): flag-derived trace knobs must land in ITS os.environ, not
+    just the workers' env dict, or ``--trace`` records no launcher-side
+    spans at all — and a flag-given sample rate would diverge from the
+    workers', violating the identical-verdict invariant obs/trace.py
+    documents."""
+    for var in (envmod.TRACE, envmod.TRACE_SAMPLE_RATE):
+        if env.get(var):
+            os.environ[var] = env[var]
 
 
 def _clean_stale_obs_files(env: Dict[str, str]) -> None:
@@ -864,10 +895,27 @@ def _clean_stale_obs_files(env: Dict[str, str]) -> None:
 
     for var, stem in ((envmod.TIMELINE, "trace"),
                       (envmod.METRICS_DUMP, "metrics"),
-                      (envmod.FLIGHTREC_DUMP, "flightrec")):
+                      (envmod.FLIGHTREC_DUMP, "flightrec"),
+                      (envmod.TRACE, "spans")):
         raw = env.get(var)
         if not raw:
             continue
+        if var == envmod.TRACE and "{rank}" not in raw:
+            # A previous run's merged waterfall/report — and the
+            # launcher's own span file, whose ``launcher`` tag has no
+            # digits for rank_of_path to anchor on — would read as
+            # THIS run's; none of them survive the rank-tag loop
+            # below, so remove them here.
+            from ..obs import trace_merge  # noqa: PLC0415
+
+            doomed = [pathspec.resolve(raw, "spans", "launcher",
+                                       epoch="")]
+            doomed += list(trace_merge.merged_output_paths(raw))
+            for path in doomed:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
         if var == envmod.FLIGHTREC_DUMP:
             # A previous crashed run's verdict would read as THIS
             # run's — it is ours by name, remove it from wherever
@@ -903,6 +951,39 @@ def _clean_stale_obs_files(env: Dict[str, str]) -> None:
                     os.remove(path)
         except OSError:
             pass
+
+
+def _merge_rank_traces(env: Dict[str, str], np: int) -> Optional[dict]:
+    """Flush the launcher's own spans (ingest pump, client result
+    fetches — tagged ``launcher``) and merge every rank's span file
+    into the per-request waterfall + latency-decomposition report
+    (``--trace``).  Best-effort like the timeline merge: a trace
+    failure must never turn a finished job into an error."""
+    raw = env.get(envmod.TRACE)
+    if not raw:
+        return None
+    try:
+        from ..obs import trace as obs_trace  # noqa: PLC0415
+        from ..obs import trace_merge  # noqa: PLC0415
+
+        if obs_trace.get_buffer().recorded:
+            # Explicit path: the dump target may live only in the
+            # workers' env dict, not this process's os.environ.
+            obs_trace.flush(obs_trace.resolve_dump_path(raw))
+        out = trace_merge.merge_glob(raw, expected_ranks=np)
+        if out is not None:
+            doc = out["doc"]
+            line = (f"[trace] waterfall {out['waterfall']} "
+                    f"({out['events']} spans, "
+                    f"{len(doc['requests'])} requests); "
+                    f"report {out['report']}")
+            if doc["missing_ranks"]:
+                line += f"; MISSING ranks {doc['missing_ranks']}"
+            print(line, flush=True)
+        return out
+    except Exception as exc:  # pragma: no cover - defensive
+        LOG.warning("trace merge failed: %s", exc)
+        return None
 
 
 def _merge_rank_timelines(env: Dict[str, str]) -> Optional[str]:
@@ -1376,6 +1457,7 @@ def launch_elastic_job(
         # streaming writer format keeps a killed rank's file loadable,
         # and its epoch-tagged lane is the story of why it died.
         merged = _merge_rank_timelines(base_env)
+        _merge_rank_traces(base_env, np)
         _finish_black_box(
             black_box, owns_black_box, failed=job_failed, np=np,
             live_history=(
@@ -1439,6 +1521,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     env: Dict[str, str] = {}
     config_parser.set_env_from_args(env, args)
+    _arm_launcher_trace_env(env)
     summary_tmp = None
     if getattr(args, "stats_summary", False) and not (
         env.get(envmod.METRICS_DUMP) or os.environ.get(envmod.METRICS_DUMP)
@@ -1554,3 +1637,7 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if serve is not None:
         print("\n== serving plane ==")
         print(serve)
+    perf = obs_summary.perf_section(dumps)
+    if perf is not None:
+        print("\n== mfu / model flops ==")
+        print(perf)

@@ -45,6 +45,7 @@ from ..exceptions import HorovodShutdownError
 from ..obs import get_registry
 from ..obs import flightrec as obs_flightrec
 from ..obs import progress as obs_progress
+from ..obs import trace as obs_trace
 from ..testing.faults import maybe_fail
 from ..utils import env as envmod
 from ..utils.logging import get_logger
@@ -676,6 +677,8 @@ class EagerEngine:
                 requests=misses, tuned_params=params
             ).serialize()
 
+        trace_on = obs_trace.enabled()
+        t_negw = time.time() if trace_on else 0.0
         t_neg = time.monotonic()
         shutdown_ranks, joined_ranks, bits, all_lists = self._exchange(
             payload, shutdown, joined
@@ -684,6 +687,12 @@ class EagerEngine:
         self._m_queue_depth.set(len(self._table))
         self.stats["cycles"] += 1
         self.stats["negotiated_cycles"] += 1
+        if trace_on:
+            # Training-side tracing, step ≙ engine cycle: the same
+            # merged view that decomposes a serve request decomposes a
+            # training step into negotiation vs wire time.
+            obs_trace.add_span("engine", "negotiate", t_negw,
+                               time.time(), step=self.stats["cycles"])
 
         state = self._controller
         state.shutdown_ranks.update(shutdown_ranks)
@@ -784,10 +793,17 @@ class EagerEngine:
         )
         # Cached responses execute first, then freshly negotiated ones —
         # the same deterministic order on every rank.
+        t_execw = time.time() if trace_on else 0.0
         for resp in cached_responses:
             self._perform_operation(resp)
         for resp in responses:
             self._perform_operation(resp)
+        if trace_on and (cached_responses or responses):
+            obs_trace.add_span(
+                "engine", "execute", t_execw, time.time(),
+                step=self.stats["cycles"],
+                responses=len(cached_responses) + len(responses),
+            )
         if self._pm is not None:
             for resp in cached_responses + responses:
                 self._pm.record_bytes(_response_bytes(resp))
@@ -1024,9 +1040,17 @@ class EagerEngine:
         self._m_completed.inc(done)
         self._m_fusion_bytes.observe(_response_bytes(first))
         obs_progress.tick(done)
+        t_execw = time.time()
         for resp in plan[1:]:
             self._perform_operation(resp)
             self.stats["cached_responses"] += len(resp.tensor_names)
+        if obs_trace.enabled():
+            # Replay cycles have no negotiate span by construction —
+            # in the merged view a replaying engine's lane is wire
+            # time with the negotiation bars gone.
+            obs_trace.add_span("engine", "replay_execute", t_execw,
+                               time.time(), step=self.stats["cycles"],
+                               responses=len(plan))
         if self._pm is not None:
             for resp in plan:
                 self._pm.record_bytes(_response_bytes(resp))

@@ -32,6 +32,7 @@ from ..basics import global_topology
 from ..obs import get_registry
 from ..obs import flightrec as obs_flightrec
 from ..obs import progress as obs_progress
+from ..obs import trace as obs_trace
 from ..testing.faults import maybe_fail
 from ..utils import env as envmod
 from ..utils.logging import get_logger
@@ -302,7 +303,14 @@ class NativeEngine:
             int(reduce_op), int(root_rank), float(prescale), float(postscale),
         )
         with self._lock:
-            self._outstanding[handle] = (fut, dtype_name, name)
+            # Enqueue wall stamp for the trace plane: the C++ engine
+            # negotiates internally, so per-op enqueue->completion is
+            # the finest span Python can honestly record here (the
+            # python engine's negotiate/execute split does not exist at
+            # this boundary — same granularity gap PR-3 documented for
+            # straggler attribution).
+            t0 = time.time() if obs_trace.enabled() else None
+            self._outstanding[handle] = (fut, dtype_name, name, t0)
         self._pump_wake.set()
         return fut
 
@@ -310,7 +318,7 @@ class NativeEngine:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         handle = self.lib.hvdtpu_join()
         with self._lock:
-            self._outstanding[handle] = (fut, None, "join")
+            self._outstanding[handle] = (fut, None, "join", None)
         self._pump_wake.set()
         return fut
 
@@ -368,7 +376,7 @@ class NativeEngine:
                 self._pump_wake.clear()
                 continue
             progressed = False
-            for handle, (fut, dtype_name, name) in items:
+            for handle, (fut, dtype_name, name, t_enq) in items:
                 st = self.lib.hvdtpu_poll(handle)
                 if st == 0:
                     continue
@@ -386,6 +394,10 @@ class NativeEngine:
                         obs_flightrec.record("complete", name=name)
                         self._m_completed.inc()
                         obs_progress.tick()
+                        if t_enq is not None:
+                            obs_trace.add_span("engine", "collective",
+                                               t_enq, time.time(),
+                                               op=name)
                 else:
                     msg = self.lib.hvdtpu_error(handle).decode()
                     obs_flightrec.record("error", name=name,

@@ -92,6 +92,13 @@ class SlotEngine:
         # slot pool); donate it so each step updates in place instead of
         # keeping input and output pools both live.
         self._step_compiled = jax.jit(_step, donate_argnums=(1,))
+        # AOT executable shared by step() and step_flops(): jit's
+        # dispatch cache never sees lower().compile(), so without the
+        # handoff every rank that asks for FLOPs would pay the
+        # full-pool compile a second time on its first real step.
+        self._step_exec = None
+        self._step_flops: Optional[float] = None
+        self._step_flops_known = False
 
     # --------------------------------------------------------- admission
 
@@ -137,7 +144,8 @@ class SlotEngine:
             return {}
         mask = np.zeros(self.num_slots, bool)
         mask[slots] = True
-        toks, self.cache = self._step_compiled(
+        step_fn = self._step_exec or self._step_compiled
+        toks, self.cache = step_fn(
             self.params, self.cache, jnp.asarray(self._cur),
             jnp.asarray(mask),
         )
@@ -147,6 +155,33 @@ class SlotEngine:
             self._cur[s] = toks[s]
             out[s] = int(toks[s])
         return out
+
+    # --------------------------------------------------------- profiling
+
+    def step_flops(self) -> Optional[float]:
+        """Model FLOPs of one ``decode_step`` over the full slot pool,
+        from XLA's cost analysis of the compiled artifact (the same
+        accountant bench.py trusts — post-fusion, per-device).  AOT
+        lowered once and cached; None when the backend exposes no cost
+        model.  The serving MFU gauge divides this by the measured
+        decode-step time, so the number is honest about masked slots:
+        the artifact computes every row whether or not it is live."""
+        if self._step_flops_known:
+            return self._step_flops
+        self._step_flops_known = True
+        try:
+            from ..obs.profile import flops_from_compiled  # noqa: PLC0415
+
+            mask = np.ones(self.num_slots, bool)
+            compiled = self._step_compiled.lower(
+                self.params, self.cache, jnp.asarray(self._cur),
+                jnp.asarray(mask),
+            ).compile()
+            self._step_exec = compiled
+            self._step_flops = flops_from_compiled(compiled)
+        except Exception:
+            self._step_flops = None
+        return self._step_flops
 
     # ------------------------------------------------------------- reset
 

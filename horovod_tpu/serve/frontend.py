@@ -33,6 +33,7 @@ import time
 import uuid
 from typing import List, Optional, Sequence
 
+from ..obs import trace as obs_trace
 from ..run.rendezvous import KVStoreClient
 from ..utils.logging import get_logger
 
@@ -98,6 +99,10 @@ class ServeClient:
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
             "eos_id": None if eos_id is None else int(eos_id),
+            # Client-clock submit stamp: the trace waterfall's first
+            # span (submit -> ingest) is measured against this; the
+            # rid doubles as the request's trace id.
+            "submit_t": time.time(),
         }
         self._kv.put(SCOPE, f"req/{rid}", pickle.dumps(doc))
         return rid
@@ -112,6 +117,7 @@ class ServeClient:
         """Block until the request finishes; raises RuntimeError when
         the server rejected it (the reject reason is in the doc)."""
         deadline = time.monotonic() + timeout
+        t_fetch0 = time.time()
         delay = 0.02
         while time.monotonic() < deadline:
             doc = self.poll(rid)
@@ -120,6 +126,13 @@ class ServeClient:
                     raise RuntimeError(
                         f"request {rid} rejected: {doc['error']}"
                     )
+                # Result-fetch span on the caller's clock (the bench /
+                # CI client runs in the launcher process, so this lands
+                # in the launcher's span dump when tracing is armed).
+                if obs_trace.enabled() and obs_trace.sampled(rid):
+                    obs_trace.add_span(rid, "result_fetch", t_fetch0,
+                                       time.time(),
+                                       tokens=len(doc.get("tokens", [])))
                 return doc
             time.sleep(delay)
             delay = min(delay * 2, 0.25)
@@ -179,6 +192,17 @@ class IngestPump:
             self._next += 1
             moved += 1
             self._server.discard([key])
+            # Launcher-side spans: submit -> ingest (client clock to
+            # launcher clock — one host in practice) and the log
+            # append itself.  The deterministic sampling verdict is the
+            # SAME one every serving rank reaches for this rid.
+            if obs_trace.enabled() and obs_trace.sampled(rid):
+                submit_t = float(doc.get("submit_t") or doc["arrival"])
+                obs_trace.add_span(rid, "ingest",
+                                   min(submit_t, doc["arrival"]),
+                                   doc["arrival"], n=doc["n"])
+                obs_trace.add_span(rid, "log_append", doc["arrival"],
+                                   time.time(), n=doc["n"])
             LOG.debug("ingested request %s as log/%d", rid, doc["n"])
         return moved
 

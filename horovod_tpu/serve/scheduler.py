@@ -64,6 +64,11 @@ class ActiveSlot:
     # reads it; the frontend publishes it so tests and operators can
     # SEE continuous admission — requests entering mid-stream).
     admitted_step: int = 0
+    # How many of `emitted` were replayed from a dead world's streams
+    # rather than generated here (scheduling never reads it; the trace
+    # plane uses it to mark the replayed prefix on a request's
+    # waterfall lane, and snapshot() exposes it for introspection).
+    resumed: int = 0
 
     @property
     def done(self) -> bool:
@@ -90,6 +95,7 @@ class Eviction:
     reason: str  # "eos" | "budget"
     tokens: Tuple[int, ...]
     admitted_step: int = 0
+    resumed: int = 0  # replayed-prefix length (see ActiveSlot.resumed)
 
 
 class SlotScheduler:
@@ -145,7 +151,8 @@ class SlotScheduler:
             req, resume = self.queue.popleft()
             self.active[slot] = ActiveSlot(req=req, slot=slot,
                                            emitted=list(resume),
-                                           admitted_step=step)
+                                           admitted_step=step,
+                                           resumed=len(resume))
             out.append(Admission(slot=slot, req=req, resume=resume))
         return out
 
@@ -181,7 +188,8 @@ class SlotScheduler:
             out.append(Eviction(slot=slot, rid=act.req.rid,
                                 reason=reason,
                                 tokens=tuple(act.emitted),
-                                admitted_step=act.admitted_step))
+                                admitted_step=act.admitted_step,
+                                resumed=act.resumed))
             del self.active[slot]
         return out
 
@@ -213,6 +221,7 @@ class SlotScheduler:
                 "eos_id": act.req.eos_id,
                 "arrival": act.req.arrival,
                 "emitted": list(act.emitted),
+                "resumed": act.resumed,
             }
             for _, act in sorted(self.active.items())
         ] + [

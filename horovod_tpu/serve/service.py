@@ -34,12 +34,14 @@ import pickle
 import sys
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..elastic.exceptions import HorovodShutdownError
 from ..obs import get_registry
 from ..obs import flightrec as obs_flightrec
 from ..obs import progress as obs_progress
+from ..obs import trace as obs_trace
 from ..testing.faults import maybe_fail
 from ..utils.logging import get_logger
 from .frontend import SCOPE, IngestPump, ServeClient, validate_request
@@ -47,7 +49,54 @@ from .scheduler import Request, SlotScheduler
 
 LOG = get_logger("serve")
 
-__all__ = ["serve_worker", "ServeJob", "DEFAULT_SPEC"]
+__all__ = ["serve_worker", "ServeJob", "DEFAULT_SPEC", "RateWindow"]
+
+# A request's decode progress is flushed to its trace lane every this
+# many tokens (plus a final remainder span at eviction): per-token
+# spans would drown the bounded ring, one-span-per-request would hide
+# mid-stream stalls.
+_DECODE_SPAN_TOKENS = 8
+
+
+class RateWindow:
+    """Sliding wall-clock token-rate window.
+
+    ``serve.tokens_per_sec`` used to be epoch-cumulative tokens over
+    epoch-elapsed time — a number only the leader's whole-epoch cadence
+    could explain, and one a trace report (built from per-step decode
+    spans) could legitimately disagree with.  This window is fed the
+    SAME timestamps the decode-compute spans record, so the digest
+    gauge and the trace report are two views of one clock: recent
+    tokens over a trailing ``window`` seconds (epoch-elapsed until the
+    window first fills, matching the old early-epoch semantics)."""
+
+    def __init__(self, window_secs: float = 5.0):
+        self.window = float(window_secs)
+        self._events: deque = deque()  # (t, ntokens)
+        self._total = 0
+        self._first_t: Optional[float] = None
+
+    def observe(self, t: float, n: int) -> None:
+        if n <= 0:
+            return
+        if self._first_t is None:
+            self._first_t = t
+        self._events.append((t, n))
+        self._total += n
+        cut = t - self.window
+        while self._events and self._events[0][0] < cut:
+            _, m = self._events.popleft()
+            self._total -= m
+
+    def rate(self, now: float) -> float:
+        if self._first_t is None:
+            return 0.0
+        cut = now - self.window
+        while self._events and self._events[0][0] < cut:
+            _, m = self._events.popleft()
+            self._total -= m
+        span = min(now - self._first_t, self.window)
+        return self._total / max(span, 1e-3)
 
 # How many trailing step-schedule keys the leader keeps before deleting
 # (authenticated DELETE): an unbounded schedule history would grow the
@@ -141,19 +190,36 @@ def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
     kv.put(SCOPE, f"out/{rid}", pickle.dumps(doc))
 
 
-def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
+def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
+                 profiler=None):
     """One rendezvous epoch of the serving loop.  Returns the per-rank
     summary dict on a clean drain (``serve/stop``), raises
-    HorovodShutdownError on a world break (the caller re-enters)."""
+    HorovodShutdownError on a world break (the caller re-enters).
+
+    Tracing (obs/trace.py, armed by ``HVDTPU_TRACE``): every sampled
+    request's life through this loop lands as spans on its rid lane —
+    the ttft components tile the [arrival, first-token] interval
+    exactly (queue_wait + schedule_broadcast + admit_wait + prefill =
+    the histogram's sample, same timestamps), and busy steps land
+    step-lane spans (schedule_broadcast / prefill / decode_compute /
+    stream_publish / whole-step — prefill twinned on the step lane
+    UNsampled, so the residual subtraction never depends on the
+    sample rate) the tpot decomposition derives from.
+    Spans carry THIS epoch, not the env's spawn epoch: a survivor's
+    single dump holds every epoch it lived through, which is how a
+    replayed request's waterfall shows both incarnations."""
     reg = get_registry()
     epoch = ctx.rendezvous()
     leader = ctx.world[0]
     is_leader = ctx.rank == leader
     scope = _epoch_scope(epoch)
+    tracing = obs_trace.enabled()
+    t_rate = obs_trace.sample_rate()
 
     # Epoch-start recovery broadcast: the leader's replay of the durable
     # request record IS the schedule seed — every rank (survivor or
     # fresh respawn) rebuilds the identical scheduler state from it.
+    t_rec0 = time.time()
     if is_leader:
         rec = _build_recovery(ctx.kv)
         ctx.kv.put(scope, "recovery", pickle.dumps(rec))
@@ -194,14 +260,23 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
         )
         LOG.info("epoch %d: replaying %d in-flight requests", epoch,
                  replayed)
+    if tracing:
+        # The recovery span is the left edge of every replayed
+        # request's second incarnation: the waterfall's gap between a
+        # request's epoch-N spans and this span IS the recovery cost.
+        obs_trace.add_span("serve.steps", "recovery", t_rec0,
+                           time.time(), epoch=epoch, replayed=replayed)
 
     step = 0
-    epoch_t0 = time.monotonic()
-    epoch_tokens = 0
+    rate_win = RateWindow()
+    # rid-keyed decode-window starts for the per-N-token decode spans:
+    # (wall t, tokens emitted at window start).
+    dspan: Dict[int, Tuple[float, int]] = {}
     idle_secs = float(spec.get("idle_secs", 0.01))
     stream_every = max(int(spec.get("stream_every", 4)), 1)
     while True:
         step += 1
+        t_step0 = time.time()
         # Deterministic chaos: the serving analog of the elastic
         # collective's step-boundary injection point — same spec
         # grammar, same epoch-0 default that keeps respawns convergent.
@@ -229,6 +304,7 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
         else:
             sdoc = pickle.loads(_fetch(ctx, scope, f"sched/{step}",
                                        f"schedule for step {step}"))
+        t_sched = time.time()
 
         for entry in sdoc["new"]:
             reason = validate_request(entry, engine.serve_len,
@@ -251,11 +327,39 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
         busy_before = sched.active_slots
         admissions = sched.admit(step)
         for adm in admissions:
+            t_a0 = time.time()
             tok = engine.admit(adm.slot, adm.req.prompt, adm.resume)
+            t_a1 = time.time()
+            # A recycled slot must never inherit the previous tenant's
+            # decode-window mark.
+            dspan.pop(adm.slot, None)
+            req_traced = tracing and obs_trace.sampled(adm.req.rid,
+                                                       t_rate)
+            if tracing:
+                # Step-lane twin of the request-lane prefill span,
+                # UNgated on per-request sampling: the tpot report
+                # subtracts named phases from the whole-step span, and
+                # an unsampled request's prefill would otherwise
+                # masquerade as scheduler residual.
+                obs_trace.add_span("serve.steps", "prefill", t_a0, t_a1,
+                                   epoch=epoch, step=step,
+                                   slot=adm.slot)
             if tok is None:
-                continue  # replay rebuild; its tokens already streamed
+                # Replay rebuild; its tokens already streamed.  The
+                # replay_prefill span marks the second incarnation's
+                # restart point on the request's lane.
+                if req_traced:
+                    obs_trace.add_span(
+                        adm.req.rid, "replay_prefill", t_a0, t_a1,
+                        epoch=epoch, step=step, slot=adm.slot,
+                        resumed=len(adm.resume),
+                    )
+                    dspan[adm.slot] = (t_a1, len(adm.resume))
+                continue
             sched.record(adm.slot, tok)
-            epoch_tokens += 1
+            rate_win.observe(t_a1, 1)
+            if req_traced:
+                dspan[adm.slot] = (t_a1, 1)
             # Dedup by rid, like evictions: a request admitted just
             # before a world break whose first out doc never landed is
             # re-admitted as fresh on replay, and survivors' counters
@@ -269,26 +373,85 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
                 # The continuous-batching moment: this request entered
                 # while other slots were mid-decode.
                 reg.counter("serve.admitted_while_busy").inc()
+            ttft_ms = None
             if adm.req.arrival:
-                reg.histogram("serve.ttft_ms").observe(
-                    max(time.time() - adm.req.arrival, 0.0) * 1000.0
+                # Measured at t_a1 — the same instant that closes the
+                # prefill span, so the trace report's component sum and
+                # this histogram's sample agree by construction.
+                ttft_ms = max(t_a1 - adm.req.arrival, 0.0) * 1000.0
+                reg.histogram("serve.ttft_ms").observe(ttft_ms)
+            if req_traced:
+                # The four spans tile [arrival, first token] exactly:
+                # queue_wait ends where this step began, the broadcast
+                # span covers the schedule fetch, admit_wait absorbs
+                # validation plus same-step earlier prefills, and
+                # prefill is the engine.admit call whose argmax IS the
+                # first token (first-decode is folded into prefill on
+                # the greedy slot engine).
+                # The ingest pump appends concurrently with this loop,
+                # so an arrival can land INSIDE (t_step0, t_sched]:
+                # schedule_broadcast must then start at the arrival,
+                # not reach back to t_step0, or the components would
+                # over-tile [arrival, first token] and break the
+                # exact-sum contract the CI trace gate enforces.
+                t_q1 = t_step0
+                if adm.req.arrival:
+                    t_q1 = min(max(adm.req.arrival, t_step0), t_sched)
+                    obs_trace.add_span(
+                        adm.req.rid, "queue_wait",
+                        min(adm.req.arrival, t_q1), t_q1,
+                        epoch=epoch, step=step,
+                    )
+                obs_trace.add_span(adm.req.rid, "schedule_broadcast",
+                                   t_q1, t_sched, epoch=epoch,
+                                   step=step)
+                obs_trace.add_span(adm.req.rid, "admit_wait", t_sched,
+                                   t_a0, epoch=epoch, step=step)
+                obs_trace.add_span(
+                    adm.req.rid, "prefill", t_a0, t_a1, epoch=epoch,
+                    step=step, slot=adm.slot,
+                    prompt_len=len(adm.req.prompt),
+                    ttft_ms=(round(ttft_ms, 3)
+                             if ttft_ms is not None else None),
                 )
         evictions = sched.evict_finished()
 
         # -- one decode iteration over the live slots ----------------
         active = sorted(sched.active)
         if active:
-            t0 = time.monotonic()
+            t_d0 = time.time()
             toks = engine.step(active)
-            step_ms = (time.monotonic() - t0) * 1000.0
+            t_d1 = time.time()
+            step_ms = (t_d1 - t_d0) * 1000.0
             for slot in active:
                 sched.record(slot, toks[slot])
                 reg.histogram("serve.tpot_ms").observe(step_ms)
-            epoch_tokens += len(active)
+            rate_win.observe(t_d1, len(active))
+            if profiler is not None:
+                profiler.observe(t_d1 - t_d0)
+            if tracing:
+                obs_trace.add_span("serve.steps", "decode_compute",
+                                   t_d0, t_d1, epoch=epoch, step=step,
+                                   slots=len(active))
+                # Per-request decode windows: flush a span to the rid
+                # lane every _DECODE_SPAN_TOKENS tokens.
+                for slot in active:
+                    mark = dspan.get(slot)
+                    if mark is None:
+                        continue
+                    n = len(sched.active[slot].emitted)
+                    if n - mark[1] >= _DECODE_SPAN_TOKENS:
+                        obs_trace.add_span(
+                            sched.active[slot].req.rid, "decode",
+                            mark[0], t_d1, epoch=epoch, step=step,
+                            tokens=n - mark[1],
+                        )
+                        dspan[slot] = (t_d1, n)
             evictions += sched.evict_finished()
 
         # -- stream results (leader only writes; peers computed the
         # identical tokens and discard them) -------------------------
+        t_p0 = time.time()
         if is_leader:
             for slot in sorted(sched.active):
                 act = sched.active[slot]
@@ -319,12 +482,38 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
                 totals["done_rids"].add(ev.rid)
                 reg.counter("serve.evicted").inc()
                 totals["completed"] += 1
+            mark = dspan.pop(ev.slot, None)
+            if tracing and obs_trace.sampled(ev.rid, t_rate):
+                t_fin = time.time()
+                if mark is not None and len(ev.tokens) > mark[1]:
+                    obs_trace.add_span(ev.rid, "decode", mark[0], t_fin,
+                                       epoch=epoch, step=step,
+                                       tokens=len(ev.tokens) - mark[1])
+                obs_trace.add_span(ev.rid, "finish", t_fin, t_fin,
+                                   epoch=epoch, step=step,
+                                   reason=ev.reason,
+                                   tokens=len(ev.tokens),
+                                   resumed=ev.resumed)
 
         # -- gauges + progress beat ----------------------------------
+        t_step1 = time.time()
+        busy = bool(active or admissions or sdoc["new"] or evictions)
+        if tracing and busy:
+            if is_leader:
+                obs_trace.add_span("serve.steps", "stream_publish",
+                                   t_p0, t_step1, epoch=epoch,
+                                   step=step)
+            obs_trace.add_span("serve.steps", "schedule_broadcast",
+                               t_step0, t_sched, epoch=epoch, step=step)
+            obs_trace.add_span("serve.steps", "step", t_step0, t_step1,
+                               epoch=epoch, step=step,
+                               active=len(active))
         reg.gauge("serve.queue_depth").set(sched.queue_depth)
         reg.gauge("serve.active_slots").set(sched.active_slots)
-        elapsed = max(time.monotonic() - epoch_t0, 1e-6)
-        reg.gauge("serve.tokens_per_sec").set(epoch_tokens / elapsed)
+        # Sliding wall-clock window, fed the SAME timestamps the
+        # decode-compute spans carry: the digest and the trace report
+        # cannot disagree about throughput.
+        reg.gauge("serve.tokens_per_sec").set(rate_win.rate(t_step1))
         reg.counter("serve.steps").inc()
         totals["tokens"] += len(active) + sum(
             1 for a in admissions if not a.resume
@@ -333,7 +522,7 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
 
         if sdoc["stop"] and sched.idle():
             LOG.info("serving drained at epoch %d step %d", epoch, step)
-            return {
+            out = {
                 "rank": ctx.rank,
                 "epoch": epoch,
                 "steps": step,
@@ -343,6 +532,9 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any]):
                     reg.counter("serve.admitted_while_busy").value
                 ),
             }
+            if profiler is not None:
+                out["perf"] = profiler.summary()
+            return out
         if not active and not admissions and not sdoc["new"] and is_leader:
             # Idle pacing: peers are paced by the schedule fetch; the
             # leader throttles itself so an empty queue costs a few
@@ -383,11 +575,22 @@ def serve_worker(spec: Optional[dict] = None):
     params = model.init(jax.random.PRNGKey(spec["seed"]), dummy)
     engine = SlotEngine(model.cfg, params, spec["num_slots"],
                         spec.get("max_len"))
+    # The serving MFU accountant: decode-step FLOPs from the compiled
+    # artifact's own cost analysis over the measured step time,
+    # published live as perf.* gauges (estimate-flagged off-TPU) —
+    # the measurement layer ROADMAP item 5 was missing.
+    from ..obs.profile import MFUProfiler  # noqa: PLC0415
+
+    flops = engine.step_flops()
+    profiler = MFUProfiler(
+        flops, jax.devices()[0].device_kind,
+        source="cost_analysis" if flops else "unavailable",
+    )
     totals = {"completed": 0, "tokens": 0, "done_rids": set(),
               "admitted_rids": set()}
     while True:
         try:
-            return _serve_epoch(ctx, engine, spec, totals)
+            return _serve_epoch(ctx, engine, spec, totals, profiler)
         except HorovodShutdownError as exc:
             LOG.warning("serving world broke (%s); re-forming", exc)
             ctx.notify_world_broken()
@@ -518,13 +721,40 @@ class ServeJob:
             self.shutdown()
 
     def shutdown(self) -> None:
-        """Release launcher-side resources (idempotent)."""
+        """Release launcher-side resources (idempotent).  When tracing
+        is armed, flush this process's spans (the ingest pump's and the
+        client's) and merge every rank's span file into the waterfall +
+        decomposition report — the python-API twin of the ``hvdrun
+        --trace`` end-of-job merge."""
         try:
             self._pump.stop()
         except Exception:  # pragma: no cover - defensive
             pass
         try:
             self._server.stop()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        try:
+            import os  # noqa: PLC0415
+
+            from ..utils import env as envmod  # noqa: PLC0415
+
+            raw = self._env.get(envmod.TRACE) \
+                or os.environ.get(envmod.TRACE)
+            if raw:
+                # Explicit path: the dump target may have been armed
+                # only in the WORKERS' env dict, not this process's
+                # os.environ — the launcher's spans must land either
+                # way (its file is tagged ``launcher``, which the
+                # aggregators read from the doc, not the filename).
+                obs_trace.flush(obs_trace.resolve_dump_path(raw))
+                from ..obs import trace_merge  # noqa: PLC0415
+
+                out = trace_merge.merge_glob(raw,
+                                             expected_ranks=self.np)
+                if out is not None:
+                    LOG.info("merged trace -> %s (report %s)",
+                             out["waterfall"], out["report"])
         except Exception:  # pragma: no cover - defensive
             pass
 

@@ -21,7 +21,8 @@ Grammar::
   also run/task_fn.py at function start), ``task_fn`` (run/task_fn.py
   before the user function runs), ``shard_write`` (ckpt/sharded.py
   per-rank shard write), ``replica_push`` (ckpt/replica.py peer-replica
-  push after each commit).
+  push after each commit), ``trace_flush`` (obs/trace.py span-dump
+  path).
 * ``rank`` — only fire on this rank (resolved from the ``rank=`` call
   argument, else ``HVDTPU_RANK``, else ``HVDTPU_ELASTIC_RANK``).  Absent
   means any rank.
@@ -54,8 +55,11 @@ Grammar::
   torn/corrupted shard, the chaos input checksum validation is tested
   against); ``drop_replica`` instructs the call site to suppress the
   write entirely (the peer-replica push path — a deterministically
-  stale replica).  ``worker_exit``/``task_fn`` points default to
-  ``exit``.
+  stale replica); ``trace_drop`` instructs the span-flush path
+  (obs/trace.py, point ``trace_flush``) to suppress the next span dump
+  on a rank — the deterministic missing-rank input trace-merge's
+  degraded handling is chaos-tested against.  ``worker_exit``/
+  ``task_fn`` points default to ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
 * ``name`` — only fire when the call site passes a matching ``name=``
@@ -81,6 +85,7 @@ _EXIT_POINTS = ("worker_exit", "task_fn")
 _ADVISORY_POINTS = {
     "corrupt_write": ("shard_write",),
     "drop_replica": ("replica_push",),
+    "trace_drop": ("trace_flush",),
 }
 
 
@@ -162,7 +167,8 @@ def parse_spec(raw: str) -> List[FaultSpec]:
                 spec.epoch = None if value in ("any", "*") else int(value)
             elif key == "action":
                 if value not in ("raise", "exit", "abort", "hang", "delay",
-                                 "corrupt_write", "drop_replica"):
+                                 "corrupt_write", "drop_replica",
+                                 "trace_drop"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -287,7 +293,7 @@ def maybe_fail(
             "fault", name=point,
             detail=f"{spec.action}:{spec.describe()}",
         )
-        if spec.action in ("corrupt_write", "drop_replica"):
+        if spec.action in ("corrupt_write", "drop_replica", "trace_drop"):
             # Advisory actions: the call site owns the I/O, so the
             # registry can only instruct it — corrupt the payload it is
             # about to write, or skip the push entirely.

@@ -107,6 +107,17 @@ CKPT_REPLICA_CHUNK_KB = "HVDTPU_CKPT_REPLICA_CHUNK_KB"
 DEFAULT_REPLICA_CHUNK_KB = 1024
 CKPT_COMMIT_TIMEOUT = "HVDTPU_CKPT_COMMIT_TIMEOUT_SECS"
 DEFAULT_CKPT_COMMIT_TIMEOUT = 120.0
+# Request-level distributed tracing (obs/trace.py): TRACE is the
+# per-rank span dump target (same dir/{rank}/plain-path forms as
+# METRICS_DUMP, stem "spans"; unset = tracing off, zero hot-path cost).
+# TRACE_SAMPLE_RATE is the fraction of requests traced (default 1.0);
+# the sampling decision is a pure function of the trace id, so every
+# rank and the launcher reach the SAME verdict with no coordination —
+# the HVD001 invariant applies to sampling decisions.  TRACE_CAPACITY
+# bounds the in-memory span ring per process (default 8192).
+TRACE = "HVDTPU_TRACE"
+TRACE_SAMPLE_RATE = "HVDTPU_TRACE_SAMPLE_RATE"
+TRACE_CAPACITY = "HVDTPU_TRACE_CAPACITY"
 # Serving plane (serve/): fleet-wide model geometry the `hvdrun
 # --elastic --serve` launcher forwards to every serving rank (the
 # python -m horovod_tpu.serve worker reads them as flag fallbacks).

@@ -315,24 +315,49 @@ _PROM_SAMPLE = re.compile(
 _PROM_TYPE = re.compile(
     r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|summary)$"
 )
+_PROM_HELP = re.compile(
+    r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S.*$"
+)
 
 
 def _assert_valid_exposition(text):
+    """Exposition-format conformance (the rules real scrapers enforce):
+    every comment is a well-formed HELP or TYPE line, at most one of
+    each per family (a second is a hard parse error), HELP precedes
+    TYPE, and every sample belongs to a family whose TYPE already
+    appeared (bare samples make scrapers warn)."""
     assert text.endswith("\n")
     seen_types = set()
+    seen_helps = set()
     for line in text.rstrip("\n").splitlines():
         if line.startswith("#"):
-            m = _PROM_TYPE.match(line)
-            assert m, f"bad comment line: {line!r}"
-            name = line.split()[2]
-            assert name not in seen_types, f"duplicate TYPE for {name}"
-            seen_types.add(name)
+            if line.startswith("# HELP"):
+                assert _PROM_HELP.match(line), f"bad HELP line: {line!r}"
+                name = line.split()[2]
+                assert name not in seen_helps, f"duplicate HELP for {name}"
+                assert name not in seen_types, \
+                    f"HELP after TYPE for {name}"
+                seen_helps.add(name)
+            else:
+                m = _PROM_TYPE.match(line)
+                assert m, f"bad comment line: {line!r}"
+                name = line.split()[2]
+                assert name not in seen_types, f"duplicate TYPE for {name}"
+                seen_types.add(name)
         else:
             assert _PROM_SAMPLE.match(line), f"bad sample line: {line!r}"
+            base = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", line).group(0)
+            # summaries sample under <name>, <name>_sum, <name>_count
+            fam = re.sub(r"_(sum|count)$", "", base)
+            assert base in seen_types or fam in seen_types, \
+                f"sample with no TYPE family: {line!r}"
             # duplicate label names are a hard parse error for scrapers
             keys = re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="', line)
             assert len(keys) == len(set(keys)), \
                 f"duplicate label in: {line!r}"
+    # every family carries help text, not just a type
+    assert seen_types <= seen_helps, \
+        f"TYPE without HELP: {sorted(seen_types - seen_helps)}"
 
 
 def test_prometheus_exposition_is_valid_and_labelled():
@@ -346,6 +371,7 @@ def test_prometheus_exposition_is_valid_and_labelled():
     ))
     text = agg.prometheus()
     _assert_valid_exposition(text)
+    assert '# HELP hvdtpu_ops_total ' in text
     assert '# TYPE hvdtpu_ops_total counter' in text
     assert 'hvdtpu_ops_total{rank="0",epoch="1",kind="x"} 3.0' in text
     # histograms render as summaries with quantile labels + sum/count
