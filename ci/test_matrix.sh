@@ -23,8 +23,12 @@ analysis_gate() {
     # surface, AND a config-default run is the one that reports stale
     # baseline entries (fixed findings whose entries should be removed).
     LINT_TMP=$(mktemp -d)
+    # --strict-baseline: stale suppressions (entries whose finding no
+    # longer fires) fail the gate — dead entries would silently swallow
+    # a FUTURE finding at the same (rule, path, context).
     if ! python -m horovod_tpu.analysis \
         --baseline horovod_tpu/analysis/baseline.json \
+        --strict-baseline \
         --format json > "$LINT_TMP/report.json"; then
         echo "analysis gate FAILED: new findings on the clean tree" >&2
         python - "$LINT_TMP/report.json" <<'EOF' >&2 || cat "$LINT_TMP/report.json" >&2
@@ -40,7 +44,7 @@ EOF
 import json, sys
 doc = json.load(open(sys.argv[1]))
 assert doc["schema"] == "hvdtpu-lint-v1", doc["schema"]
-assert isinstance(doc["rules"], dict) and len(doc["rules"]) >= 12
+assert isinstance(doc["rules"], dict) and len(doc["rules"]) >= 20
 for rid, r in doc["rules"].items():
     assert {"name", "severity", "summary"} <= set(r), (rid, r)
     assert r["severity"] in ("error", "warning"), (rid, r)
@@ -75,6 +79,50 @@ EOF
     grep -q "HVD001" "$LINT_TMP/seeded.out" || {
         echo "analysis gate FAILED: seeded violation not attributed to HVD001" >&2
         cat "$LINT_TMP/seeded.out" >&2
+        rm -rf "$LINT_TMP"
+        exit 1
+    }
+    # 4) the mesh-aware family gates too (ISSUE 12): a rank-guarded
+    # subgroup collective inside a shard_map body must fail as HVD010,
+    # including the interprocedural shape where the rank read and the
+    # collective live in different functions.
+    cat > "$LINT_TMP/seeded_subgroup.py" <<'EOF'
+import horovod_tpu as hvd
+from jax import lax
+from jax.experimental.shard_map import shard_map
+
+def body(x):
+    if hvd.rank() == 0:              # world taint, local group: deadlock
+        return lax.psum(x, "hvd_local")
+    return x
+
+def reduce_part(flag, x):
+    if flag == 0:                    # taint arrives through the argument
+        return lax.psum(x, "hvd_cross")
+    return x
+
+def step(x):
+    return reduce_part(hvd.cross_rank(), x)
+EOF
+    if python -m horovod_tpu.analysis "$LINT_TMP/seeded_subgroup.py" \
+        --baseline horovod_tpu/analysis/baseline.json \
+        > "$LINT_TMP/seeded_sub.out" 2>&1; then
+        echo "analysis gate FAILED: seeded subgroup-divergent collective passed" >&2
+        cat "$LINT_TMP/seeded_sub.out" >&2
+        rm -rf "$LINT_TMP"
+        exit 1
+    fi
+    # both the direct and the interprocedural hit, attributed to HVD010
+    # with the producing call chain named
+    [ "$(grep -c "HVD010" "$LINT_TMP/seeded_sub.out")" -ge 2 ] || {
+        echo "analysis gate FAILED: seeded subgroup violations not attributed to HVD010" >&2
+        cat "$LINT_TMP/seeded_sub.out" >&2
+        rm -rf "$LINT_TMP"
+        exit 1
+    }
+    grep -q "step \[.*\] -> reduce_part" "$LINT_TMP/seeded_sub.out" || {
+        echo "analysis gate FAILED: HVD010 finding lost its call-chain attribution" >&2
+        cat "$LINT_TMP/seeded_sub.out" >&2
         rm -rf "$LINT_TMP"
         exit 1
     }
@@ -719,6 +767,22 @@ print(f"overlap bench record OK: {len(bb)} buckets, "
       f"{parsed['donation']['expected']}")
 EOF
 rm -rf "$OV_TMP"
+
+# HLO schedule-diff gate (ISSUE 12): every rank must COMPILE the same
+# collective sequence for the engine fused-allreduce, the overlap
+# bucket train step, and the serve sequence-sharded decode step — the
+# artifact-level form of the HVD001/HVD010 invariant.  Each simulated
+# rank compiles in its own process with rank-specific env; the checker
+# diffs op kinds, order, replica groups, and operand bytes.  The
+# --seed-divergence self-test plants a rank-guarded collective and
+# requires the gate to reject it, so "gate passed" can never mean
+# "checker was blind".
+echo "== hlo gate: cross-rank collective-schedule diff =="
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 580 python scripts/hlo_gate.py
+echo "== hlo gate: seeded divergence self-test =="
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 580 python scripts/hlo_gate.py --seed-divergence
 
 # Serve gate (ISSUE 10): the continuous-batching serving plane.  The
 # unit suite + hvdtpu-lint over the new subsystem, then one 2-proc

@@ -11,15 +11,24 @@ Two rule families (catalog: ``--list-rules`` / docs/analysis.md):
 * ``HVD0xx`` — SPMD schedule correctness: rank-guarded collectives,
   unordered-container iteration, unnamed collectives in conditionals,
   missing initial-state broadcast, import-time topology reads,
-  collectives in except handlers, rank-dependent names.
+  collectives in except handlers, rank-dependent names — and, since
+  PR 12, the mesh-aware family: interprocedural axis-scoped rank
+  taint guarding subgroup collectives (HVD010), runtime-selected
+  collective axis sets (HVD011), impurity inside determinism
+  contracts (HVD012), rank-tainted trace decisions (HVD013).
 * ``HVDC1xx`` — concurrency discipline: lock-order inversions,
   blocking calls under locks, and the signal-path rules (non-reentrant
   locks, logging, blocking calls, unbounded growth reachable from
   death hooks), plus swallowed shutdown exceptions.
 
+The compiled-artifact side lives in :mod:`horovod_tpu.analysis.hlo`
+(``python -m horovod_tpu.analysis.hlo``): parse scheduled HLO dumps
+and assert every rank compiled the identical collective sequence.
+
 Suppress one finding inline with ``# hvdtpu: disable=HVD001`` (same
 line or the line above); acknowledge known false positives in
-``analysis/baseline.json`` — every entry needs a ``reason``.
+``analysis/baseline.json`` — every entry needs a ``reason``
+(``--prune-baseline`` / ``--strict-baseline`` keep the file honest).
 
 This package is stdlib-only (no jax import), so it runs in bare CI
 images and pre-commit hooks.

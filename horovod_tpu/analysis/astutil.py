@@ -301,6 +301,27 @@ def index_functions(model: ModuleModel) -> Dict[str, FunctionInfo]:
     return out
 
 
+def iter_defs(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Yield ``(qualname, def-node)`` for every function in a module,
+    in source order, using THE qualname convention every pass keys on
+    (``Class.method``, nested ``f.<locals>.g``).  One implementation on
+    purpose: taint summaries, contract registration and the call graph
+    must agree on these names exactly, or cross-references silently
+    resolve to nothing."""
+    stack: List[Tuple[ast.AST, str]] = [(tree, "")]
+    while stack:
+        node, prefix = stack.pop(0)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                yield qn, child
+                stack.append((child, f"{qn}.<locals>."))
+            elif isinstance(child, ast.ClassDef):
+                stack.append((child, f"{child.name}."))
+            else:
+                stack.append((child, prefix))
+
+
 def own_calls(func: ast.AST) -> List[ast.Call]:
     """Call nodes in a function body EXCLUDING nested def/class/lambda
     bodies: a closure handed to a Thread(target=...) runs on another
@@ -347,7 +368,10 @@ def enclosing_function_map(
     model: ModuleModel,
 ) -> Dict[int, str]:
     """line -> qualname of the innermost enclosing function, for
-    stable finding contexts."""
+    stable finding contexts.  Memoized on the model: every rule family
+    asks for this map and the walk is the priciest per-file pass."""
+    if model.fmap_cache is not None:
+        return model.fmap_cache
     spans: List[Tuple[int, int, str]] = []
 
     def visit(node: ast.AST, prefix: str) -> None:
@@ -368,6 +392,7 @@ def enclosing_function_map(
     for start, end, qn in sorted(spans, key=lambda s: -(s[1] - s[0])):
         for line in range(start, end + 1):
             out[line] = qn
+    model.fmap_cache = out
     return out
 
 

@@ -67,6 +67,40 @@ def apply_baseline(
     return findings, unused
 
 
+def prune_baseline(path: str, stale: List[dict]) -> int:
+    """Remove ``stale`` entries (as returned by :func:`apply_baseline`)
+    from the baseline file in place, preserving every surviving entry
+    byte-for-byte (reasons are curated text).  Returns the number
+    removed.  Stale suppressions are drift: an entry whose finding no
+    longer fires either acknowledges a fixed defect (remove it) or —
+    worse — will silently swallow a *future* finding at the same
+    (rule, path, context) that has nothing to do with the original
+    justification."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("schema") != BASELINE_SCHEMA:
+        raise BaselineError(
+            f"{path}: expected schema {BASELINE_SCHEMA!r}, got "
+            f"{doc.get('schema')!r}"
+        )
+    stale_keys = {
+        (e["rule"], e["path"], e["context"]) for e in stale
+    }
+    entries = doc.get("entries", [])
+    kept = [
+        e for e in entries
+        if (e.get("rule"), e.get("path"), e.get("context"))
+        not in stale_keys
+    ]
+    removed = len(entries) - len(kept)
+    if removed:
+        doc["entries"] = kept
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+    return removed
+
+
 def write_baseline(
     path: str,
     findings: List[Finding],

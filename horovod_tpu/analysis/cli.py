@@ -14,7 +14,9 @@ import sys
 from typing import Dict, List, Optional, Sequence, Set
 
 from . import baseline as baseline_mod
+from . import cache as cache_mod
 from . import registry
+from . import taint
 from .config import LintConfig, load_config
 from .core import SCHEMA, Finding, ModuleModel, is_suppressed, load_module
 
@@ -53,28 +55,51 @@ def _iter_py_files(paths: Sequence[str], exclude: Sequence[str],
 
 def _changed_files(root: str) -> List[str]:
     """Working-tree changes vs HEAD plus untracked files — the local
-    pre-commit loop's file set."""
+    pre-commit loop's file set.
+
+    ``--name-status`` (not ``--name-only``) so deletions are dropped
+    and renames contribute their NEW path: a plain name listing hands
+    back paths that no longer exist (the D side of a delete, the old
+    side of a rename), which then crash the per-file loop."""
     files: Set[str] = set()
-    for args in (
-        ["git", "diff", "--name-only", "HEAD"],
-        ["git", "ls-files", "--others", "--exclude-standard"],
-    ):
-        try:
-            res = subprocess.run(
-                args, cwd=root, capture_output=True, text=True,
-                timeout=30, check=True,
-            )
-        except (OSError, subprocess.SubprocessError) as e:
-            # exit 2: environment/usage error — never 1, which the
-            # documented contract reserves for "new findings".
-            print(f"hvdtpu-lint: --changed needs git: {e}",
-                  file=sys.stderr)
-            raise SystemExit(2)
+    try:
+        res = subprocess.run(
+            ["git", "diff", "--name-status", "-M", "HEAD"],
+            cwd=root, capture_output=True, text=True,
+            timeout=30, check=True,
+        )
+        for line in res.stdout.splitlines():
+            parts = line.split("\t")
+            if len(parts) < 2:
+                continue
+            status = parts[0]
+            if status.startswith("D"):
+                continue  # deleted: nothing on disk to lint
+            # R100\told\tnew / C90\tsrc\tdst: the last column is the
+            # path that exists in the working tree now.
+            files.add(parts[-1].strip())
+        res = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True,
+            timeout=30, check=True,
+        )
         files.update(
             line.strip() for line in res.stdout.splitlines()
             if line.strip()
         )
-    return sorted(f for f in files if f.endswith(".py"))
+    except (OSError, subprocess.SubprocessError) as e:
+        # exit 2: environment/usage error — never 1, which the
+        # documented contract reserves for "new findings".
+        print(f"hvdtpu-lint: --changed needs git: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    # Belt and braces: a checkout can still race the diff (a file
+    # deleted between the two git calls, an unmerged path) — only paths
+    # that exist right now are lintable.
+    return sorted(
+        f for f in files
+        if f.endswith(".py") and os.path.isfile(os.path.join(root, f))
+    )
 
 
 def analyze_paths(
@@ -83,12 +108,21 @@ def analyze_paths(
     root: Optional[str] = None,
     exclude: Sequence[str] = (),
     rules: Optional[Set[str]] = None,
+    cache_path: Optional[str] = None,
 ) -> List[Finding]:
     """Library entry point: lint ``paths`` (files or directories),
     returning findings with suppression status applied (baseline is the
-    CLI's job)."""
+    CLI's job).
+
+    ``cache_path`` (optional) points at the per-file analysis cache:
+    unchanged files reuse their module-scope findings and taint
+    summaries by content hash; project-scope rules always re-run (their
+    verdicts span files) but start from the cached summaries.
+    """
     root = os.path.abspath(root or os.getcwd())
     files = _iter_py_files(paths, exclude, root)
+    cached = cache_mod.load_cache(cache_path) if cache_path else {}
+    new_cache: Dict[str, dict] = {}
     models: List[ModuleModel] = []
     findings: List[Finding] = []
     for path in files:
@@ -102,9 +136,47 @@ def analyze_paths(
             ))
             continue
         models.append(model)
+    dirty = False
     for model in models:
-        findings.extend(registry.run_module_rules(model))
+        key = taint.content_key(model.source)
+        entry = cached.get(model.relpath)
+        module_findings: Optional[List[Finding]] = None
+        if entry is not None and entry.get("key") == key:
+            module_findings = cache_mod.findings_from_entry(
+                entry, model.relpath)
+            raw_taint = entry.get("taint")
+            if isinstance(raw_taint, dict) and raw_taint:
+                taint.seed_summary_memo(key, raw_taint)
+        else:
+            entry = None
+        if module_findings is None:
+            module_findings = registry.run_module_rules(model)
+            entry = None
+            dirty = True
+        findings.extend(module_findings)
+        if cache_path:
+            new_cache[model.relpath] = (key, module_findings, entry)
     findings.extend(registry.run_project_rules(models))
+    if cache_path and dirty:
+        # Dump AFTER the project rules: their closures force the taint
+        # local phase for every model, so the summaries exist now.
+        # All-hit runs skip the write entirely, and hit entries are
+        # carried over verbatim — re-serializing identical summaries
+        # was most of the warm-path cost.  MERGE with the prior cache:
+        # a --changed run analyzes a file subset and must not clobber
+        # the other files' entries; entries whose file left the disk
+        # are dropped.
+        merged = {
+            rel: entry for rel, entry in cached.items()
+            if os.path.isfile(os.path.join(root, rel))
+        }
+        for rel, (key, module_findings, prior) in new_cache.items():
+            merged[rel] = prior if prior is not None else \
+                cache_mod.entry_for(
+                    key, module_findings,
+                    taint.dump_summary_memo(key),
+                )
+        cache_mod.save_cache(cache_path, merged)
     if rules:
         findings = [f for f in findings if f.rule in rules or
                     f.rule == "PARSE"]
@@ -194,6 +266,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="ignore any configured baseline (report everything)",
     )
     parser.add_argument(
+        "--prune-baseline", action="store_true",
+        help="remove baseline entries whose finding no longer fires "
+             "(full-surface runs only: a partial view cannot judge "
+             "staleness)",
+    )
+    parser.add_argument(
+        "--strict-baseline", action="store_true",
+        help="exit 1 when the baseline carries stale entries (CI drift "
+             "gate; full-surface runs only)",
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="skip the per-file analysis cache (content-hash keyed "
+             "module findings + taint summaries)",
+    )
+    parser.add_argument(
         "--changed", action="store_true",
         help="lint only files changed vs HEAD (plus untracked) — the "
              "fast local pre-commit loop",
@@ -220,6 +308,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
+    # Staleness is only decidable on the full surface with every rule:
+    # a --changed/--rules/explicit-path run sees a subset, so "entry
+    # didn't match" means "entry wasn't looked at", not "fixed".
+    partial_view = bool(args.changed or args.rules or args.paths)
+    if (args.prune_baseline or args.strict_baseline) and partial_view:
+        which = "--prune-baseline" if args.prune_baseline \
+            else "--strict-baseline"
+        print(f"hvdtpu-lint: {which} needs a full-surface run — drop "
+              f"--changed/--rules/explicit paths", file=sys.stderr)
+        return 2
     try:
         cfg: LintConfig = load_config(root)
     except ValueError as e:
@@ -258,15 +356,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"{', '.join(sorted(unknown))}", file=sys.stderr)
             return 2
 
+    cache_path: Optional[str] = None
+    if cfg.cache and not args.no_cache:
+        cache_path = cfg.cache if os.path.isabs(cfg.cache) else \
+            os.path.join(root, cfg.cache)
+
     try:
         findings = analyze_paths(
             paths, root=root, exclude=cfg.exclude, rules=rules_filter,
+            cache_path=cache_path,
         )
     except ValueError as e:  # config errors
         print(f"hvdtpu-lint: {e}", file=sys.stderr)
         return 2
 
     loaded_baseline: dict = {}
+    stale_rc = 0
     baseline_path = args.baseline or cfg.baseline
     if baseline_path and not args.no_baseline:
         bp = baseline_path if os.path.isabs(baseline_path) else \
@@ -283,15 +388,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # Unused entries are only meaningful on a full-surface,
             # all-rules run; a --changed run sees a file subset and a
             # --rules run a rule subset — both would cry wolf.
-            if unused and not args.changed and not args.paths \
-                    and not args.rules:
-                for e in unused:
-                    print(
-                        f"hvdtpu-lint: note: baseline entry no longer "
-                        f"matches anything (fixed? remove it): "
-                        f"{e['rule']} {e['path']} {e['context']}",
-                        file=sys.stderr,
-                    )
+            if unused and not partial_view:
+                if args.prune_baseline:
+                    removed = baseline_mod.prune_baseline(bp, unused)
+                    for e in unused:
+                        print(
+                            f"hvdtpu-lint: pruned stale baseline entry: "
+                            f"{e['rule']} {e['path']} {e['context']}",
+                            file=sys.stderr,
+                        )
+                    print(f"hvdtpu-lint: removed {removed} stale "
+                          f"baseline entr(y/ies) from {baseline_path}",
+                          file=sys.stderr)
+                else:
+                    for e in unused:
+                        print(
+                            f"hvdtpu-lint: note: baseline entry no "
+                            f"longer matches anything (fixed? remove "
+                            f"it): {e['rule']} {e['path']} "
+                            f"{e['context']}",
+                            file=sys.stderr,
+                        )
+                    if args.strict_baseline:
+                        print(
+                            f"hvdtpu-lint: --strict-baseline: "
+                            f"{len(unused)} stale baseline entr(y/ies) "
+                            f"— run --prune-baseline (or delete them) "
+                            f"so dead suppressions cannot swallow "
+                            f"future findings", file=sys.stderr,
+                        )
+                        stale_rc = 1
 
     if args.write_baseline:
         n = baseline_mod.write_baseline(
@@ -307,4 +433,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     out = _format_json(findings) if args.format == "json" else \
         _format_text(findings)
     print(out)
-    return 1 if any(f.status == "new" for f in findings) else 0
+    if any(f.status == "new" for f in findings):
+        return 1
+    return stale_rc

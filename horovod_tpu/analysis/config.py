@@ -25,6 +25,9 @@ class LintConfig:
     ])
     baseline: Optional[str] = "horovod_tpu/analysis/baseline.json"
     exclude: List[str] = field(default_factory=list)
+    # Per-file analysis cache (content-hash keyed module findings +
+    # taint summaries).  ``cache = ""`` in pyproject disables it.
+    cache: Optional[str] = ".hvdtpu-lint-cache.json"
 
 
 def find_pyproject(start: str) -> Optional[str]:
@@ -53,6 +56,8 @@ def load_config(root: str) -> LintConfig:
         cfg.baseline = table["baseline"] or None
     if "exclude" in table:
         cfg.exclude = list(table["exclude"])
+    if "cache" in table:
+        cfg.cache = table["cache"] or None
     return cfg
 
 

@@ -130,6 +130,12 @@ class ModuleModel:
     # import alias -> full module path ("np" -> "numpy").
     module_aliases: Dict[str, str] = field(default_factory=dict)
     suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    # Memo slot for astutil.enclosing_function_map: every rule family
+    # asks for the line->qualname map, and rebuilding it per rule was
+    # the single largest cost in a full-surface run.
+    fmap_cache: Optional[Dict[int, str]] = field(
+        default=None, repr=False, compare=False,
+    )
 
     @property
     def is_package_module(self) -> bool:

@@ -1,0 +1,326 @@
+"""HLO collective-schedule checker: the compiled-artifact gate.
+
+The source-level rules (HVD001/HVD010/...) reject schedules that *look*
+divergent; this module checks the property the runtime actually needs,
+on the artifact the runtime actually executes: **every rank's compiled
+program must issue the same collective sequence** — same op kinds, same
+order, same replica groups, same operand bytes.  PR 9 proved the idea
+for one program (``optim/overlap.inspect_schedule`` parses the
+scheduled module and counts in-backward collectives); this generalizes
+it into a standalone checker usable from CI for any compiled step:
+
+* :func:`extract_schedule` — parse ``compiled.as_text()`` (or the text
+  of a dumped module) and pull out the ordered collective sequence,
+  per computation, with op kind, dtype/element/byte accounting, replica
+  groups, and channel ids;
+* :func:`diff_schedules` — structural diff of N schedules (one per
+  rank, or per config expected to be identical), reporting the first
+  divergence in human-readable form;
+* a CLI — ``python -m horovod_tpu.analysis.hlo rank0=a.txt rank1=b.txt``
+  — exit 0 when all schedules agree, 1 on divergence, 2 on usage
+  errors, so the CI gate is one subprocess call.
+
+Stdlib-only, like the rest of the package: the *producer* of the HLO
+text needs jax; the checker must run anywhere (including on dumped
+artifacts from a TPU job, on a laptop without jax).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+HLO_SCHEMA = "hvdtpu-hlo-schedule-v1"
+
+# Ops that synchronize a group: if ranks disagree about any of these —
+# presence, order, group shape, payload — some subset blocks forever.
+# -start forms are the async halves; their -done twins are completion
+# bookkeeping and carry no new schedule information.
+COLLECTIVE_OPCODES = (
+    "all-reduce-start",
+    "all-reduce",
+    "reduce-scatter",
+    "all-gather-start",
+    "all-gather",
+    "all-to-all",
+    "collective-broadcast",
+    "collective-permute-start",
+    "collective-permute",
+)
+
+_BYTES: Dict[str, int] = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e5m2": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+    "f8e5m2fnuz": 1, "f8e4m3fnuz": 1,
+    "s4": 1, "u4": 1,  # rounded up; XLA packs two per byte
+}
+
+_SHAPE_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\]")
+# Shape = whatever sits between the '=' and the opcode token: tuple
+# shapes and tiled layouts ("{0:T(256)}") nest parens/braces too freely
+# for a structural match, and _SHAPE_RE re-scans the capture anyway.
+_OPCODE_RE = re.compile(
+    r"=\s+(?P<shape>\S.*?)\s+"
+    r"(?P<opcode>" + "|".join(COLLECTIVE_OPCODES) + r")\("
+)
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+# Both spellings: explicit groups `replica_groups={{0,1},{2,3}}` and the
+# iota form `replica_groups=[2,2]<=[4]`.
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}|\{\}|"
+    r"\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)"
+)
+_COMPUTATION_RE = re.compile(
+    r"^\s*(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*(?:\([^)]*\))?.*\{\s*$"
+)
+
+
+def _shape_elements(shape_text: str) -> Tuple[int, int]:
+    """(elements, bytes) over every array in a result shape (tuples
+    summed — an all-reduce over a tuple moves every element)."""
+    elements = 0
+    nbytes = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _BYTES:
+            continue  # token/opaque types move no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        elements += n
+        nbytes += n * _BYTES[dtype]
+    return elements, nbytes
+
+
+@dataclass(frozen=True)
+class CollectiveInstr:
+    """One collective instruction, position-independent facts only —
+    everything that must match across ranks for the schedule to be the
+    same program."""
+
+    opcode: str
+    shape: str           # normalized result shape (layout stripped)
+    elements: int
+    nbytes: int
+    replica_groups: str  # raw attribute text ("" when absent)
+    channel_id: Optional[int]
+    computation: str
+
+    def signature(self) -> Tuple:
+        return (self.opcode, self.shape, self.replica_groups,
+                self.channel_id)
+
+    def display(self) -> str:
+        grp = self.replica_groups or "<flat>"
+        ch = f", channel={self.channel_id}" \
+            if self.channel_id is not None else ""
+        return (f"{self.opcode} {self.shape} ({self.nbytes}B) "
+                f"groups={grp}{ch} in {self.computation}")
+
+    def as_dict(self) -> dict:
+        return {
+            "opcode": self.opcode, "shape": self.shape,
+            "elements": self.elements, "bytes": self.nbytes,
+            "replica_groups": self.replica_groups,
+            "channel_id": self.channel_id,
+            "computation": self.computation,
+        }
+
+
+@dataclass
+class CollectiveSchedule:
+    """The ordered collective sequence of one compiled program."""
+
+    label: str
+    instrs: List[CollectiveInstr] = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(i.nbytes for i in self.instrs)
+
+    def signatures(self) -> List[Tuple]:
+        return [i.signature() for i in self.instrs]
+
+    def as_dict(self) -> dict:
+        return {
+            "schema": HLO_SCHEMA,
+            "label": self.label,
+            "collectives": [i.as_dict() for i in self.instrs],
+            "total_bytes": self.total_bytes,
+        }
+
+
+def _normalize_shape(shape_text: str) -> str:
+    """Strip layout annotations: ``f32[8,4]{1,0}`` and ``f32[8,4]{0,1}``
+    are the same payload; layout is a backend choice, not a schedule
+    property."""
+    return re.sub(r"\]\{[^}]*\}", "]", shape_text).strip()
+
+
+def extract_schedule(text: str, label: str = "") -> CollectiveSchedule:
+    """Parse one HLO module's text into its collective sequence.
+
+    Instruction order within a computation IS execution order for
+    scheduled modules (``is_scheduled=true`` — what ``compiled
+    .as_text()`` prints); for unscheduled modules it is still the
+    deterministic def order, which is exactly as comparable across
+    ranks.  Collectives inside nested computations (while bodies,
+    conditionals) are collected under their computation's name so a
+    rank whose loop body differs is caught even when the entry
+    computations agree."""
+    sched = CollectiveSchedule(label=label)
+    computation = "<module>"
+    for line in text.splitlines():
+        comp = _COMPUTATION_RE.match(line)
+        if comp and ("(" in line or line.lstrip().startswith("ENTRY")):
+            computation = comp.group("name")
+            continue
+        m = _OPCODE_RE.search(line)
+        if not m:
+            continue
+        shape = _normalize_shape(m.group("shape"))
+        elements, nbytes = _shape_elements(shape)
+        ch = _CHANNEL_RE.search(line)
+        grp = _GROUPS_RE.search(line)
+        sched.instrs.append(CollectiveInstr(
+            opcode=m.group("opcode"),
+            shape=shape,
+            elements=elements,
+            nbytes=nbytes,
+            replica_groups=grp.group(1) if grp else "",
+            channel_id=int(ch.group(1)) if ch else None,
+            computation=computation,
+        ))
+    return sched
+
+
+def schedule_of(compiled_or_text, label: str = "") -> CollectiveSchedule:
+    """Convenience producer-side hook: accepts a lowered/compiled jax
+    object or raw text (mirrors ``optim/overlap.inspect_schedule``)."""
+    if hasattr(compiled_or_text, "compile"):
+        compiled_or_text = compiled_or_text.compile()
+    text = (compiled_or_text if isinstance(compiled_or_text, str)
+            else compiled_or_text.as_text())
+    return extract_schedule(text, label=label)
+
+
+def diff_schedules(
+    schedules: Sequence[CollectiveSchedule],
+) -> List[str]:
+    """Structural diff against the first schedule (the reference rank).
+    Empty list = every program issues the identical collective
+    sequence; otherwise each entry is one human-readable divergence.
+    """
+    if len(schedules) < 2:
+        return []
+    ref = schedules[0]
+    ref_sigs = ref.signatures()
+    problems: List[str] = []
+    for other in schedules[1:]:
+        sigs = other.signatures()
+        if sigs == ref_sigs:
+            continue
+        if len(sigs) != len(ref_sigs):
+            problems.append(
+                f"{other.label}: {len(sigs)} collective(s) vs "
+                f"{len(ref_sigs)} on {ref.label} — ranks disagree about "
+                f"HOW MANY collectives the program issues; the extras "
+                f"block forever"
+            )
+        n = min(len(sigs), len(ref_sigs))
+        for i in range(n):
+            if sigs[i] == ref_sigs[i]:
+                continue
+            problems.append(
+                f"{other.label}: collective #{i} diverges — "
+                f"{other.instrs[i].display()} vs "
+                f"{ref.instrs[i].display()} on {ref.label}"
+            )
+            break  # first divergence per pair: the rest is noise
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _parse_arg(arg: str) -> Tuple[str, str]:
+    """``label=path`` or bare ``path`` (label = path)."""
+    if "=" in arg:
+        label, path = arg.split("=", 1)
+        return label or path, path
+    return arg, arg
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse  # noqa: PLC0415
+
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.hlo",
+        description="diff the collective schedules of compiled HLO "
+                    "dumps: all ranks must compile the same sequence",
+    )
+    parser.add_argument(
+        "dumps", nargs="+", metavar="LABEL=PATH",
+        help="HLO text dumps to compare (first one is the reference); "
+             "bare paths use the path as the label",
+    )
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument(
+        "--expect-collectives", type=int, default=0, metavar="N",
+        help="fail unless the reference schedule has at least N "
+             "collectives (guards against a gate silently comparing "
+             "empty programs)",
+    )
+    args = parser.parse_args(argv)
+
+    schedules: List[CollectiveSchedule] = []
+    for arg in args.dumps:
+        label, path = _parse_arg(arg)
+        try:
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"hvdtpu-hlo: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        schedules.append(extract_schedule(text, label=label))
+
+    problems = diff_schedules(schedules)
+    ref = schedules[0]
+    if len(ref.instrs) < args.expect_collectives:
+        problems.insert(0, (
+            f"{ref.label}: expected >= {args.expect_collectives} "
+            f"collectives, found {len(ref.instrs)} — wrong dump, or "
+            f"the program under test lost its collectives"
+        ))
+
+    if args.format == "json":
+        print(json.dumps({
+            "schema": HLO_SCHEMA,
+            "schedules": [s.as_dict() for s in schedules],
+            "divergences": problems,
+        }, indent=2))
+    else:
+        for s in schedules:
+            print(f"{s.label}: {len(s.instrs)} collective(s), "
+                  f"{s.total_bytes} payload bytes")
+        for p in problems:
+            print(f"DIVERGENCE: {p}")
+        if not problems:
+            print(f"hvdtpu-hlo: {len(schedules)} schedule(s) identical")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CI gate
+    sys.exit(main())

@@ -1,0 +1,403 @@
+"""Mesh model: what the analyzer knows about named axis subgroups.
+
+PR 8 gave the runtime a (slice, host, chip) mesh and PR 9/10 run
+collectives over *named axis subgroups* (``psum(..., LOCAL_AXIS)``
+inside ``shard_map`` bodies, ``hierarchical_axes=(local, cross)``).
+Rank divergence **within** one of those groups is exactly the HVD001
+deadlock class, but the world-collective rules cannot see it: a branch
+on ``cross_rank()`` is perfectly safe around a LOCAL_AXIS collective
+(every member of a local group shares the cross index) and fatal around
+a CROSS_AXIS one.  This module centralizes that judgement:
+
+* canonical **axis scopes** ("world"/"slice"/"cross"/"local"/literal
+  axis names) and the mapping from the repo's axis constants to them;
+* **rank-source classification** — which calls/env reads produce a
+  value that differs across ranks, and along which axis;
+* **subgroup-collective recognition** (``lax.psum``/``psum_scatter``/
+  ``all_gather``/... plus the hierarchical plane's wrappers) and axis
+  extraction from their call sites;
+* the **divergence judgement** ``diverges(scope, axes)``;
+* **sanitizers** — collectives whose *result* is rank-uniform along the
+  reduced axis (an allreduce/broadcast result is the same everywhere:
+  branching on it is safe);
+* the **deterministic-contract registry** (HVD012): functions whose
+  outputs must be a pure function of their inputs on every rank — the
+  serve scheduler's documented purity contract and the trace sampler —
+  plus the ``# hvdtpu: deterministic`` source annotation, and the
+  impure-input classifier used against them.
+
+Stdlib-only, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import astutil
+from .core import ModuleModel
+
+# ---------------------------------------------------------------------------
+# axis scopes
+# ---------------------------------------------------------------------------
+
+WORLD = "world"
+
+# Literal axis-name values used across the repo (basics.py,
+# runtime/device_plane.py) -> canonical scope.  Kept as literals on
+# purpose: the analyzer must not import the runtime.
+_AXIS_LITERALS: Dict[str, str] = {
+    "hvd": WORLD,            # DP_AXIS — the flat data-parallel world
+    "hvdtpu_proc": WORLD,    # PROC_AXIS — device-plane process axis
+    "hvd_local": "local",    # LOCAL_AXIS
+    "hvdtpu_ici": "local",   # ICI_AXIS
+    "hvd_cross": "cross",    # CROSS_AXIS
+    "hvdtpu_dcn": "cross",   # DCN_AXIS
+    "hvd_slice": "slice",    # SLICE_AXIS
+}
+
+# Symbolic spellings (Name/attribute references to the axis constants,
+# and the conventional parameter names of the hierarchical plane).
+_AXIS_SYMBOLS: Dict[str, str] = {
+    "DP_AXIS": WORLD, "PROC_AXIS": WORLD,
+    "LOCAL_AXIS": "local", "ICI_AXIS": "local", "local_axis": "local",
+    "CROSS_AXIS": "cross", "DCN_AXIS": "cross", "cross_axis": "cross",
+    "SLICE_AXIS": "slice", "slice_axis": "slice",
+}
+
+UNKNOWN_AXIS = "?"
+
+
+def canon_axis(token: str) -> str:
+    """Literal axis string -> canonical scope (unknown literals map to
+    themselves: ``psum(x, "model")`` guarded by ``axis_index("model")``
+    must still match)."""
+    return _AXIS_LITERALS.get(token, token)
+
+
+def axis_tokens(expr: Optional[ast.expr]) -> List[str]:
+    """Canonical axis tokens an axis-name argument can denote."""
+    if expr is None:
+        return [UNKNOWN_AXIS]
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [canon_axis(expr.value)]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in expr.elts:
+            out.extend(axis_tokens(e))
+        return out
+    if isinstance(expr, ast.Name):
+        return [_AXIS_SYMBOLS.get(expr.id, UNKNOWN_AXIS)]
+    if isinstance(expr, ast.Attribute):
+        return [_AXIS_SYMBOLS.get(expr.attr, UNKNOWN_AXIS)]
+    return [UNKNOWN_AXIS]
+
+
+def diverges(scope: str, axes: List[str]) -> bool:
+    """May a value tainted with ``scope`` differ between members of a
+    collective group over ``axes``?
+
+    The mesh-aware part: taint scoped to axis B is *uniform* within a
+    group over axis A != B (the group fixes every other coordinate), so
+    only a matching axis — or world-scoped taint, which differs along
+    every axis — diverges.  Unknown axes stay quiet for scoped taint
+    (over-firing on unresolvable axis names would drown the signal) but
+    world taint always fires: the world rank differs inside every
+    conceivable subgroup."""
+    if scope in (WORLD, UNKNOWN_AXIS):
+        return True
+    if WORLD in axes:
+        # A world collective's group is everyone: any per-rank scope
+        # varies inside it (local_rank differs across hosts too).
+        return True
+    return scope in axes
+
+
+# ---------------------------------------------------------------------------
+# rank sources
+# ---------------------------------------------------------------------------
+
+# call name -> fixed scope (None: scope comes from the axis argument)
+_SOURCE_CALLS: Dict[str, Optional[str]] = {
+    "rank": WORLD,
+    "device_rank": WORLD,
+    "process_index": WORLD,   # jax.process_index()
+    "local_rank": "local",
+    "cross_rank": "cross",
+    "slice_id": "slice",
+    "axis_rank": None,
+    "axis_index": None,
+}
+
+_ENV_SCOPE_RE: List[Tuple[re.Pattern, str]] = [
+    (re.compile(r"SLICE", re.I), "slice"),
+    (re.compile(r"RANK|PROCESS_INDEX|PMI|PROC_ID", re.I), WORLD),
+]
+
+
+def _env_key_scope(key: str) -> Optional[str]:
+    for pat, scope in _ENV_SCOPE_RE:
+        if pat.search(key):
+            return scope
+    return None
+
+
+def source_scope(node: ast.expr) -> Optional[Tuple[str, str]]:
+    """``(scope, witness)`` when ``node`` is a rank source expression:
+    a topology call, ``lax.axis_index(axis)``, or an env lookup of a
+    rank-shaped key.  ``None`` otherwise."""
+    if isinstance(node, ast.Call):
+        name = astutil.call_name(node)
+        if name in _SOURCE_CALLS:
+            fixed = _SOURCE_CALLS[name]
+            if fixed is not None:
+                return fixed, f"{name}()"
+            axis_expr = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis_expr = kw.value
+            if axis_expr is None:  # axis_rank() defaults to DP_AXIS
+                return WORLD, f"{name}()"
+            toks = axis_tokens(axis_expr)
+            scope = toks[0] if len(toks) == 1 else UNKNOWN_AXIS
+            return scope, f"{name}({astutil.expr_text(axis_expr)})"
+        # os.environ.get("HOROVOD_RANK") / os.getenv("...")
+        if name in ("get", "getenv") and node.args:
+            recv = astutil.expr_text(node.func)
+            if "environ" in recv or name == "getenv":
+                key = node.args[0]
+                if isinstance(key, ast.Constant) and \
+                        isinstance(key.value, str):
+                    scope = _env_key_scope(key.value)
+                    if scope is not None:
+                        return scope, f"env[{key.value!r}]"
+    if isinstance(node, ast.Subscript):
+        base = astutil.expr_text(node.value)
+        if "environ" in base:
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                scope = _env_key_scope(sl.value)
+                if scope is not None:
+                    return scope, f"env[{sl.value!r}]"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# subgroup collectives + axis extraction
+# ---------------------------------------------------------------------------
+
+# jax.lax collectives whose 2nd positional arg (or axis_name=) is the
+# axis-name binding.
+_LAX_COLLECTIVES: Set[str] = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "all_to_all", "ppermute", "pshuffle", "pbroadcast",
+}
+# horovod_tpu wrappers carrying axis names in kwargs.
+_HVD_AXIS_COLLECTIVES: Set[str] = {
+    "hierarchical_allreduce", "hierarchical_adasum",
+    "hierarchical_reduce_scatter", "hierarchical_all_gather",
+    "adasum_allreduce",
+}
+_HIER_DEFAULT_AXES = ["local", "cross"]
+
+
+def _laxish(node: ast.Call, model: ModuleModel) -> bool:
+    recv = astutil.receiver_name(node)
+    if recv is not None:
+        target = model.module_aliases.get(recv, recv)
+        return target == "lax" or target.endswith(".lax") or recv == "lax"
+    name = astutil.call_name(node)
+    origin = model.from_imports.get(name or "")
+    if origin is not None:
+        mod = origin[0]
+        return mod == "jax.lax" or mod.endswith(".lax") or mod == "jax"
+    return False
+
+
+def _hvdish(node: ast.Call, model: ModuleModel) -> bool:
+    name = astutil.call_name(node)
+    if isinstance(node.func, ast.Attribute):
+        return True
+    origin = model.from_imports.get(name or "")
+    if origin is not None:
+        mod = origin[0]
+        return mod == "" or "horovod_tpu" in mod or mod.startswith(".")
+    return model.is_package_module
+
+
+def collective_axes(node: ast.Call,
+                    model: ModuleModel) -> Optional[List[str]]:
+    """Canonical axis tokens of a collective call, or ``None`` when the
+    call is not a recognized collective.
+
+    ``["world"]`` marks world-group collectives (the eager ``hvd.*``
+    surface and lax collectives over the data-parallel axis); anything
+    else is a *subgroup* collective."""
+    name = astutil.call_name(node)
+    if name is None:
+        return None
+    if name in _LAX_COLLECTIVES and _laxish(node, model):
+        axis_expr: Optional[ast.expr] = (
+            node.args[1] if len(node.args) >= 2 else None
+        )
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis_expr = kw.value
+        return axis_tokens(axis_expr)
+    if name in _HVD_AXIS_COLLECTIVES and _hvdish(node, model):
+        axes: List[str] = []
+        for kw in node.keywords:
+            if kw.arg in ("local_axis", "cross_axis", "axis_name"):
+                axes.extend(axis_tokens(kw.value))
+            elif kw.arg == "hierarchical_axes":
+                axes.extend(axis_tokens(kw.value))
+        if not axes:
+            axes = (list(_HIER_DEFAULT_AXES)
+                    if name.startswith("hierarchical_")
+                    else [WORLD])
+        return axes
+    if astutil.is_collective_call(node, model):
+        # The eager world surface — unless an explicit axis_name kwarg
+        # narrows it to a subgroup (ops.collectives under tracing).
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                return axis_tokens(kw.value)
+        return [WORLD]
+    return None
+
+
+def is_subgroup(axes: List[str]) -> bool:
+    return axes != [WORLD]
+
+
+# ---------------------------------------------------------------------------
+# sanitizers: rank-uniform results
+# ---------------------------------------------------------------------------
+
+# World collectives whose RESULT is identical on every rank: assigning
+# through one launders any rank taint (the satellite "sanitized by a
+# uniform broadcast" case).  allgather/barrier included: the gathered
+# tuple is the same everywhere.
+_WORLD_SANITIZERS: Set[str] = {
+    "allreduce", "allreduce_", "grouped_allreduce", "allgather",
+    "broadcast", "broadcast_", "broadcast_object", "broadcast_parameters",
+    "broadcast_variables", "broadcast_optimizer_state", "sync_state",
+}
+
+
+def sanitizer_axes(node: ast.Call,
+                   model: ModuleModel) -> Optional[List[str]]:
+    """Axes along which this call's result is uniform, or None.
+
+    A ``psum(x, A)`` result is uniform along A but still differs across
+    the other axes; a world allreduce/broadcast result is uniform
+    everywhere (returns ``["world"]``, treated as clearing all taint)."""
+    name = astutil.call_name(node)
+    if name in _LAX_COLLECTIVES and name not in (
+        "psum_scatter", "all_to_all", "ppermute", "pshuffle",
+    ) and _laxish(node, model):
+        axes = collective_axes(node, model)
+        return axes
+    if name in _WORLD_SANITIZERS and astutil.is_collective_call(
+            node, model):
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                return axis_tokens(kw.value)
+        return [WORLD]
+    if name in ("hierarchical_allreduce", "hierarchical_all_gather") \
+            and _hvdish(node, model):
+        return collective_axes(node, model)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# deterministic contracts (HVD012)
+# ---------------------------------------------------------------------------
+
+# Built-in contract surface: the serve scheduler module is documented as
+# a pure state machine ("every rank derives the identical schedule" —
+# serve/scheduler.py docstring, the serving HVD001 invariant), and the
+# trace sampler's verdict must be a pure function of the trace id
+# (obs/trace.py, the PR-11 determinism contract).  "*" = every function
+# in the module.
+CONTRACT_REGISTRY: Dict[str, Set[str]] = {
+    "horovod_tpu/serve/scheduler.py": {"*"},
+    "horovod_tpu/obs/trace.py": {"sampled"},
+}
+
+_CONTRACT_COMMENT_RE = re.compile(r"#\s*hvdtpu:\s*deterministic\b")
+
+
+def contract_functions(model: ModuleModel) -> Dict[str, int]:
+    """qualname -> def line of every function in ``model`` bound by a
+    determinism contract (registry match or ``# hvdtpu: deterministic``
+    on the def line / the line above)."""
+    out: Dict[str, int] = {}
+    registered = CONTRACT_REGISTRY.get(model.relpath, set())
+    annotated: Set[int] = set()
+    for i, line in enumerate(model.source.splitlines(), start=1):
+        if _CONTRACT_COMMENT_RE.search(line):
+            annotated.add(i)
+
+    for qn, node in astutil.iter_defs(model.tree):
+        lines = {node.lineno, node.lineno - 1}
+        for deco in node.decorator_list:
+            lines.add(deco.lineno - 1)
+        if "*" in registered or qn in registered \
+                or node.name in registered \
+                or lines & annotated:
+            out[qn] = node.lineno
+    return out
+
+
+# impure-input classifier: calls whose value differs per rank, per run,
+# or per PYTHONHASHSEED — poison for a deterministic scheduler.
+_IMPURE_MODULE_CALLS: Set[Tuple[str, str]] = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("time", "time_ns"), ("time", "monotonic_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("os", "urandom"), ("uuid", "uuid1"), ("uuid", "uuid4"),
+    ("secrets", "token_hex"), ("secrets", "token_bytes"),
+    ("random", "random"), ("random", "randint"), ("random", "choice"),
+    ("random", "shuffle"), ("random", "sample"), ("random", "uniform"),
+    ("random", "randrange"), ("random", "getrandbits"),
+}
+_IMPURE_BARE_CALLS: Set[str] = {"hash", "id"}
+
+
+def impurity_of_call(node: ast.Call,
+                     model: ModuleModel) -> Optional[str]:
+    """Why this call's result is not a deterministic function of its
+    inputs, or None.  jax.random is exempt (explicit-key, deterministic
+    by construction)."""
+    name = astutil.call_name(node)
+    recv = astutil.receiver_name(node)
+    if recv is not None:
+        target = model.module_aliases.get(recv, recv)
+        if "jax" in target:
+            return None
+        base = target.rsplit(".", 1)[-1]
+        if (base, name) in _IMPURE_MODULE_CALLS:
+            return f"{base}.{name}()"
+        # np.random.randint / random.choice / rng-module methods: any
+        # call whose dotted receiver PATH contains a `random` segment
+        # (the base-name check alone let `np.random.*` through).
+        if recv != "self" and isinstance(node.func, ast.Attribute):
+            segments = astutil.expr_text(node.func.value).split(".")
+            segments[0] = target
+            if any(seg == "random" for seg in segments):
+                return f"{'.'.join(segments)}.{name}()"
+    else:
+        if name in _IMPURE_BARE_CALLS and isinstance(node.func, ast.Name):
+            return f"{name}() (PYTHONHASHSEED/per-process value)"
+        origin = model.from_imports.get(name or "")
+        if origin is not None and (origin[0], origin[1]) in (
+            ("time", "time"), ("time", "monotonic"),
+            ("time", "perf_counter"),
+        ):
+            return f"{name}() [from {origin[0]}]"
+    src = source_scope(node)
+    if src is not None:
+        return f"rank source {src[1]}"
+    return None

@@ -50,6 +50,7 @@ def _load() -> None:
     # Import for side effect: the @rule decorators populate the tables.
     from . import rules_spmd  # noqa: F401, PLC0415
     from . import rules_concurrency  # noqa: F401, PLC0415
+    from . import rules_mesh  # noqa: F401, PLC0415
 
 
 def all_rules() -> Dict[str, Rule]:

@@ -16,27 +16,17 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from . import astutil, signals
 from .core import ModuleModel, SEV_ERROR, SEV_WARNING, Finding
-from .lockgraph import CallGraph, nodes_under_with
+from .lockgraph import CallGraph, nodes_under_with, shared_callgraph
 from .registry import make_finding, rule
 
 FuncKey = Tuple[str, str]
 
 # Built once per run (the CLI analyzes one model set per process);
-# project rules share the graph instead of rebuilding it five times.
-# Keyed by content, not object identity: id() can be recycled across
-# analyze_paths() calls and would hand a stale graph to fresh models.
-_GRAPH_CACHE: Dict[tuple, CallGraph] = {}
-
-
+# Project rules share ONE closed call graph per model set — the memo
+# lives in lockgraph.shared_callgraph so the mesh-taint family reuses
+# the same graph instead of re-indexing every file.
 def _graph(models: List[ModuleModel]) -> CallGraph:
-    key = tuple((m.relpath, hash(m.source)) for m in models)
-    g = _GRAPH_CACHE.get(key)
-    if g is None:
-        _GRAPH_CACHE.clear()
-        g = CallGraph(models)
-        g.close_summaries()
-        _GRAPH_CACHE[key] = g
-    return g
+    return shared_callgraph(models)
 
 
 def _model_by_relpath(models: List[ModuleModel],
@@ -198,10 +188,21 @@ def hvdc102(models: List[ModuleModel]) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
+# Four rules walk the same reachability set; computing roots re-walks
+# every function's AST, so share one result per graph instance.
+_REACH_MEMO: List[tuple] = []
+
+
 def _signal_reachability(models: List[ModuleModel]):
     graph = _graph(models)
+    for held, reach in _REACH_MEMO:
+        if held is graph:
+            return graph, reach
     roots = signals.find_roots(graph)
-    return graph, signals.reachable_from(graph, roots)
+    reach = signals.reachable_from(graph, roots)
+    del _REACH_MEMO[:]
+    _REACH_MEMO.append((graph, reach))
+    return graph, reach
 
 
 @rule("HVDC103", "nonreentrant-lock-in-signal-path", SEV_ERROR,

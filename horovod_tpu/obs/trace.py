@@ -92,6 +92,7 @@ def sample_rate() -> float:
     return envmod.env_float(envmod.TRACE_SAMPLE_RATE, 1.0)
 
 
+# hvdtpu: deterministic
 def sampled(trace_id: str, rate: Optional[float] = None) -> bool:
     """Deterministic sampling verdict for one trace id.
 

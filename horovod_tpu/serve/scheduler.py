@@ -20,6 +20,12 @@ training side, which is why nothing in this file may consult
 ``hvd.rank()``, a wall clock, or an unordered dict iteration.  Unit
 tests drive the decision table directly (tests/test_serve.py), and the
 multi-rank determinism test replays one trace through N instances.
+
+Since PR 12 the contract is also *statically checked*: hvdtpu-lint's
+HVD012 registers this module (and anything marked ``# hvdtpu:
+deterministic``) as a determinism contract and rejects any clock /
+``random`` / hash-order / rank read in its call tree at lint time —
+the invariant holds on every diff, not just when the replay test runs.
 """
 
 from __future__ import annotations
@@ -139,6 +145,7 @@ class SlotScheduler:
     def free_slots(self) -> List[int]:
         return [s for s in range(self.num_slots) if s not in self.active]
 
+    # hvdtpu: deterministic
     def admit(self, step: int = 0) -> List[Admission]:
         """Admit queued requests into free slots: FCFS, lowest slot
         first.  Mutates the schedule and returns the admissions in
@@ -170,6 +177,7 @@ class SlotScheduler:
             )
         act.emitted.append(int(token))
 
+    # hvdtpu: deterministic
     def evict_finished(self) -> List[Eviction]:
         """Evict every finished slot (ascending order), freeing it for
         the next step's admissions."""

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""HLO collective-schedule gate: every rank must compile the same program.
+
+The source-level lint (HVD001/HVD010) rejects rank-divergent schedules
+it can see in the AST; this gate checks the property on the artifact:
+each simulated rank compiles the repo's three collective-bearing step
+programs — the engine-style fused allreduce, the overlap bucket train
+step, and the serve sequence-sharded decode attention step — in its own
+process (rank-specific env, exactly how a real launcher differs per
+rank), dumps the scheduled HLO, and
+``python -m horovod_tpu.analysis.hlo`` asserts the extracted collective
+sequences are identical.  Any code path that lets the rank leak into
+the compiled schedule (a rank-guarded collective, a rank-dependent
+bucket layout, a rank-chosen axis) diverges the dumps and fails CI.
+
+    python scripts/hlo_gate.py                 # the gate (exit != 0 on divergence)
+    python scripts/hlo_gate.py --seed-divergence   # self-test: a seeded
+        # rank-guarded collective MUST be rejected (exit 0 iff it was)
+
+Internal: ``--emit RANK`` runs the per-rank compile half (spawned by
+the driver with JAX_PLATFORMS=cpu and a 4-device host platform).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROGRAMS = ("engine_allreduce", "overlap_bucket", "serve_decode")
+WORLD = 2  # simulated ranks; each compiles in its own process
+
+
+# ---------------------------------------------------------------------------
+# per-rank emitter (subprocess half)
+# ---------------------------------------------------------------------------
+
+
+def _emit(rank: int, out_dir: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.optim import overlap
+    from horovod_tpu.ops.collectives import shard_map_compat
+    from horovod_tpu.serve.longctx import sharded_decode_attention
+
+    seed_divergent = os.environ.get("HVDTPU_HLO_GATE_DIVERGE") == "1"
+    mesh = Mesh(np.asarray(jax.devices(), dtype=object).reshape(4),
+                (hvd.DP_AXIS,))
+
+    def dump(name: str, lowered) -> None:
+        text = lowered.compile().as_text()
+        path = os.path.join(out_dir, f"{name}.rank{rank}.txt")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+
+    # (1) engine-style fused allreduce: the device plane's jitted
+    # shard_map psum over the staged (world, n) buffer, pre/post scaled
+    # (the Average path).  The seeded divergence is the HVD010 bug as
+    # an artifact: a collective only SOME ranks compile.
+    def fused_allreduce(x):
+        v = x * (1.0 / 4.0)
+        total = lax.psum(v, hvd.DP_AXIS)
+        if seed_divergent and rank != 0:
+            total = total + lax.psum(jnp.sum(v), hvd.DP_AXIS)
+        return total
+
+    fn = jax.jit(shard_map_compat(
+        fused_allreduce, mesh=mesh,
+        in_specs=P(hvd.DP_AXIS), out_specs=P(),
+    ))
+    dump("engine_allreduce",
+         fn.lower(jnp.ones((4, 64), jnp.float32)))
+
+    # (2) overlap bucket train step: the PR-9 plane end to end (bucket
+    # collectives planted in the backward), compiled exactly as the CI
+    # overlap gate compiles it.
+    def init_params(key):
+        sizes = [16, 32, 32, 8]
+        params = []
+        for i in range(3):
+            k, key = jax.random.split(key)
+            params.append({
+                "w": jax.random.normal(k, (sizes[i], sizes[i + 1])) * .1,
+                "b": jnp.zeros(sizes[i + 1]),
+            })
+        return params
+
+    def loss_fn(params, x, y):
+        h = x
+        for i, layer in enumerate(params):
+            h = h @ layer["w"] + layer["b"]
+            if i < 2:
+                h = jax.nn.relu(h)
+        return jnp.mean((h - y) ** 2)
+
+    params = init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    y = jax.random.normal(jax.random.PRNGKey(2), (8, 8))
+    plan = overlap.OverlapPlan(params, optax.sgd(0.05), mode="bucket",
+                               mesh=mesh, bucket_mb=2 / 1024.0)
+    spec = plan.state_spec()
+    step = jax.jit(shard_map_compat(
+        plan.local_step(loss_fn), mesh=mesh,
+        in_specs=(spec, P(hvd.DP_AXIS), P(hvd.DP_AXIS)),
+        out_specs=(spec, P()),
+    ), donate_argnums=(0,))
+    dump("overlap_bucket", step.lower(plan.init(params), x, y))
+
+    # (3) serve decode: the sequence-sharded decode attention step
+    # (pmax + psum merge per decode step) over a 4-way sharded cache.
+    import types
+    cfg = types.SimpleNamespace(kv_heads=2, attention_window=None)
+
+    def decode(q, k, v, pos):
+        return sharded_decode_attention(cfg, q, k, v, pos, hvd.DP_AXIS)
+
+    b, h, hd, s = 2, 4, 8, 32
+    dec = jax.jit(shard_map_compat(
+        decode, mesh=mesh,
+        in_specs=(P(), P(None, hvd.DP_AXIS), P(None, hvd.DP_AXIS), P()),
+        out_specs=P(),
+    ))
+    dump("serve_decode", dec.lower(
+        jnp.ones((b, h, hd), jnp.float32),
+        jnp.ones((b, s, 2, hd), jnp.float32),
+        jnp.ones((b, s, 2, hd), jnp.float32),
+        jnp.full((b,), 7, jnp.int32),
+    ))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _spawn_rank(rank: int, out_dir: str, diverge: bool) -> None:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        # Rank-specific env, like a real launcher: the gate's whole
+        # claim is that none of this may reach the artifact.
+        "HOROVOD_RANK": str(rank),
+        "HVDTPU_HLO_GATE_DIVERGE": "1" if diverge else "0",
+    })
+    subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--emit", str(rank), "--out", out_dir],
+        env=env, cwd=REPO, check=True, timeout=600,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--emit", type=int, default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--seed-divergence", action="store_true",
+                        help="self-test: assert a rank-guarded "
+                             "collective is rejected")
+    args = parser.parse_args(argv)
+
+    if args.emit is not None:
+        _emit(args.emit, args.out)
+        return 0
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="hvdtpu-hlo-gate.")
+    for rank in range(WORLD):
+        _spawn_rank(rank, out_dir, args.seed_divergence)
+
+    failures = 0
+    for prog in PROGRAMS:
+        dumps = [
+            f"rank{r}={os.path.join(out_dir, f'{prog}.rank{r}.txt')}"
+            for r in range(WORLD)
+        ]
+        rc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis.hlo",
+             *dumps, "--expect-collectives", "1"],
+            cwd=REPO, timeout=120,
+            env={**os.environ,
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+        ).returncode
+        expect_divergence = args.seed_divergence \
+            and prog == "engine_allreduce"
+        if expect_divergence:
+            # rc == 1 exactly: the documented divergence verdict.  A
+            # rc of 2 means the checker never compared anything
+            # (unreadable dump) — accepting it would let a blind
+            # checker pass its own blindness test.
+            if rc != 1:
+                print(f"hlo gate SELF-TEST FAILED: seeded divergent "
+                      f"{prog} schedule was not rejected as a "
+                      f"divergence (exit {rc})", file=sys.stderr)
+                failures += 1
+            else:
+                print(f"hlo gate self-test OK: seeded divergent {prog} "
+                      f"rejected (exit {rc})")
+        elif rc != 0:
+            print(f"hlo gate FAILED: {prog} schedules diverge across "
+                  f"ranks (exit {rc})", file=sys.stderr)
+            failures += 1
+    if failures == 0:
+        mode = "self-test" if args.seed_divergence else "gate"
+        print(f"hlo {mode} OK: {len(PROGRAMS)} program(s) x {WORLD} "
+              f"rank(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -198,6 +198,76 @@ FIXTURES = {
         step = jax.jit(local_step, donate_argnums=(0, 1))
         """,
     ),
+    "HVD010": (
+        """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def reduce_part(flag, x):
+            if flag == 0:
+                return lax.psum(x, "hvd_local")
+            return x
+
+        def step(x):
+            return reduce_part(hvd.local_rank(), x)
+        """,
+        """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def reduce_part(flag, x):
+            y = lax.psum(x, "hvd_local")
+            if flag == 0:
+                return y
+            return y * 0
+
+        def step(x):
+            return reduce_part(hvd.local_rank(), x)
+        """,
+    ),
+    "HVD011": (
+        """
+        from jax import lax
+
+        def step(x, fast_path):
+            axis = "hvd_local" if fast_path else "hvd_cross"
+            return lax.psum(x, axis)
+        """,
+        """
+        from jax import lax
+
+        def step(x):
+            return lax.psum(x, ("hvd_local", "hvd_cross"))
+        """,
+    ),
+    "HVD012": (
+        """
+        import random
+
+        # hvdtpu: deterministic
+        def pick_slot(queue, slots):
+            return random.choice(slots)
+        """,
+        """
+        # hvdtpu: deterministic
+        def pick_slot(queue, slots):
+            return min(slots)
+        """,
+    ),
+    "HVD013": (
+        """
+        import horovod_tpu as hvd
+
+        def record(trace, tid, t0, t1):
+            if hvd.rank() == 0:
+                trace.add_span(tid, "decode", t0, t1)
+        """,
+        """
+        def record(trace, tid, t0, t1, enabled):
+            if enabled:
+                trace.add_span(tid, "decode", t0, t1)
+        """,
+    ),
     "HVDC101": (
         """
         import threading
@@ -641,6 +711,223 @@ def test_thread_target_closure_not_signal_reachable(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# interprocedural taint edge cases (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_hvd010_taint_through_kwarg(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def reduce_part(x, flag=0):
+            if flag == 0:
+                return lax.psum(x, "hvd_local")
+            return x
+
+        def step(x):
+            return reduce_part(x, flag=hvd.local_rank())
+    """)
+    hits = _new(findings, "HVD010")
+    assert hits and "flag" in hits[0].message, \
+        [f.message for f in findings]
+
+
+def test_hvd010_taint_through_returned_tuple(tmp_path):
+    # A rank carried inside a returned tuple must not launder through
+    # unpacking.
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def who_and_size():
+            return hvd.rank(), hvd.size()
+
+        def step(x):
+            r, n = who_and_size()
+            if r == 0:
+                return lax.psum(x, "hvd_local")
+            return x
+    """)
+    hits = _new(findings, "HVD010")
+    assert hits and "who_and_size" in hits[0].message, \
+        [f.message for f in findings]
+
+
+def test_hvd010_sanitized_by_uniform_broadcast(tmp_path):
+    # An allreduce result is identical on every rank: branching on it
+    # is safe even though a rank value flowed in.
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def step(x):
+            chief = hvd.allreduce(hvd.rank(), name="who")
+            if chief == 0:
+                return lax.psum(x, "hvd_local")
+            return x
+    """)
+    assert not _new(findings, "HVD010"), \
+        [f.message for f in _new(findings, "HVD010")]
+
+
+def test_hvd010_three_frame_chain_attribution(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def helper(x, r):
+            if r > 0:
+                return x
+            return lax.psum(x, "hvd_cross")
+
+        def mid(x, rr):
+            return helper(x, rr)
+
+        def top(x):
+            return mid(x, hvd.cross_rank())
+    """)
+    hits = _new(findings, "HVD010")
+    assert hits, [f.message for f in findings]
+    # call-chain attribution: every frame named, caller-first
+    msg = hits[0].message
+    assert "top" in msg and "mid" in msg and "helper" in msg
+
+
+def test_hvd010_scoped_taint_is_uniform_off_axis(tmp_path):
+    # local_rank() differs WITHIN a local group but is uniform within a
+    # cross group (the group fixes every other mesh coordinate): a
+    # local-scoped guard around a CROSS collective must stay quiet.
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def reduce_cross(x, lr):
+            if lr == 0:
+                return lax.psum(x, "hvd_cross")
+            return x
+
+        def step(x):
+            return reduce_cross(x, hvd.local_rank())
+    """)
+    assert not _new(findings, "HVD010"), \
+        [f.message for f in _new(findings, "HVD010")]
+
+
+def test_hvd010_param_laundered_in_callee_stays_quiet(tmp_path):
+    # The callee itself launders the tainted parameter along the
+    # collective's axis before branching on it: uniform by the time it
+    # reaches the guard, whatever the caller passed in.  Two distinct
+    # regressions hid here — ValueTaint.merge wiped the sanitized set
+    # when merging into a fresh value, and the parameter-hazard path
+    # never consulted it.
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def reduce_part(flag, x):
+            flag = lax.psum(flag, "hvd_local")
+            if flag == 0:
+                return lax.psum(x, "hvd_local")
+            return x
+
+        def step(x):
+            return reduce_part(hvd.local_rank(), x)
+    """)
+    assert not _new(findings, "HVD010"), \
+        [f.message for f in _new(findings, "HVD010")]
+
+
+def test_hvd011_same_name_in_unrelated_functions_stays_quiet(tmp_path):
+    # Two helpers each binding their own constant `axis` are two
+    # single-axis call sites — the assignment map is scoped per
+    # enclosing function, not per file.
+    findings = _lint_source(tmp_path, """
+        from jax import lax
+
+        def local_reduce(x):
+            axis = "hvd_local"
+            return lax.psum(x, axis)
+
+        def cross_reduce(x):
+            axis = "hvd_cross"
+            return lax.psum(x, axis)
+    """)
+    assert not _new(findings, "HVD011"), \
+        [f.message for f in _new(findings, "HVD011")]
+
+
+def test_hvd011_reassigned_selector_in_one_function_fires(tmp_path):
+    findings = _lint_source(tmp_path, """
+        from jax import lax
+
+        def pick(x, fast):
+            axis = "hvd_local"
+            if fast:
+                axis = "hvd_cross"
+            return lax.psum(x, axis)
+    """)
+    assert _new(findings, "HVD011")
+
+
+def test_hvd010_world_taint_diverges_in_every_subgroup(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from jax import lax
+
+        def step(x):
+            if hvd.rank() == 0:
+                return lax.psum(x, "hvd_local")
+            return x
+    """)
+    assert _new(findings, "HVD010")
+
+
+def test_hvd012_impure_helper_via_call_tree(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import time
+
+        def now_ms():
+            return time.time() * 1000
+
+        # hvdtpu: deterministic
+        def pick_slot(queue, slots):
+            t = now_ms()
+            return slots[int(t) % len(slots)]
+    """)
+    hits = _new(findings, "HVD012")
+    assert hits and "now_ms" in " ".join(f.message for f in hits), \
+        [f.message for f in findings]
+
+
+def test_hvd012_impure_arg_into_contract_function(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import random
+
+        # hvdtpu: deterministic
+        def pick_slot(queue, seed):
+            return queue[seed % len(queue)]
+
+        def caller(queue):
+            return pick_slot(queue, random.randint(0, 7))
+    """)
+    hits = _new(findings, "HVD012")
+    assert hits and any("flows into" in f.message for f in hits), \
+        [f.message for f in findings]
+
+
+def test_hvd013_rank_in_sampled_args(tmp_path):
+    findings = _lint_source(tmp_path, """
+        import horovod_tpu as hvd
+        from horovod_tpu.obs.trace import sampled
+
+        def should_trace(tid):
+            return sampled(f"{tid}-{hvd.rank()}")
+    """)
+    assert _new(findings, "HVD013")
+
+
+# ---------------------------------------------------------------------------
 # PR-4 regression: the reentrant-flush deadlock shape
 # ---------------------------------------------------------------------------
 
@@ -944,6 +1231,198 @@ def test_cli_changed_without_git_is_exit_2(tmp_path):
     r = _run_cli(["--changed", "--root", str(tmp_path)], cwd=tmp_path)
     assert r.returncode == 2, r.stdout + r.stderr
     assert "git" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# per-file cache + baseline pruning + --changed robustness (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_roundtrip_same_findings(tmp_path):
+    from horovod_tpu.analysis import cache as cache_mod
+
+    (tmp_path / "bad.py").write_text(
+        textwrap.dedent(FIXTURES["HVD001"][0]))
+    cp = str(tmp_path / "cache.json")
+    cold = analyze_paths([str(tmp_path / "bad.py")],
+                         root=str(tmp_path), cache_path=cp)
+    assert os.path.isfile(cp)
+    assert cache_mod.load_cache(cp)  # entries landed
+    warm = analyze_paths([str(tmp_path / "bad.py")],
+                         root=str(tmp_path), cache_path=cp)
+    assert [(f.rule, f.path, f.line, f.message) for f in cold] == \
+        [(f.rule, f.path, f.line, f.message) for f in warm]
+
+
+def test_cache_hit_skips_module_rules(tmp_path, monkeypatch):
+    from horovod_tpu.analysis import registry
+
+    (tmp_path / "bad.py").write_text(
+        textwrap.dedent(FIXTURES["HVD001"][0]))
+    cp = str(tmp_path / "cache.json")
+    analyze_paths([str(tmp_path / "bad.py")], root=str(tmp_path),
+                  cache_path=cp)
+    calls = []
+    orig = registry.run_module_rules
+    monkeypatch.setattr(
+        registry, "run_module_rules",
+        lambda model: calls.append(model.relpath) or orig(model))
+    warm = analyze_paths([str(tmp_path / "bad.py")],
+                         root=str(tmp_path), cache_path=cp)
+    assert not calls, f"cache hit still ran module rules on {calls}"
+    assert _new(warm, "HVD001")
+
+
+def test_cache_invalidated_by_edit(tmp_path):
+    p = tmp_path / "f.py"
+    p.write_text(textwrap.dedent(FIXTURES["HVD001"][0]))
+    cp = str(tmp_path / "cache.json")
+    first = analyze_paths([str(p)], root=str(tmp_path), cache_path=cp)
+    assert _new(first, "HVD001")
+    p.write_text(textwrap.dedent(FIXTURES["HVD001"][1]))
+    second = analyze_paths([str(p)], root=str(tmp_path), cache_path=cp)
+    assert not _new(second, "HVD001")
+
+
+def test_cache_subset_run_merges_instead_of_clobbering(tmp_path):
+    # a --changed-style run over ONE file must not evict the other
+    # files' entries from the cache
+    from horovod_tpu.analysis import cache as cache_mod
+
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("x = 1\n")
+    b.write_text("y = 2\n")
+    cp = str(tmp_path / "cache.json")
+    analyze_paths([str(a), str(b)], root=str(tmp_path), cache_path=cp)
+    assert set(cache_mod.load_cache(cp)) == {"a.py", "b.py"}
+    a.write_text("x = 3\n")  # dirty, so the subset run rewrites
+    analyze_paths([str(a)], root=str(tmp_path), cache_path=cp)
+    assert set(cache_mod.load_cache(cp)) == {"a.py", "b.py"}
+
+
+def test_cache_corruption_is_recomputed(tmp_path):
+    p = tmp_path / "f.py"
+    p.write_text(textwrap.dedent(FIXTURES["HVD001"][0]))
+    cp = tmp_path / "cache.json"
+    analyze_paths([str(p)], root=str(tmp_path), cache_path=str(cp))
+    cp.write_text("{ not json")
+    findings = analyze_paths([str(p)], root=str(tmp_path),
+                             cache_path=str(cp))
+    assert _new(findings, "HVD001")
+
+
+def test_cache_rejected_on_rule_set_change(tmp_path):
+    from horovod_tpu.analysis import cache as cache_mod
+
+    p = tmp_path / "f.py"
+    p.write_text("x = 1\n")
+    cp = tmp_path / "cache.json"
+    analyze_paths([str(p)], root=str(tmp_path), cache_path=str(cp))
+    doc = json.loads(cp.read_text())
+    doc["rules"] = "HVD999"  # a different analyzer wrote this
+    cp.write_text(json.dumps(doc))
+    assert cache_mod.load_cache(str(cp)) == {}
+
+
+@pytest.mark.serial
+def test_prune_baseline_removes_stale_entries(tmp_path):
+    # a baseline with one live and one stale entry; --prune-baseline
+    # must drop exactly the stale one and keep the live entry's reason.
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.hvdtpu-lint]
+        paths = ["bad.py"]
+        baseline = "bl.json"
+    """))
+    (tmp_path / "bad.py").write_text(
+        textwrap.dedent(FIXTURES["HVD001"][0]))
+    r = _run_cli(["--no-baseline", "--format", "json"], cwd=tmp_path)
+    doc = json.loads(r.stdout)
+    entries = [
+        {"rule": f["rule"], "path": f["path"], "context": f["context"],
+         "reason": "live entry, still fires"}
+        for f in doc["findings"]
+    ]
+    entries.append({
+        "rule": "HVD007", "path": "gone.py", "context": "nope",
+        "reason": "stale: the finding this acknowledged was fixed",
+    })
+    (tmp_path / "bl.json").write_text(json.dumps(
+        {"schema": BASELINE_SCHEMA, "entries": entries}))
+    r = _run_cli(["--prune-baseline"], cwd=tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pruned stale baseline entry" in r.stderr
+    kept = json.loads((tmp_path / "bl.json").read_text())["entries"]
+    assert all(e["path"] != "gone.py" for e in kept)
+    assert any(e["reason"] == "live entry, still fires" for e in kept)
+
+
+@pytest.mark.serial
+def test_strict_baseline_exits_1_on_stale(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.hvdtpu-lint]
+        paths = ["ok.py"]
+        baseline = "bl.json"
+    """))
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    (tmp_path / "bl.json").write_text(json.dumps({
+        "schema": BASELINE_SCHEMA,
+        "entries": [{"rule": "HVD001", "path": "gone.py",
+                     "context": "f", "reason": "stale on purpose"}],
+    }))
+    r = _run_cli(["--strict-baseline"], cwd=tmp_path)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "stale" in r.stderr
+    # without the flag the same run is exit 0 (note only)
+    r = _run_cli([], cwd=tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no longer matches" in r.stderr
+
+
+@pytest.mark.serial
+def test_prune_and_strict_rejected_on_partial_view(tmp_path):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    for extra in (["--rules", "HVD001"], ["ok.py"], ["--changed"]):
+        for flag in ("--prune-baseline", "--strict-baseline"):
+            r = _run_cli([flag, *extra], cwd=tmp_path)
+            assert r.returncode == 2, (flag, extra, r.stderr)
+            assert "full-surface" in r.stderr
+
+
+@pytest.mark.serial
+def test_changed_survives_deleted_and_renamed_files(tmp_path):
+    # a deleted tracked file and a rename must not crash --changed (the
+    # old names no longer exist on disk).
+    def git(*a):
+        subprocess.run(
+            ["git", *a], cwd=tmp_path, check=True, capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.hvdtpu-lint]
+        paths = ["src"]
+        baseline = ""
+    """))
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "doomed.py").write_text("x = 1\n")
+    (src / "old_name.py").write_text("y = 2\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (src / "doomed.py").unlink()
+    (src / "old_name.py").rename(src / "new_name.py")
+    (src / "fresh.py").write_text(
+        textwrap.dedent(FIXTURES["HVD001"][0]))
+    r = _run_cli(["--changed"], cwd=tmp_path)
+    # no traceback/exit-2 from the missing paths; the surviving files
+    # are linted and the bad one still fails the run
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "HVD001" in r.stdout
+    assert "Traceback" not in r.stderr
 
 
 def test_write_baseline_preserves_curated_reasons(tmp_path):

@@ -1,0 +1,155 @@
+"""HLO collective-schedule checker (ISSUE 12): the compiled-artifact
+gate's parser and differ, on synthetic scheduled-module text.
+
+The jax-compiling half (engine fused-allreduce, overlap bucket, serve
+decode attention, per-rank subprocess compiles) lives in
+``scripts/hlo_gate.py`` and runs in the CI matrix — these tests pin the
+stdlib checker itself: extraction (opcodes, shapes, bytes, replica
+groups, nested computations), the diff verdicts, and the CLI contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+from horovod_tpu.analysis import hlo
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODULE_A = """
+HloModule train_step, is_scheduled=true
+
+%decode_body (p: f32[8]) -> f32[8] {
+  %x = f32[8]{0} parameter(0)
+  %pm = f32[8]{0} all-reduce(f32[8]{0} %x), channel_id=1, replica_groups={{0,1},{2,3}}, to_apply=%max
+  ROOT %ar = f32[8]{0} all-reduce(f32[8]{0} %pm), channel_id=2, replica_groups={{0,1},{2,3}}, to_apply=%add
+}
+
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %f = f32[64]{0} fusion(f32[64]{0} %p0), kind=kLoop, calls=%fused
+  %rs = f32[16]{0} reduce-scatter(f32[64]{0} %f), channel_id=3, replica_groups={{0,1,2,3}}, to_apply=%add
+  %ags = (f32[16]{0}, f32[64]{0}) all-gather-start(f32[16]{0} %rs), channel_id=4, replica_groups=[1,4]<=[4], dimensions={0}
+  ROOT %agd = f32[64]{0} all-gather-done((f32[16]{0}, f32[64]{0}) %ags)
+}
+"""
+
+
+def test_extract_schedule_ops_and_order():
+    s = hlo.extract_schedule(MODULE_A, "rank0")
+    assert [i.opcode for i in s.instrs] == [
+        "all-reduce", "all-reduce", "reduce-scatter", "all-gather-start",
+    ]
+    # nested computations are tracked by name
+    assert s.instrs[0].computation == "decode_body"
+    assert s.instrs[2].computation == "main"
+
+
+def test_extract_schedule_bytes_and_groups():
+    s = hlo.extract_schedule(MODULE_A)
+    ar = s.instrs[0]
+    assert ar.elements == 8 and ar.nbytes == 32  # f32[8]
+    assert ar.replica_groups == "{{0,1},{2,3}}"
+    assert ar.channel_id == 1
+    # the -start tuple shape sums every array member
+    ags = s.instrs[3]
+    assert ags.elements == 16 + 64
+    assert ags.replica_groups == "[1,4]<=[4]"  # iota form preserved
+    assert s.total_bytes == 32 + 32 + 64 + (16 + 64) * 4
+
+
+def test_layout_is_not_a_schedule_property():
+    # {1,0} vs {0,1} layouts are backend choices; same payload
+    b = MODULE_A.replace("f32[64]{0}", "f32[64]{0:T(256)}")
+    assert hlo.diff_schedules([
+        hlo.extract_schedule(MODULE_A, "a"),
+        hlo.extract_schedule(b, "b"),
+    ]) == []
+
+
+def test_diff_identical_and_group_divergence():
+    a = hlo.extract_schedule(MODULE_A, "rank0")
+    same = hlo.extract_schedule(MODULE_A, "rank1")
+    assert hlo.diff_schedules([a, same]) == []
+    b = hlo.extract_schedule(
+        MODULE_A.replace("replica_groups={{0,1},{2,3}}",
+                         "replica_groups={{0,2},{1,3}}"),
+        "rank1",
+    )
+    problems = hlo.diff_schedules([a, b])
+    assert problems and "collective #0 diverges" in problems[0]
+    assert "rank1" in problems[0] and "rank0" in problems[0]
+
+
+def test_diff_count_divergence_names_the_extra():
+    # one rank compiles an extra collective (the HVD010 bug as an
+    # artifact): the differ must call out the count mismatch
+    lines = [l for l in MODULE_A.splitlines()
+             if "reduce-scatter" not in l]
+    b = hlo.extract_schedule("\n".join(lines), "rank1")
+    a = hlo.extract_schedule(MODULE_A, "rank0")
+    problems = hlo.diff_schedules([a, b])
+    assert any("HOW MANY" in p for p in problems)
+
+
+def test_single_schedule_trivially_clean():
+    assert hlo.diff_schedules([hlo.extract_schedule(MODULE_A)]) == []
+
+
+def test_schedule_of_accepts_text():
+    s = hlo.schedule_of(MODULE_A, label="x")
+    assert s.label == "x" and len(s.instrs) == 4
+
+
+def test_as_dict_schema():
+    d = hlo.extract_schedule(MODULE_A, "r").as_dict()
+    assert d["schema"] == hlo.HLO_SCHEMA
+    assert len(d["collectives"]) == 4
+    assert {"opcode", "shape", "elements", "bytes", "replica_groups",
+            "channel_id", "computation"} <= set(d["collectives"][0])
+
+
+def _run_hlo_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.hlo", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+        env={**os.environ,
+             "PYTHONPATH": _REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+    )
+
+
+def test_cli_identical_exit_0_and_divergent_exit_1(tmp_path):
+    (tmp_path / "a.txt").write_text(MODULE_A)
+    (tmp_path / "b.txt").write_text(MODULE_A)
+    r = _run_hlo_cli(["rank0=a.txt", "rank1=b.txt"], cwd=tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "identical" in r.stdout
+    (tmp_path / "b.txt").write_text(MODULE_A.replace(
+        "replica_groups={{0,1},{2,3}}", "replica_groups={{0,3},{1,2}}"))
+    r = _run_hlo_cli(["rank0=a.txt", "rank1=b.txt"], cwd=tmp_path)
+    assert r.returncode == 1
+    assert "DIVERGENCE" in r.stdout
+
+
+def test_cli_expect_collectives_guards_empty_dumps(tmp_path):
+    (tmp_path / "a.txt").write_text("HloModule empty\n")
+    (tmp_path / "b.txt").write_text("HloModule empty\n")
+    r = _run_hlo_cli(
+        ["a.txt", "b.txt", "--expect-collectives", "1"], cwd=tmp_path)
+    assert r.returncode == 1
+    assert "expected >= 1" in r.stdout
+
+
+def test_cli_json_format_and_missing_file(tmp_path):
+    (tmp_path / "a.txt").write_text(MODULE_A)
+    r = _run_hlo_cli(["a.txt", "--format", "json"], cwd=tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["schema"] == hlo.HLO_SCHEMA
+    assert doc["divergences"] == []
+    r = _run_hlo_cli(["nope.txt"], cwd=tmp_path)
+    assert r.returncode == 2
