@@ -907,6 +907,37 @@ print(f"serve bench record OK: {parsed['value']} tok/s, "
 EOF
 rm -rf "$SV_TMP"
 
+# Autoscale + hot-swap gate (ISSUE 13): the train→serve loop closed
+# without a restart.  hvdtpu-lint clean over the new serve files (the
+# poll-and-flip decision must derive from shared data only —
+# HVD001/HVD010-013), the pure decision-table suite, then the two
+# chaos acceptances: (1) load-driven grow through a re-minted epoch
+# with in-flight requests bitwise-equal to an uninterrupted run,
+# followed by a drain-driven release (cooldown respected in the
+# decision trace, zero drops, no flapping); (2) a rank killed between
+# shard prefetch and version flip (swap_commit/action=swap_abort) —
+# the fleet converges on exactly ONE weight version (the durable flip
+# record), 8/8 requests complete with oracle-exact tokens.
+echo "== autoscale_swap gate: lint + decision-table suite =="
+python -m horovod_tpu.analysis \
+    horovod_tpu/serve/autoscale.py horovod_tpu/serve/hotswap.py \
+    horovod_tpu/serve/service.py horovod_tpu/serve/frontend.py \
+    --baseline horovod_tpu/analysis/baseline.json
+JAX_PLATFORMS=cpu \
+    timeout 300 python -m pytest tests/test_autoscale_swap.py \
+    -x -q -m "not multiprocess"
+echo "== autoscale_swap gate: grow-under-load + drain-release =="
+JAX_PLATFORMS=cpu \
+    timeout 400 python -m pytest \
+    "tests/test_autoscale_swap.py::test_autoscale_grow_under_load_then_drain_release" \
+    -x -q
+echo "== autoscale_swap gate: mid-swap kill -> one version, 8/8 =="
+JAX_PLATFORMS=cpu \
+    timeout 400 python -m pytest \
+    "tests/test_autoscale_swap.py::test_chaos_kill_mid_swap_converges_on_one_version" \
+    "tests/test_autoscale_swap.py::test_log_compaction_bounds_store_and_replay" \
+    -x -q
+
 # Trace gate (ISSUE 11): request-level tracing + the live MFU
 # profiler.  The unit suite + hvdtpu-lint over the new obs files, a
 # 2-proc training smoke through the real launcher CLI with --trace

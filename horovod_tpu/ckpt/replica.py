@@ -46,11 +46,25 @@ LOG = get_logger("ckpt")
 
 SCOPE = "ckptrep"
 
-__all__ = ["SCOPE", "ReplicaTier", "tier_from_env"]
+__all__ = ["SCOPE", "ReplicaTier", "tier_from_env", "job_fingerprint"]
 
 
 def _sha256(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
+
+
+def job_fingerprint(kv) -> str:
+    """Job identity derived from the per-job HMAC secret — the guard
+    every KV-resident artifact that must not outlive its job stamps
+    itself with.  A *different* secret already fails the transport MAC;
+    this closes the same-secret-endpoint-reuse case.  Shared by the
+    replica tier below and the weight hot-swap announce channel
+    (serve/hotswap.py), so both planes reject a recycled endpoint the
+    same way."""
+    secret = getattr(kv, "_secret", "") or ""
+    return hashlib.sha256(
+        b"hvdtpu-ckpt-job:" + secret.encode()
+    ).hexdigest()[:16]
 
 
 class ReplicaTier:
@@ -72,15 +86,10 @@ class ReplicaTier:
                 envmod.DEFAULT_REPLICA_CHUNK_KB,
             ) * 1024
         self.chunk_bytes = max(int(chunk_bytes), 1)
-        # Job fingerprint derived from the per-job HMAC secret: a
-        # long-lived/reused KV endpoint must never serve one job's
-        # replica to the next job's rank 0-commit respawn as its own
-        # predecessor's state.  (A *different* secret already fails the
-        # transport MAC; this closes the same-secret-reuse case.)
-        secret = getattr(kv, "_secret", "") or ""
-        self.job_id = hashlib.sha256(
-            b"hvdtpu-ckpt-job:" + secret.encode()
-        ).hexdigest()[:16]
+        # Job fingerprint: a long-lived/reused KV endpoint must never
+        # serve one job's replica to the next job's 0-commit respawn as
+        # its own predecessor's state (see job_fingerprint above).
+        self.job_id = job_fingerprint(kv)
 
     # ------------------------------------------------------------ topology
 

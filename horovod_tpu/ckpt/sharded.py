@@ -279,9 +279,34 @@ def list_steps(directory: str) -> List[int]:
     return sorted(steps)
 
 
-def latest_step(directory: str) -> Optional[int]:
-    steps = list_steps(directory)
-    return steps[-1] if steps else None
+def latest_step(directory: str,
+                newer_than: Optional[int] = None) -> Optional[int]:
+    """Newest committed step, or None.  ``newer_than`` makes this a
+    cheap incremental poll (the weight hot-swap watcher calls it every
+    few decode steps, forever): step directories are scanned by NAME
+    descending and the manifest — the expensive validation — is only
+    loaded for candidates above the floor, so a long-lived serving
+    fleet pays O(1) manifest reads per poll instead of O(published
+    versions)."""
+    if newer_than is None:
+        steps = list_steps(directory)
+        return steps[-1] if steps else None
+    if not os.path.isdir(directory):
+        return None
+    candidates = []
+    for name in os.listdir(directory):
+        if not name.startswith("shards_"):
+            continue
+        try:
+            step = int(name[len("shards_"):])
+        except ValueError:
+            continue
+        if step > newer_than:
+            candidates.append(step)
+    for step in sorted(candidates, reverse=True):
+        if load_manifest(directory, step) is not None:
+            return step
+    return None
 
 
 def read_shard_payload(directory: str, step: int, shard: dict) -> Dict[int, np.ndarray]:

@@ -121,6 +121,9 @@ class LiveAggregator:
         self._lock = threading.RLock()
         self._views: Dict[Tuple[int, int], _RankView] = {}
         self.rounds = 0
+        # Last serving-world size the digest printed: the autoscale
+        # token shows transitions ("world 4→6") across rounds.
+        self._serve_world_prev: Optional[int] = None
 
     # ------------------------------------------------------------ ingest
 
@@ -227,6 +230,9 @@ class LiveAggregator:
         serve = self._serve_part(views)
         if serve:
             parts.append(serve)
+        autoscale = self._autoscale_part(views)
+        if autoscale:
+            parts.append(autoscale)
         perf = self._perf_part(views)
         if perf:
             parts.append(perf)
@@ -370,6 +376,44 @@ class LiveAggregator:
                  f"slots={int(slots or 0)} {tps:.0f} tok/s")
         if ttft is not None:
             token += f" ttft p50 {ttft:.0f}ms"
+        return token
+
+    def _autoscale_part(self, views) -> Optional[str]:
+        """One digest token for the autoscale/hot-swap plane (``world
+        4→6 v=12``): current serving-world size (arrowed across rounds
+        when it changed — a resize mid-flight reads as a transition)
+        and the weight version every rank reports.  Absent on jobs that
+        never set ``serve.world_size``, so training jobs and pre-swap
+        fleets stay quiet.  Formatting is shared with the
+        ``--stats-summary`` section (serve/autoscale.py world_token —
+        the PR-3 single-source rule)."""
+        world = version = None
+        world_seen = version_seen = -1.0
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                # Both gauges are fleet-global values every CURRENT
+                # member republishes each round, so the freshest view
+                # wins — a released rank's final (stale) snapshot must
+                # not keep reporting the pre-shrink world forever.
+                if name == "serve.world_size" \
+                        and view.seen_mono > world_seen:
+                    world, world_seen = int(float(m["value"])), \
+                        view.seen_mono
+                elif name == "serve.weight_version" \
+                        and view.seen_mono > version_seen:
+                    version, version_seen = int(float(m["value"])), \
+                        view.seen_mono
+        if world is None:
+            return None
+        # Imported here, not at module top: only serving jobs reach
+        # this branch, and their launcher already imported the serve
+        # package (ingest pump) — a training job's launcher never pays
+        # for it.
+        from ..serve.autoscale import world_token  # noqa: PLC0415
+
+        token = world_token(self._serve_world_prev, world, version)
+        self._serve_world_prev = world
         return token
 
     @staticmethod
@@ -549,9 +593,26 @@ class LivePlane:
         self.agg = LiveAggregator()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Launcher-local series appended to the exposition (e.g. the
+        # autoscale controller's gauges — worker snapshots never carry
+        # them).  Each callable returns complete exposition lines.
+        self._extra_renders: List = []
+
+    def add_render(self, fn) -> None:
+        """Append a launcher-side exposition source to ``/metrics``."""
+        self._extra_renders.append(fn)
+
+    def _render(self) -> str:
+        body = self.agg.prometheus()
+        for fn in self._extra_renders:
+            try:
+                body += fn()
+            except Exception as exc:  # pragma: no cover - defensive
+                LOG.warning("extra /metrics render failed: %s", exc)
+        return body
 
     def start(self) -> None:
-        self.server.set_metrics_render(self.agg.prometheus)
+        self.server.set_metrics_render(self._render)
         self._thread = threading.Thread(
             target=self._loop, name="hvdtpu_live_agg", daemon=True
         )

@@ -21,6 +21,7 @@ __all__ = [
     "format_summary_table",
     "straggler_section",
     "fabric_section",
+    "autoscale_section",
     "perf_section",
     "summarize",
 ]
@@ -267,6 +268,109 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
                 )
         rows.append(row)
     return "\n".join(rows) if rows else None
+
+
+def autoscale_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job autoscale / weight hot-swap report: the world/version
+    the fleet converged on (every rank must agree — a disagreement here
+    is a single-version-guarantee violation worth reading twice), swap
+    outcomes per rank, and the launcher's resize decisions/backoffs.
+    None when the job neither autoscaled nor armed hot-swap."""
+    worlds: Dict[str, int] = {}
+    versions: Dict[str, int] = {}
+    released_labels = set()
+    swap_rows = []
+    launcher_bits = []
+    for label in sorted(dumps, key=_rank_sort_key):
+        vals: Dict[str, float] = {}
+        swaps: Dict[str, int] = {}
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if name in ("serve.world_size", "serve.weight_version",
+                        "serve.released", "serve.log_watermark",
+                        "serve.swap_prefetch_failures",
+                        "autoscale.world", "autoscale.backoffs"):
+                vals[name] = float(m["value"])
+            elif name == "serve.swaps":
+                outcome = (m.get("tags") or {}).get("outcome", "?")
+                swaps[outcome] = swaps.get(outcome, 0) + int(m["value"])
+            elif name == "autoscale.decisions":
+                d = (m.get("tags") or {}).get("direction", "?")
+                launcher_bits.append(f"scale-{d} {int(m['value'])}")
+        if vals.get("serve.released"):
+            released_labels.add(label)
+        if "serve.world_size" in vals:
+            worlds[label] = int(vals["serve.world_size"])
+        if "serve.weight_version" in vals:
+            versions[label] = int(vals["serve.weight_version"])
+        if "autoscale.backoffs" in vals and vals["autoscale.backoffs"]:
+            launcher_bits.append(
+                f"grow-backoffs {int(vals['autoscale.backoffs'])}")
+        if swaps or vals.get("serve.swap_prefetch_failures") \
+                or vals.get("serve.released"):
+            row = f"rank {label}: " + ", ".join(
+                [f"swaps {o}={n}" for o, n in sorted(swaps.items())]
+                + ([f"prefetch-failures "
+                    f"{int(vals['serve.swap_prefetch_failures'])}"]
+                   if vals.get("serve.swap_prefetch_failures") else [])
+                + (["released"] if vals.get("serve.released") else [])
+            )
+            swap_rows.append(row)
+    if not worlds and not versions and not launcher_bits \
+            and not swap_rows:
+        return None
+    from ..serve.autoscale import world_token  # noqa: PLC0415
+
+    def _newest(per_label: Dict[str, int]) -> Dict[str, int]:
+        """One value per rank: the newest incarnation's (labels are
+        ``rank`` or ``rank@eN``).  A dead incarnation's stale version
+        is evidence elsewhere, not a convergence violation."""
+        best: Dict[str, tuple] = {}
+        for label, v in per_label.items():
+            base, _, etag = label.partition("@e")
+            e = int(etag) if etag.isdigit() else 0
+            if base not in best or e > best[base][0]:
+                best[base] = (e, label, v)
+        return {lbl: v for _, lbl, v in best.values()}
+
+    lines = []
+    if worlds or versions:
+        # A released rank's end-of-life gauges describe the world it
+        # was dropped FROM; the surviving ranks' dumps carry the final
+        # truth.  Filter by BASE rank (every incarnation of a released
+        # rank, not just the one whose dump carries serve.released),
+        # and fall back to everything only when the whole fleet was
+        # released (shrink-to-zero never happens, but dumps can be
+        # partial).
+        released_bases = {lbl.partition("@e")[0]
+                          for lbl in released_labels}
+
+        def _survivors(per_label: Dict[str, int]) -> Dict[str, int]:
+            kept = {lbl: v for lbl, v in per_label.items()
+                    if lbl.partition("@e")[0] not in released_bases}
+            return kept or per_label
+
+        newest_versions = _newest(_survivors(versions))
+        # Worlds get the same newest-incarnation dedup: after a grow
+        # then shrink, a survivor's stale earlier-incarnation dump
+        # must not keep reporting the pre-shrink peak as "final".
+        newest_worlds = _newest(_survivors(worlds))
+        world = max(newest_worlds.values()) if newest_worlds else 0
+        version = (max(newest_versions.values())
+                   if newest_versions else None)
+        lines.append("final " + world_token(None, world, version))
+        stray_v = {label: v for label, v in newest_versions.items()
+                   if version is not None and v != version}
+        if stray_v:
+            lines.append(
+                "WARNING: weight-version disagreement across final "
+                "incarnations (violates the single-version "
+                f"guarantee): {stray_v}"
+            )
+    if launcher_bits:
+        lines.append("launcher: " + ", ".join(sorted(set(launcher_bits))))
+    lines.extend(swap_rows)
+    return "\n".join(lines)
 
 
 def perf_section(dumps: Dict[str, dict]) -> Optional[str]:

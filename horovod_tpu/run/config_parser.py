@@ -91,6 +91,22 @@ _ARG_ENV_MAP = {
     "serve_slots": (envmod.SERVE_SLOTS, "serve.slots"),
     "serve_max_len": (envmod.SERVE_MAX_LEN, "serve.max-len"),
     "serve_seed": (envmod.SERVE_SEED, "serve.seed"),
+    "serve_weights_dir": (envmod.SERVE_WEIGHTS_DIR, "serve.weights-dir"),
+    "serve_swap_poll_steps": (
+        envmod.SERVE_SWAP_POLL_STEPS,
+        "serve.swap-poll-steps",
+    ),
+    "serve_autoscale": (envmod.SERVE_AUTOSCALE, "serve.autoscale"),
+    "max_workers": (envmod.MAX_WORKERS, "serve.max-workers"),
+    "scale_up_queue": (envmod.SCALE_UP_QUEUE, "serve.scale-up-queue"),
+    "scale_down_idle_secs": (
+        envmod.SCALE_DOWN_IDLE_SECS,
+        "serve.scale-down-idle-secs",
+    ),
+    "scale_cooldown_secs": (
+        envmod.SCALE_COOLDOWN_SECS,
+        "serve.scale-cooldown-secs",
+    ),
 }
 
 

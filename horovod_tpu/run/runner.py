@@ -109,6 +109,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "respawn budget is spent (default: np — never shrink).",
     )
     elastic.add_argument(
+        "--max-workers", type=int, action=_StoreOverrideAction,
+        dest="max_workers", default=None,
+        help="Largest world the job may grow to (default: np).  Ranks "
+             "np..max_workers-1 are standby slots the autoscale "
+             "controller can admit under load; the host list must "
+             "carry slots for all of them.",
+    )
+    elastic.add_argument(
         "--max-elastic-retries", type=int, action=_StoreOverrideAction,
         dest="max_elastic_retries", default=None,
         help="Total failed-rank respawns across the job (default 3).",
@@ -244,6 +252,57 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         dest="serve_seed", default=None,
         help="Params init seed — identical on every rank by "
              "construction (HVDTPU_SERVE_SEED, default 0).",
+    )
+    serve.add_argument(
+        "--serve-weights-dir", action=_StoreOverrideAction,
+        dest="serve_weights_dir", default=None,
+        help="Weight hot-swap source (HVDTPU_SERVE_WEIGHTS_DIR): a "
+             "sharded-checkpoint directory a concurrently-training job "
+             "publishes committed versions into "
+             "(horovod_tpu.serve.hotswap.publish_weights).  The fleet "
+             "polls it between decode steps and flips atomically on a "
+             "version-stamped step — exactly one weight version is "
+             "served at every step, and a failed or dying swap rolls "
+             "the whole fleet back to the incumbent.",
+    )
+    serve.add_argument(
+        "--serve-swap-poll-steps", type=int, action=_StoreOverrideAction,
+        dest="serve_swap_poll_steps", default=None,
+        help="Serving steps between hot-swap manifest polls "
+             "(HVDTPU_SERVE_SWAP_POLL_STEPS, default 16).",
+    )
+    serve.add_argument(
+        "--serve-autoscale", action=_StoreTrueOverrideAction,
+        dest="serve_autoscale", default=None,
+        help="Load-driven autoscaling: the launcher watches the "
+             "serve.queue_depth/serve.ttft_ms gauges the live plane "
+             "aggregates and grows/shrinks the fleet between "
+             "--min-workers and --max-workers through deliberately "
+             "re-minted rendezvous epochs — in-flight requests replay, "
+             "zero are dropped (a scale event is indistinguishable "
+             "from a survived failure).  Implies live stats at 0.5s "
+             "when --live-stats-secs is unset.",
+    )
+    serve.add_argument(
+        "--scale-up-queue", type=int, action=_StoreOverrideAction,
+        dest="scale_up_queue", default=None,
+        help="Queue-depth high-water mark: grow one worker when the "
+             "queue stays at/above this for the hysteresis window "
+             "(default 4).",
+    )
+    serve.add_argument(
+        "--scale-down-idle-secs", type=float, action=_StoreOverrideAction,
+        dest="scale_down_idle_secs", default=None,
+        help="Release one worker after the fleet has been fully "
+             "drained (empty queue, no active slot) this long "
+             "(default 10).",
+    )
+    serve.add_argument(
+        "--scale-cooldown-secs", type=float, action=_StoreOverrideAction,
+        dest="scale_cooldown_secs", default=None,
+        help="Minimum seconds between resizes in EITHER direction "
+             "(flap guard, default 15).  Failed grows additionally "
+             "back off exponentially.",
     )
 
     ckpt = parser.add_argument_group("checkpointing")
@@ -1059,6 +1118,8 @@ def launch_elastic_job(
     env: Optional[Dict[str, str]] = None,
     ssh_port: Optional[int] = None,
     min_workers: Optional[int] = None,
+    max_workers: Optional[int] = None,
+    autoscale: Optional[dict] = None,
     max_retries: int = 3,
     heartbeat_timeout: float = 60.0,
     progress_timeout: float = 300.0,
@@ -1085,7 +1146,19 @@ def launch_elastic_job(
 
     ``min_workers``: once the respawn budget is spent, the job may
     continue with a SHRUNKEN world as long as at least this many ranks
-    survive (default np — any unrecoverable failure aborts).
+    survive (default np — any unrecoverable failure aborts); under
+    autoscale it is also the envelope floor.
+    ``max_workers``: the envelope ceiling (default np) — ranks
+    ``np..max_workers-1`` are standby slots a deliberate grow admits;
+    the host list must carry slots for all of them.
+    ``autoscale``: :class:`~..serve.autoscale.AutoscaleConfig` override
+    dict; when set, the launcher reads the live plane's merged
+    ``serve.queue_depth``/``serve.ttft_ms`` gauges and executes the
+    policy's grow/shrink decisions through the SAME epoch-mint +
+    spawn/drop path failures use (a scale event is a survived failure
+    as far as the workers can tell).  Live stats are forced on (0.5s)
+    when not otherwise armed — the gauges are the controller's only
+    input.
     ``max_retries`` bounds total respawns across the job.
     ``progress_timeout`` / ``progress_grace``: the workload-aware
     progress-beat policy (obs/progress.py ProgressPolicy).  Worker beats
@@ -1117,9 +1190,18 @@ def launch_elastic_job(
         raise ValueError(
             f"min_workers must be in [1, np]; got {min_workers} for np={np}"
         )
+    capacity = np if max_workers is None else int(max_workers)
+    if capacity < np:
+        raise ValueError(
+            f"max_workers must be >= np; got {capacity} for np={np}"
+        )
 
-    host_slots = _resolve_host_slots(hosts, hostfile, f"localhost:{np}")
-    slots = allocate(host_slots, np)
+    # Slots are allocated for the whole ENVELOPE: standby ranks
+    # np..capacity-1 need a host the moment a grow admits them, and a
+    # host list that cannot carry them must fail here, pre-spawn.
+    host_slots = _resolve_host_slots(hosts, hostfile,
+                                     f"localhost:{capacity}")
+    slots = allocate(host_slots, capacity)
     host_of: Dict[int, str] = {s.rank: s.hostname for s in slots}
     host_order: List[str] = []
     for hs in host_slots:
@@ -1152,6 +1234,11 @@ def launch_elastic_job(
 
     # Live telemetry rides the rendezvous store: snapshots travel the
     # same signed PUT path as heartbeats, and /metrics shares the port.
+    # The autoscale controller's ONLY input is this plane's merged
+    # gauges, so autoscale forces it on when nothing else armed it.
+    if autoscale is not None and live_stats_secs is None \
+            and not base_env.get(envmod.LIVE_STATS):
+        live_stats_secs = 0.5
     live_plane, _ = _maybe_start_live_plane(
         base_env, np, kv_server=kv_server, kv_addr=kv_addr,
         live_stats_secs=live_stats_secs, live_history=live_history,
@@ -1180,6 +1267,29 @@ def launch_elastic_job(
     result = ElasticJobResult()
     trace = result.trace
     blacklist = HostBlacklist(cooldown_base=blacklist_cooldown)
+
+    # Deliberate-resize controller (serving autoscale): the pure policy
+    # + metrics glue live in serve/autoscale.py; THIS loop executes its
+    # decisions because only it owns epoch minting and process spawn.
+    scaler = None
+    if autoscale is not None:
+        from ..serve.autoscale import (  # noqa: PLC0415
+            AutoscaleConfig, AutoscaleController,
+        )
+        from ..testing.faults import maybe_fail  # noqa: PLC0415
+
+        scaler = AutoscaleController(
+            AutoscaleConfig(
+                min_workers=min_workers, max_workers=capacity,
+                **{k: v for k, v in autoscale.items() if v is not None},
+            ),
+            registry=metrics,
+        )
+        if live_plane is not None:
+            # autoscale.* series ride the same /metrics exposition the
+            # worker gauges do (they live in the launcher's registry,
+            # which worker snapshots never carry).
+            live_plane.add_render(scaler.prometheus)
     # Slice-aware blacklisting (multislice jobs): a failure is recorded
     # against its rank's slice too, and a quorum of dead hosts within
     # one slice blacklists the whole slice — same contiguous-block
@@ -1260,8 +1370,13 @@ def launch_elastic_job(
     epoch = 0
     world = list(range(np))
     finished: Dict[int, int] = {}
+    # Ranks a deliberate scale-down released (they exit 0 and land in
+    # `finished`, but the job is NOT draining — the distinction keeps
+    # autoscale alive after its own shrinks).
+    released: set = set()
     hb_seen: Dict[int, tuple] = {}
     hb_next_scan = 0.0
+    scale_next = 0.0
     respawns_used = 0
     deadline = time.monotonic() + job_timeout if job_timeout else None
     black_box, owns_black_box = _ensure_black_box(base_env)
@@ -1279,6 +1394,13 @@ def launch_elastic_job(
                 if rc == 0:
                     finished[rank] = 0
                     continue
+                if rank in released:
+                    # A released rank that died on its way out (e.g.
+                    # terminated for a stale heartbeat after the drop)
+                    # owes the job nothing: it must neither be
+                    # respawned nor counted as a host failure.
+                    trace.append(("released_exit", rank, rc, epoch))
+                    continue
                 tb = posted_error(rank, epoch)
                 if tb is not None:
                     raise RuntimeError(
@@ -1295,20 +1417,27 @@ def launch_elastic_job(
                     "this host)", rank, host, rc, count,
                 )
                 alive = procs.alive_ranks()
-                if not alive and finished:
-                    # Every peer already exited 0: a replacement would
-                    # have no survivor to sync state from and would
-                    # retrain alone from initial values.  The committed
-                    # result is already replicated across the finished
-                    # ranks — finish with them instead of respawning.
-                    if len(finished) < min_workers:
+                # Released ranks exited 0 but did NOT finish the job's
+                # work — counting them as contributors here would let a
+                # crash of the last real worker "complete" the job on a
+                # released rank's summary, silently dropping in-flight
+                # requests.
+                contributed = set(finished) - released
+                if not alive and contributed:
+                    # Every real peer already exited 0: a replacement
+                    # would have no survivor to sync state from and
+                    # would retrain alone from initial values.  The
+                    # committed result is already replicated across the
+                    # finished ranks — finish with them instead of
+                    # respawning.
+                    if len(contributed) < min_workers:
                         raise RuntimeError(
                             f"elastic job lost rank {rank} after only "
-                            f"{len(finished)} workers finished "
+                            f"{len(contributed)} workers finished "
                             f"(< min_workers={min_workers})"
                         )
                     epoch += 1
-                    world = sorted(finished)
+                    world = sorted(contributed)
                     mint_epoch(epoch, world)
                     trace.append(("shrink", epoch, tuple(world)))
                     LOG.warning(
@@ -1331,12 +1460,13 @@ def launch_elastic_job(
                     spawn(rank, new_host, epoch)
                     metrics.counter("launcher.respawns").inc()
                     trace.append(("respawn", rank, epoch, new_host))
-                elif len(set(alive) | set(finished)) >= min_workers:
+                elif len(set(alive) | contributed) >= min_workers:
                     # Budget spent: continue with the shrunken world
                     # (the dead rank's slot is dropped for good).
                     # min_workers counts CONTRIBUTING ranks — alive ones
-                    # plus those that already delivered a result — so an
-                    # early finisher is not held against the job.
+                    # plus those that already delivered a result (NOT
+                    # released ones) — so an early finisher is not held
+                    # against the job.
                     epoch += 1
                     world = sorted(alive)
                     mint_epoch(epoch, world)
@@ -1349,7 +1479,7 @@ def launch_elastic_job(
                     raise RuntimeError(
                         f"elastic job lost rank {rank} with the respawn "
                         f"budget spent and only "
-                        f"{len(set(alive) | set(finished))} workers "
+                        f"{len(set(alive) | contributed)} workers "
                         f"contributing (< min_workers={min_workers})"
                     )
             hb_enabled = bool(heartbeat_timeout and heartbeat_timeout > 0)
@@ -1422,6 +1552,85 @@ def launch_elastic_job(
                         hb_seen.pop(rank, None)
                         progress_policy.forget(rank)
                         procs.terminate_rank(rank, grace=dump_grace_secs)
+            if (scaler is not None
+                    and live_plane is not None
+                    and not (set(finished) - released)
+                    and time.monotonic() >= scale_next):
+                # Deliberate resize tick.  Guards: never while a real
+                # drain is under way (a non-released rank finished),
+                # and only against a STABLE world (every member alive —
+                # a failure respawn in flight must win the epoch race,
+                # not interleave with a resize).
+                scale_next = time.monotonic() + 0.25
+                if set(world) <= set(procs.alive_ranks()):
+                    decision = scaler.tick(
+                        time.monotonic(), live_plane.agg.merged(),
+                        world,
+                    )
+                else:
+                    decision = None
+                if decision is not None and decision.direction == "up":
+                    want = decision.target - len(world)
+                    standby = [r for r in range(capacity)
+                               if r not in world][:want]
+                    admitted = []
+                    skipped_blacklisted = False
+                    for r in standby:
+                        # A deliberate grow honors the same host
+                        # blacklist the failure-respawn path does: a
+                        # cooling-down host must not be handed a
+                        # standby just to kill it and burn a respawn.
+                        if not blacklist.is_admissible(host_of[r]):
+                            trace.append(
+                                ("scale_skip_blacklisted", r, epoch))
+                            skipped_blacklisted = True
+                            continue
+                        # Chaos point: a standby host refusing
+                        # admission (action=scale_fail) is the
+                        # deterministic input the exponential-backoff
+                        # policy is tested against.
+                        if maybe_fail("scale_admit",
+                                      rank=r) == "scale_fail":
+                            trace.append(("scale_fail", r, epoch))
+                            scaler.grow_failed(time.monotonic(), r)
+                            continue
+                        admitted.append(r)
+                    if not admitted and skipped_blacklisted:
+                        # Every standby is cooling down: back off like
+                        # a refused admission instead of re-deciding
+                        # every tick until a cooldown expires.
+                        scaler.grow_failed(time.monotonic(), standby[0])
+                    if admitted:
+                        epoch += 1
+                        for r in admitted:
+                            # A previously released rank re-admitted:
+                            # its old clean exit is not this
+                            # incarnation's result.
+                            finished.pop(r, None)
+                            released.discard(r)
+                            hb_seen.pop(r, None)
+                            progress_policy.forget(r)
+                        world = sorted(set(world) | set(admitted))
+                        mint_epoch(epoch, world)
+                        for r in admitted:
+                            spawn(r, host_of[r], epoch)
+                        trace.append(("scale_up", epoch,
+                                      tuple(admitted)))
+                        scaler.executed(decision, epoch, len(world))
+                elif decision is not None \
+                        and decision.direction == "down":
+                    drop = len(world) - decision.target
+                    victims = sorted(world)[-drop:]
+                    released.update(victims)
+                    epoch += 1
+                    world = [r for r in world if r not in victims]
+                    mint_epoch(epoch, world)
+                    # The victims notice the epoch bump, find
+                    # themselves outside the new world, and exit 0
+                    # (RankDroppedError -> clean release); survivors
+                    # replay in-flight work in the fresh epoch.
+                    trace.append(("scale_down", epoch, tuple(victims)))
+                    scaler.executed(decision, epoch, len(world))
             if all(r in finished for r in world):
                 result.exit_codes = dict(finished)
                 result.epoch = epoch
@@ -1535,6 +1744,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         LOG.info("launching %d processes: %s", args.np, " ".join(command))
         if getattr(args, "elastic", False) or getattr(args, "serve", False):
+            autoscale = None
+            if getattr(args, "serve_autoscale", False):
+                autoscale = {
+                    "scale_up_queue": getattr(args, "scale_up_queue",
+                                              None),
+                    "scale_down_idle_secs": getattr(
+                        args, "scale_down_idle_secs", None),
+                }
+                cooldown = getattr(args, "scale_cooldown_secs", None)
+                if cooldown is not None:
+                    autoscale["up_cooldown_secs"] = cooldown
+                    autoscale["down_cooldown_secs"] = cooldown
             launch_elastic_job(
                 command,
                 args.np,
@@ -1543,6 +1764,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 env=env,
                 ssh_port=args.ssh_port,
                 min_workers=getattr(args, "min_workers", None),
+                max_workers=getattr(args, "max_workers", None),
+                autoscale=autoscale,
                 # `x or default` would coerce an EXPLICIT 0 (zero
                 # respawns / zero cooldown) back to the default.
                 max_retries=(
@@ -1637,6 +1860,10 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if serve is not None:
         print("\n== serving plane ==")
         print(serve)
+    autoscale = obs_summary.autoscale_section(dumps)
+    if autoscale is not None:
+        print("\n== autoscale / weight hot-swap ==")
+        print(autoscale)
     perf = obs_summary.perf_section(dumps)
     if perf is not None:
         print("\n== mfu / model flops ==")

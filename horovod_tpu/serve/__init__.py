@@ -17,6 +17,12 @@ out of the pieces the training stack already trusts:
   log; zero dropped requests) and the :class:`ServeJob` python driver.
 * :mod:`.longctx`   — sequence-sharded slot caches for long-context
   requests (Ulysses all-to-all prefill, flash-merge decode).
+* :mod:`.autoscale` — load-driven grow/shrink of the serving world
+  through deliberately re-minted rendezvous epochs (pure
+  hysteresis/cooldown/backoff policy + launcher controller).
+* :mod:`.hotswap`   — live weight hot-swap from a concurrently-training
+  publisher, single-version-guaranteed (poll manifest → prefetch +
+  vote → version-stamped atomic flip, rollback on any doubt).
 
 Quick start::
 
@@ -27,8 +33,12 @@ Quick start::
     job.stop()
 """
 
+from .autoscale import (  # noqa: F401
+    AutoscaleConfig, AutoscalePolicy,
+)
 from .engine import SlotEngine  # noqa: F401
 from .frontend import IngestPump, ServeClient, validate_request  # noqa: F401
+from .hotswap import SwapManager, publish_weights  # noqa: F401
 from .scheduler import (  # noqa: F401
     ActiveSlot, Admission, Eviction, Request, SlotScheduler,
 )

@@ -42,6 +42,14 @@ def parse_spec(argv=None) -> dict:
                    help="attention implementation for the served model "
                         "(default reference: runs on every backend; "
                         "flash is the TPU fast path)")
+    p.add_argument("--weights-dir", default=None,
+                   help="weight hot-swap source: sharded-checkpoint "
+                        "directory a training job publishes versions "
+                        "into (default: HVDTPU_SERVE_WEIGHTS_DIR, "
+                        "unset = hot-swap off)")
+    p.add_argument("--swap-poll-steps", type=int, default=None,
+                   help="serving steps between hot-swap manifest "
+                        "polls (default 16)")
     args = p.parse_args(argv)
 
     import os  # noqa: PLC0415
@@ -61,6 +69,13 @@ def parse_spec(argv=None) -> dict:
     max_len = pick(args.max_len, envmod.SERVE_MAX_LEN, int, 0)
     if max_len:
         spec["max_len"] = max_len
+    weights_dir = pick(args.weights_dir, envmod.SERVE_WEIGHTS_DIR,
+                       str, None)
+    if weights_dir:
+        spec["weights_dir"] = weights_dir
+        spec["swap_poll_steps"] = pick(
+            args.swap_poll_steps, envmod.SERVE_SWAP_POLL_STEPS, int, 16
+        )
     return spec
 
 

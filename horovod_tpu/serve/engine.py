@@ -183,6 +183,27 @@ class SlotEngine:
             self._step_flops = None
         return self._step_flops
 
+    # ---------------------------------------------------------- hot swap
+
+    def set_params(self, params) -> None:
+        """Swap the served weights in place (weight hot-swap,
+        serve/hotswap.py).  The jitted step/assign executables key on
+        shapes and dtypes, which a same-model checkpoint preserves — a
+        flip costs zero recompiles and the KV cache is untouched (the
+        flip happens between decode steps; in-flight requests continue
+        over their existing cache).  Structure/shape mismatches were
+        already rejected at prefetch time by the manifest validation,
+        but a direct caller gets the same loud error here."""
+        old = jax.tree_util.tree_structure(self.params)
+        new = jax.tree_util.tree_structure(params)
+        if old != new:
+            raise ValueError(
+                f"hot-swap params tree mismatch: engine serves {old}, "
+                f"got {new} — this checkpoint belongs to a different "
+                f"model"
+            )
+        self.params = params
+
     # ------------------------------------------------------------- reset
 
     def reset(self) -> None:

@@ -157,12 +157,30 @@ class IngestPump:
     against.
     """
 
-    def __init__(self, server, interval: float = 0.02):
+    def __init__(self, server, interval: float = 0.02,
+                 out_ttl_secs: Optional[float] = None):
+        from ..utils import env as envmod  # noqa: PLC0415
+
         self._server = server
         self._kv = KVStoreClient(f"127.0.0.1:{server.port}",
                                  server.secret)
         self.interval = max(float(interval), 0.005)
+        # Finished-output retention: a result doc whose log index fell
+        # below the leader's compaction watermark is kept this long for
+        # late client polls, then GC'd (see _gc_finished_outputs).
+        self.out_ttl_secs = (
+            float(out_ttl_secs) if out_ttl_secs is not None
+            else envmod.env_float(envmod.SERVE_OUT_TTL,
+                                  envmod.DEFAULT_SERVE_OUT_TTL)
+        )
         self._next = 0
+        self._done_seen: dict = {}  # out key -> monotonic first-seen-done
+        # The finished-output GC unpickles every live out doc, so it
+        # runs on its own ~1s cadence, not the 20ms ingest tick (TTL
+        # granularity is hundreds of seconds; millisecond precision
+        # would buy 50x the deserialization cost and nothing else).
+        self._gc_every = min(1.0, max(self.out_ttl_secs / 4, 0.01))
+        self._next_gc = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -173,9 +191,11 @@ class IngestPump:
     def round(self) -> int:
         """Move every pending submission into the log; returns how many.
         Also garbage-collects dead-epoch serving scopes (see
-        :meth:`_gc_stale_epochs`) — the pump is the one serving
+        :meth:`_gc_stale_epochs`) and compacted finished outputs (see
+        :meth:`_gc_finished_outputs`) — the pump is the one serving
         component with in-process listing access to the store."""
         self._gc_stale_epochs()
+        self._gc_finished_outputs()
         pending = self._server.scan(REQ_PREFIX)
         moved = 0
         for key in sorted(pending):
@@ -233,6 +253,65 @@ class IngestPump:
         if doomed:
             self._server.discard(doomed)
             LOG.debug("GC'd %d stale-epoch serving keys", len(doomed))
+
+    def _gc_finished_outputs(self) -> None:
+        """Drop result docs of requests the leader's compaction
+        watermark already retired (their log keys are gone — recovery
+        replay will never need them) once they have been done for
+        ``out_ttl_secs``.  This is the second half of request-log
+        compaction: without it ``serve/out/*`` still grows with total
+        requests ever served even though ``serve/log/*`` no longer
+        does.  The TTL exists for late pollers; a client that sleeps
+        past it sees a result timeout, which docs/inference.md states
+        as the honest trade."""
+        if time.monotonic() < self._next_gc:
+            return
+        self._next_gc = time.monotonic() + self._gc_every
+        raw = self._server.scan(SCOPE + "/log_watermark")
+        try:
+            watermark = int(
+                raw[SCOPE + "/log_watermark"].decode())
+        except (KeyError, ValueError):
+            return  # no compaction yet
+        # Orphan sweep: the leader publishes the watermark BEFORE
+        # deleting the retired log keys, so a crash between the two
+        # leaves below-watermark entries nobody will ever read (the
+        # recovery scan starts at the watermark).  The pump is the one
+        # component that can list them.
+        orphans = []
+        for key in self._server.scan(SCOPE + "/log/"):
+            try:
+                if int(key.rsplit("/", 1)[1]) < watermark:
+                    orphans.append(key)
+            except ValueError:
+                continue
+        if orphans:
+            self._server.discard(orphans)
+            LOG.debug("GC'd %d below-watermark log orphans",
+                      len(orphans))
+        now = time.monotonic()
+        doomed = []
+        live = self._server.scan(SCOPE + "/out/")
+        for key, blob in live.items():
+            try:
+                doc = pickle.loads(blob)
+            except Exception:
+                continue
+            n = doc.get("n")
+            if not doc.get("done") or n is None or int(n) >= watermark:
+                continue
+            first = self._done_seen.setdefault(key, now)
+            if now - first >= self.out_ttl_secs:
+                doomed.append(key)
+        if doomed:
+            self._server.discard(doomed)
+            for key in doomed:
+                self._done_seen.pop(key, None)
+            LOG.debug("GC'd %d compacted result docs", len(doomed))
+        # Tracking entries for keys something else already removed.
+        for key in list(self._done_seen):
+            if key not in live:
+                self._done_seen.pop(key, None)
 
     def start(self) -> None:
         self._thread = threading.Thread(

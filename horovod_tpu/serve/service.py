@@ -26,6 +26,19 @@ Observability rides the PR-2/3 planes: ``serve.*`` instruments land in
 the per-rank metrics registry, stream to the launcher's ``/metrics``
 endpoint when live stats are armed, show in the live digest, and
 aggregate into ``--stats-summary``.
+
+Two riders close the train→serve loop without a restart (ISSUE 13):
+the launcher's autoscale controller (serve/autoscale.py) drives the
+same epoch machinery deliberately from the streamed queue/ttft gauges
+— a resize is indistinguishable from a survived failure, and a rank
+dropped by a shrink exits as a clean *release* — and the weight
+hot-swap manager (serve/hotswap.py) flips the fleet to newly published
+checkpoints on a version-stamped step over the schedule-broadcast
+lane, with the durable ``serve/weight_version`` record making
+epoch recovery converge on exactly one version.  The leader also
+advances a finished watermark that compacts ``serve/log/*`` (and,
+via the ingest pump, ``serve/out/*``) so the store and the recovery
+replay stop growing with total requests ever served.
 """
 
 from __future__ import annotations
@@ -45,6 +58,7 @@ from ..obs import trace as obs_trace
 from ..testing.faults import maybe_fail
 from ..utils.logging import get_logger
 from .frontend import SCOPE, IngestPump, ServeClient, validate_request
+from .hotswap import VERSION_KEY, SwapManager
 from .scheduler import Request, SlotScheduler
 
 LOG = get_logger("serve")
@@ -114,6 +128,8 @@ DEFAULT_SPEC: Dict[str, Any] = {
     "max_len": None,         # slot cache length (default cfg.max_len)
     "idle_secs": 0.01,       # leader pacing when nothing is in flight
     "stream_every": 4,       # publish token streams every N tokens
+    "weights_dir": None,     # weight hot-swap source (None = off)
+    "swap_poll_steps": 16,   # leader manifest-poll cadence (steps)
 }
 
 
@@ -143,12 +159,23 @@ def _fetch(ctx, scope: str, key: str, what: str) -> bytes:
 
 
 def _build_recovery(kv) -> dict:
-    """Replay the durable request record: the full ingest log joined
-    with each request's streamed tokens.  Only the leader runs this —
-    peers adopt its published doc, so a log entry racing in mid-scan
-    can never split the world's view."""
+    """Replay the durable request record: the ingest log from the
+    finished watermark up, joined with each request's streamed tokens.
+    Only the leader runs this — peers adopt its published doc, so a log
+    entry racing in mid-scan can never split the world's view.
+
+    The watermark (``serve/log_watermark``) is the compaction floor the
+    leader advances as requests finish: every entry below it is done
+    and its log key deleted, so neither this replay nor the ingest
+    store grows with total requests ever served — only with what is
+    actually in flight (ROADMAP 1d).  ``weight_version`` is the durable
+    flip record the whole fleet converges on (hotswap.py's
+    single-version argument rests on every rank adopting THIS value at
+    epoch start)."""
+    raw = kv.get(SCOPE, "log_watermark")
+    watermark = int(raw.decode()) if raw is not None else 0
     docs = []
-    n = 0
+    n = watermark
     while True:
         raw = kv.get(SCOPE, f"log/{n}")
         if raw is None:
@@ -156,24 +183,33 @@ def _build_recovery(kv) -> dict:
         docs.append(pickle.loads(raw))
         n += 1
     inflight = []
-    for doc in docs:
+    done_ns: List[int] = []
+    for idx, doc in enumerate(docs):
         out_raw = kv.get(SCOPE, f"out/{doc['rid']}")
         emitted: List[int] = []
         if out_raw is not None:
             out = pickle.loads(out_raw)
             if out.get("done"):
-                continue  # finished (or rejected) before the break
+                # Finished (or rejected) before the break: only its
+                # compaction bookkeeping survives into the new epoch.
+                done_ns.append(int(doc.get("n", watermark + idx)))
+                continue
             emitted = list(out.get("tokens", []))
         entry = dict(doc)
         entry["emitted"] = emitted
         inflight.append(entry)
-    return {"log_next": n, "inflight": inflight}
+    raw = kv.get(SCOPE, VERSION_KEY)
+    version = int(raw.decode()) if raw is not None else 0
+    return {"log_next": n, "inflight": inflight,
+            "watermark": watermark, "done_ns": done_ns,
+            "weight_version": version}
 
 
 def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
                  admitted_step: int, error: Optional[str] = None,
                  finished_step: Optional[int] = None,
-                 reason: Optional[str] = None) -> None:
+                 reason: Optional[str] = None,
+                 n: Optional[int] = None) -> None:
     doc = {
         "rid": rid,
         "tokens": list(tokens),
@@ -187,11 +223,15 @@ def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
         doc["finished_step"] = finished_step
     if reason is not None:
         doc["reason"] = reason
+    if n is not None:
+        # Log index: the ingest pump's finished-output GC keys its
+        # watermark comparison on this (frontend._gc_finished_outputs).
+        doc["n"] = int(n)
     kv.put(SCOPE, f"out/{rid}", pickle.dumps(doc))
 
 
 def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
-                 profiler=None):
+                 profiler=None, swap: Optional[SwapManager] = None):
     """One rendezvous epoch of the serving loop.  Returns the per-rank
     summary dict on a clean drain (``serve/stop``), raises
     HorovodShutdownError on a world break (the caller re-enters).
@@ -226,9 +266,46 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
     else:
         rec = pickle.loads(_fetch(ctx, scope, "recovery",
                                   f"recovery doc for epoch {epoch}"))
+    # Gauges the autoscale controller and the live digest read: the
+    # size of the world this rank just rendezvoused into, and the
+    # weight version it serves.  Every rank converges on the durable
+    # version BEFORE any replay prefill — a replayed request's rebuilt
+    # cache must be computed under the version the new epoch serves.
+    reg.gauge("serve.world_size").set(ctx.size)
+    if swap is not None:
+        swap.reset_epoch()
+        swap.ensure_version(engine, rec.get("weight_version", 0))
     sched = SlotScheduler(spec["num_slots"])
     engine.reset()
     log_next = rec["log_next"]
+    # Request-log compaction (leader-only writes, like every other
+    # durable-record write): log index of every in-flight request, the
+    # done set above the watermark, and the watermark itself.
+    n_of: Dict[str, int] = {}
+    done_ns = set(rec.get("done_ns", []))
+    watermark = rec.get("watermark", 0)
+
+    def _mark_done(rid: str) -> None:
+        """Leader bookkeeping: fold a finished request's log index into
+        the watermark, push the new floor durably, THEN delete the
+        compacted log keys (a crash between the two leaves orphan
+        entries below the floor — harmless — never a floor above
+        surviving entries)."""
+        nonlocal watermark
+        n = n_of.pop(rid, None)
+        if n is not None:
+            done_ns.add(n)
+        old = watermark
+        while watermark in done_ns:
+            done_ns.discard(watermark)
+            watermark += 1
+        if watermark > old:
+            ctx.kv.put(SCOPE, "log_watermark",
+                       str(watermark).encode())
+            for i in range(old, watermark):
+                ctx.kv.delete(SCOPE, f"log/{i}")
+            reg.gauge("serve.log_watermark").set(watermark)
+
     replayed = 0
     for entry in rec["inflight"]:
         reason = validate_request(entry, engine.serve_len,
@@ -241,8 +318,14 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             reg.counter("serve.rejected").inc()
             if is_leader:
                 _publish_out(ctx.kv, entry["rid"], tokens=(), done=True,
-                             epoch=epoch, admitted_step=0, error=reason)
+                             epoch=epoch, admitted_step=0, error=reason,
+                             n=entry.get("n"))
+                if entry.get("n") is not None:
+                    n_of[entry["rid"]] = int(entry["n"])
+                    _mark_done(entry["rid"])
             continue
+        if is_leader and entry.get("n") is not None:
+            n_of[entry["rid"]] = int(entry["n"])
         req = Request(
             rid=entry["rid"], prompt=tuple(entry["prompt"]),
             max_new_tokens=entry["max_new_tokens"],
@@ -298,6 +381,15 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 log_next += 1
             stop = ctx.kv.get(SCOPE, "stop") is not None
             sdoc = {"new": new_entries, "stop": stop}
+            if swap is not None:
+                # The poll-and-flip decision travels the SAME broadcast
+                # lane as admissions: derived from shared data (the
+                # committed manifest + the ranks' prefetch votes) by
+                # the leader alone, obeyed by everyone — the serving
+                # form of "all ranks agree to deviate".
+                sw = swap.leader_step(ctx.kv, scope, ctx.world, step)
+                if sw is not None:
+                    sdoc["swap"] = sw
             ctx.kv.put(scope, f"sched/{step}", pickle.dumps(sdoc))
             if step > _SCHED_KEEP:
                 ctx.kv.delete(scope, f"sched/{step - _SCHED_KEEP}")
@@ -305,6 +397,13 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             sdoc = pickle.loads(_fetch(ctx, scope, f"sched/{step}",
                                        f"schedule for step {step}"))
         t_sched = time.time()
+
+        # -- weight hot-swap transitions (between decode steps, before
+        # this step's admissions: a flip is version-stamped to exactly
+        # this step on every rank) --------------------------------------
+        if swap is not None and sdoc.get("swap"):
+            swap.apply(sdoc["swap"], engine, ctx.kv, scope, ctx.rank,
+                       epoch, step)
 
         for entry in sdoc["new"]:
             reason = validate_request(entry, engine.serve_len,
@@ -314,8 +413,14 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 if is_leader:
                     _publish_out(ctx.kv, entry["rid"], tokens=(),
                                  done=True, epoch=epoch,
-                                 admitted_step=0, error=reason)
+                                 admitted_step=0, error=reason,
+                                 n=entry.get("n"))
+                    if entry.get("n") is not None:
+                        n_of[entry["rid"]] = int(entry["n"])
+                        _mark_done(entry["rid"])
                 continue
+            if is_leader and entry.get("n") is not None:
+                n_of[entry["rid"]] = int(entry["n"])
             sched.enqueue(Request(
                 rid=entry["rid"], prompt=tuple(entry["prompt"]),
                 max_new_tokens=entry["max_new_tokens"],
@@ -472,7 +577,12 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 _publish_out(ctx.kv, ev.rid, tokens=ev.tokens,
                              done=True, epoch=epoch,
                              admitted_step=ev.admitted_step,
-                             finished_step=step, reason=ev.reason)
+                             finished_step=step, reason=ev.reason,
+                             n=n_of.get(ev.rid))
+                # Done doc durably published -> this log index can
+                # leave the replay set; the watermark advances and the
+                # compacted log keys are deleted.
+                _mark_done(ev.rid)
             # Dedup by rid: a request a peer finished just before a
             # world break (its done doc never published) is replayed
             # and finished AGAIN on that peer — without the set, its
@@ -532,6 +642,10 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                     reg.counter("serve.admitted_while_busy").value
                 ),
             }
+            if swap is not None:
+                # Every rank reports the version it drained on — the
+                # single-version chaos gate asserts these agree.
+                out["weight_version"] = swap.version
             if profiler is not None:
                 out["perf"] = profiler.summary()
             return out
@@ -586,11 +700,40 @@ def serve_worker(spec: Optional[dict] = None):
         flops, jax.devices()[0].device_kind,
         source="cost_analysis" if flops else "unavailable",
     )
+    # Weight hot-swap rider (spec["weights_dir"]): versions survive
+    # epoch re-formation on this object; version 0 is the seed-derived
+    # init params every rank built identically above.
+    swap = None
+    if spec.get("weights_dir"):
+        swap = SwapManager(
+            spec["weights_dir"], params,
+            poll_steps=int(spec.get("swap_poll_steps") or 16),
+        )
+        get_registry().gauge("serve.weight_version").set(0)
     totals = {"completed": 0, "tokens": 0, "done_rids": set(),
               "admitted_rids": set()}
+    from ..exceptions import RankDroppedError  # noqa: PLC0415
+
     while True:
         try:
-            return _serve_epoch(ctx, engine, spec, totals, profiler)
+            return _serve_epoch(ctx, engine, spec, totals, profiler,
+                                swap)
+        except RankDroppedError:
+            # Deliberate scale-down (or a shrink past this rank): the
+            # launcher re-minted a world without us.  That is a clean
+            # release, not a failure — exit 0 with a summary so the
+            # monitor banks the result and can re-admit this rank on a
+            # later grow.  (RankDroppedError subclasses
+            # HorovodShutdownError, so this arm must come first.)
+            LOG.info("rank %d released from the serving world "
+                     "(scale-down); exiting cleanly", ctx.rank)
+            get_registry().counter("serve.released").inc()
+            return {
+                "rank": ctx.rank,
+                "released": True,
+                "completed": totals["completed"],
+                "tokens": totals["tokens"],
+            }
         except HorovodShutdownError as exc:
             LOG.warning("serving world broke (%s); re-forming", exc)
             ctx.notify_world_broken()
@@ -622,20 +765,31 @@ class ServeJob:
                  env: Optional[Dict[str, str]] = None,
                  max_retries: int = 3,
                  min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 autoscale: Optional[dict] = None,
                  heartbeat_timeout: float = 60.0,
                  progress_timeout: float = 300.0,
                  blacklist_cooldown: float = 0.5,
                  live_stats_secs: Optional[float] = None,
                  live_history: Optional[str] = None,
                  timeout: Optional[float] = None):
+        """``autoscale``: a dict of :class:`~.autoscale.AutoscaleConfig`
+        overrides (``scale_up_queue``, ``scale_down_idle_secs``, ...)
+        turning on load-driven grow/shrink between ``min_workers`` and
+        ``max_workers`` (default np); requires live stats, so a missing
+        ``live_stats_secs`` defaults to 0.5 when autoscale is on.
+        ``spec["weights_dir"]`` arms weight hot-swap on every rank."""
         from ..run.rendezvous import KVStoreServer  # noqa: PLC0415
 
         self.spec = dict(DEFAULT_SPEC)
         self.spec.update(spec or {})
         self.np = np
         self._env = dict(env or {})
+        if autoscale is not None and live_stats_secs is None:
+            live_stats_secs = 0.5
         self._launch_kw = dict(
             max_retries=max_retries, min_workers=min_workers,
+            max_workers=max_workers, autoscale=autoscale,
             heartbeat_timeout=heartbeat_timeout,
             progress_timeout=progress_timeout,
             blacklist_cooldown=blacklist_cooldown,

@@ -58,8 +58,16 @@ Grammar::
   stale replica); ``trace_drop`` instructs the span-flush path
   (obs/trace.py, point ``trace_flush``) to suppress the next span dump
   on a rank — the deterministic missing-rank input trace-merge's
-  degraded handling is chaos-tested against.  ``worker_exit``/
-  ``task_fn`` points default to ``exit``.
+  degraded handling is chaos-tested against; ``swap_abort`` instructs
+  the weight hot-swap path (serve/service.py, point ``swap_commit`` —
+  fired after shard prefetch succeeded, before the version flip is
+  applied) to ``os._exit`` the rank — the deterministic mid-swap death
+  the single-version convergence gate is chaos-tested against;
+  ``scale_fail`` instructs the launcher's autoscale grow path (point
+  ``scale_admit``) to treat the standby host as refusing admission —
+  the deterministic failed-grow input the exponential-backoff policy
+  is chaos-tested against.  ``worker_exit``/``task_fn`` points default
+  to ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
 * ``name`` — only fire when the call site passes a matching ``name=``
@@ -86,6 +94,8 @@ _ADVISORY_POINTS = {
     "corrupt_write": ("shard_write",),
     "drop_replica": ("replica_push",),
     "trace_drop": ("trace_flush",),
+    "swap_abort": ("swap_commit",),
+    "scale_fail": ("scale_admit",),
 }
 
 
@@ -168,7 +178,8 @@ def parse_spec(raw: str) -> List[FaultSpec]:
             elif key == "action":
                 if value not in ("raise", "exit", "abort", "hang", "delay",
                                  "corrupt_write", "drop_replica",
-                                 "trace_drop"):
+                                 "trace_drop", "swap_abort",
+                                 "scale_fail"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -259,9 +270,10 @@ def maybe_fail(
     ``step=N`` deterministically means "the Nth visit to this point".
 
     Returns the fired action name for the *advisory* actions the call
-    site must apply itself (``corrupt_write``, ``drop_replica``) and
-    ``None`` otherwise — existing callers that ignore the return value
-    keep their exact semantics.
+    site must apply itself (``corrupt_write``, ``drop_replica``,
+    ``trace_drop``, ``swap_abort``, ``scale_fail``) and ``None``
+    otherwise — existing callers that ignore the return value keep
+    their exact semantics.
     """
     specs = _load().get(point)
     counter = None
@@ -293,7 +305,8 @@ def maybe_fail(
             "fault", name=point,
             detail=f"{spec.action}:{spec.describe()}",
         )
-        if spec.action in ("corrupt_write", "drop_replica", "trace_drop"):
+        if spec.action in ("corrupt_write", "drop_replica", "trace_drop",
+                           "swap_abort", "scale_fail"):
             # Advisory actions: the call site owns the I/O, so the
             # registry can only instruct it — corrupt the payload it is
             # about to write, or skip the push entirely.

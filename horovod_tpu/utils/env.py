@@ -127,6 +127,25 @@ SERVE_MODEL = "HVDTPU_SERVE_MODEL"
 SERVE_SLOTS = "HVDTPU_SERVE_SLOTS"
 SERVE_MAX_LEN = "HVDTPU_SERVE_MAX_LEN"
 SERVE_SEED = "HVDTPU_SERVE_SEED"
+# Weight hot-swap (serve/hotswap.py): WEIGHTS_DIR is the sharded-
+# checkpoint directory a concurrently-training publisher commits
+# versions into (unset = hot-swap off); SWAP_POLL_STEPS is the
+# leader's manifest-poll cadence in serving steps.  OUT_TTL bounds how
+# long the ingest pump retains a FINISHED request's compacted result
+# doc for late client polls (request-log compaction, frontend.py).
+SERVE_WEIGHTS_DIR = "HVDTPU_SERVE_WEIGHTS_DIR"
+SERVE_SWAP_POLL_STEPS = "HVDTPU_SERVE_SWAP_POLL_STEPS"
+SERVE_OUT_TTL = "HVDTPU_SERVE_OUT_TTL_SECS"
+DEFAULT_SERVE_OUT_TTL = 300.0
+# Autoscale (serve/autoscale.py): launcher-local knobs; carried as env
+# so config files can set them and operators can see them in ps.  The
+# envelope ceiling MAX_WORKERS also sizes the launcher's slot
+# allocation (standby ranks need hosts the moment a grow admits them).
+SERVE_AUTOSCALE = "HVDTPU_SERVE_AUTOSCALE"
+MAX_WORKERS = "HVDTPU_MAX_WORKERS"
+SCALE_UP_QUEUE = "HVDTPU_SCALE_UP_QUEUE"
+SCALE_DOWN_IDLE_SECS = "HVDTPU_SCALE_DOWN_IDLE_SECS"
+SCALE_COOLDOWN_SECS = "HVDTPU_SCALE_COOLDOWN_SECS"
 
 
 def resolve_rank(default=None):

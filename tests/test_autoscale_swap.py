@@ -1,0 +1,527 @@
+"""Autoscaling + live weight hot-swap (ISSUE 13): the pure
+hysteresis/cooldown/backoff decision table, version-flip determinism
+across simulated ranks, rollback on seeded checksum corruption,
+request-log compaction, and the end-to-end chaos stories — N→M resize
+with in-flight requests bitwise-equal to an uninterrupted run, and a
+rank killed mid-swap converging on exactly one weight version with
+zero dropped requests.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models.decode import generate
+from horovod_tpu.models.transformer import gpt
+from horovod_tpu.serve import ServeJob, SlotEngine, publish_weights
+from horovod_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    AutoscalePolicy,
+    gauges_from_views,
+    world_token,
+)
+from horovod_tpu.serve.frontend import SCOPE, IngestPump
+from horovod_tpu.serve.hotswap import VERSION_KEY, SwapManager
+from horovod_tpu.testing import faults
+
+_OVERRIDES = dict(num_layers=1, num_heads=2, emb_dim=32, max_len=64,
+                  vocab_size=64, dtype=jnp.float32,
+                  attention_impl="reference")
+
+
+def _model():
+    return gpt("nano", **_OVERRIDES)
+
+
+def _params(seed):
+    model = _model()
+    return model, model.init(jax.random.PRNGKey(seed),
+                             jnp.zeros((1, 8), jnp.int32))
+
+
+def _cfg(**kw):
+    base = dict(min_workers=1, max_workers=4, scale_up_queue=4,
+                up_window_secs=1.0, scale_down_idle_secs=5.0,
+                up_cooldown_secs=10.0, down_cooldown_secs=10.0,
+                backoff_base_secs=2.0, backoff_max_secs=60.0)
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Autoscale policy: the pure decision table
+# ---------------------------------------------------------------------------
+
+
+def test_policy_grow_needs_sustained_pressure():
+    p = AutoscalePolicy(_cfg())
+    # one pressured round is a spike, not a trend
+    assert p.observe(0.0, queue_depth=9, active_slots=4,
+                     world_size=2) is None
+    # pressure interrupted -> window restarts
+    assert p.observe(0.5, queue_depth=0, active_slots=0,
+                     world_size=2) is None
+    assert p.observe(0.6, queue_depth=9, active_slots=4,
+                     world_size=2) is None
+    assert p.observe(1.5, queue_depth=9, active_slots=4,
+                     world_size=2) is None  # only 0.9s sustained
+    d = p.observe(1.7, queue_depth=9, active_slots=4, world_size=2)
+    assert d is not None and d.direction == "up" and d.target == 3
+
+
+def test_policy_up_cooldown_blocks_consecutive_grows():
+    p = AutoscalePolicy(_cfg())
+    p.observe(0.0, queue_depth=9, active_slots=4, world_size=2)
+    assert p.observe(1.0, queue_depth=9, active_slots=4,
+                     world_size=2) is not None
+    # still pressured, but inside the cooldown — and the hysteresis
+    # window restarted at the decision
+    assert p.observe(2.5, queue_depth=9, active_slots=4,
+                     world_size=3) is None
+    assert p.observe(10.5, queue_depth=9, active_slots=4,
+                     world_size=3) is None
+    d = p.observe(12.1, queue_depth=9, active_slots=4, world_size=3)
+    assert d is not None and d.direction == "up" and d.target == 4
+
+
+def test_policy_envelope_caps_both_directions():
+    p = AutoscalePolicy(_cfg(max_workers=2))
+    p.observe(0.0, queue_depth=9, active_slots=4, world_size=2)
+    assert p.observe(2.0, queue_depth=9, active_slots=4,
+                     world_size=2) is None  # already at max
+    p2 = AutoscalePolicy(_cfg(min_workers=2))
+    p2.observe(0.0, queue_depth=0, active_slots=0, world_size=2)
+    assert p2.observe(20.0, queue_depth=0, active_slots=0,
+                      world_size=2) is None  # already at min
+
+
+def test_policy_shrink_needs_sustained_idle_and_cooldown():
+    p = AutoscalePolicy(_cfg())
+    assert p.observe(0.0, queue_depth=0, active_slots=0,
+                     world_size=3) is None
+    # a busy blip restarts the idle window
+    assert p.observe(3.0, queue_depth=0, active_slots=1,
+                     world_size=3) is None
+    assert p.observe(3.1, queue_depth=0, active_slots=0,
+                     world_size=3) is None
+    assert p.observe(7.0, queue_depth=0, active_slots=0,
+                     world_size=3) is None
+    d = p.observe(8.2, queue_depth=0, active_slots=0, world_size=3)
+    assert d is not None and d.direction == "down" and d.target == 2
+
+
+def test_policy_no_flapping_across_directions():
+    """An up decision starts the cooldown for DOWN too — the decision
+    trace can never show up,down within one cooldown window."""
+    p = AutoscalePolicy(_cfg(up_window_secs=0.1,
+                             scale_down_idle_secs=0.1))
+    d = p.observe(1.0, queue_depth=9, active_slots=4, world_size=2)
+    assert d is None
+    d = p.observe(1.2, queue_depth=9, active_slots=4, world_size=2)
+    assert d is not None and d.direction == "up"
+    # instantly idle afterwards: the down cooldown (from the up) holds
+    for t in (2.0, 5.0, 9.0, 11.0):
+        assert p.observe(t, queue_depth=0, active_slots=0,
+                         world_size=3) is None
+    d = p.observe(11.4, queue_depth=0, active_slots=0, world_size=3)
+    assert d is not None and d.direction == "down"
+    directions = [e[1] for e in p.trace]
+    assert directions == ["up", "down"]
+    # cooldown respected in the trace: >= 10s apart
+    assert p.trace[1][0] - p.trace[0][0] >= 10.0
+
+
+def test_policy_grow_failure_backs_off_exponentially():
+    p = AutoscalePolicy(_cfg(up_window_secs=0.1, up_cooldown_secs=0.1))
+    d = p.observe(1.0, queue_depth=9, active_slots=4, world_size=1)
+    assert d is None
+    assert p.observe(1.2, queue_depth=9, active_slots=4,
+                     world_size=1) is not None
+    assert p.record_grow_failed(1.2) == 2.0
+    # pressured throughout, but backed off
+    assert p.observe(2.0, queue_depth=9, active_slots=4,
+                     world_size=1) is None
+    d = p.observe(3.5, queue_depth=9, active_slots=4, world_size=1)
+    assert d is not None and d.direction == "up"
+    assert p.record_grow_failed(3.5) == 4.0   # doubled
+    assert p.record_grow_failed(8.0) == 8.0   # doubled again
+    p.record_grow_ok()                         # success resets the ladder
+    assert p.record_grow_failed(20.0) == 2.0
+
+
+def test_policy_ttft_pressure_when_configured():
+    p = AutoscalePolicy(_cfg(scale_up_ttft_ms=500.0,
+                             up_window_secs=0.1))
+    assert p.observe(0.0, queue_depth=0, active_slots=2, world_size=1,
+                     ttft_p50_ms=900.0) is None
+    d = p.observe(0.2, queue_depth=0, active_slots=2, world_size=1,
+                  ttft_p50_ms=900.0)
+    assert d is not None and d.direction == "up"
+
+
+def test_config_envelope_validated():
+    with pytest.raises(ValueError, match="envelope"):
+        AutoscaleConfig(min_workers=3, max_workers=2)
+
+
+def test_world_token_formats():
+    assert world_token(None, 4) == "world 4"
+    assert world_token(4, 4) == "world 4"
+    assert world_token(4, 6, 12) == "world 4→6 v=12"
+
+
+def test_controller_prometheus_exposition():
+    """The launcher-local autoscale series render as parseable
+    exposition lines (HELP/TYPE once per family, counters by
+    direction) — appended to the live plane's /metrics by
+    LivePlane.add_render."""
+    from horovod_tpu.obs.registry import MetricsRegistry
+    from horovod_tpu.serve.autoscale import AutoscaleController, Decision
+
+    reg = MetricsRegistry()
+    c = AutoscaleController(_cfg(), registry=reg)
+    c.executed(Decision("up", 3, "test"), epoch=1, world_size=3)
+    c.executed(Decision("down", 2, "test"), epoch=2, world_size=2)
+    c.grow_failed(0.0, rank=3)
+    body = c.prometheus()
+    assert "hvdtpu_autoscale_world 2" in body.replace(".0", "")
+    assert 'hvdtpu_autoscale_decisions{direction="up"} 1' in body
+    assert 'hvdtpu_autoscale_decisions{direction="down"} 1' in body
+    assert "hvdtpu_autoscale_backoffs 1" in body
+    for family in ("hvdtpu_autoscale_world",
+                   "hvdtpu_autoscale_decisions",
+                   "hvdtpu_autoscale_backoffs"):
+        assert body.count(f"# TYPE {family} ") == 1
+    assert body.endswith("\n")
+
+
+def test_gauges_from_views_silence_and_worst_rank():
+    class _V:
+        def __init__(self, metrics):
+            self.metrics = metrics
+
+    assert gauges_from_views({}) is None
+    views = {
+        0: _V({"a": {"name": "serve.queue_depth", "value": 2},
+               "b": {"name": "serve.active_slots", "value": 1}}),
+        1: _V({"a": {"name": "serve.queue_depth", "value": 7},
+               "c": {"name": "serve.ttft_ms", "count": 3,
+                     "p50": 40.0}}),
+    }
+    g = gauges_from_views(views)
+    assert g["queue_depth"] == 7 and g["active_slots"] == 1
+    assert g["ttft_p50_ms"] == 40.0
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar: the new point-restricted actions
+# ---------------------------------------------------------------------------
+
+
+def test_swap_and_scale_actions_point_restricted():
+    faults.parse_spec("swap_commit:action=swap_abort:rank=1")
+    faults.parse_spec("scale_admit:action=scale_fail")
+    with pytest.raises(ValueError, match="only implemented at"):
+        faults.parse_spec("ckpt_write:action=swap_abort")
+    with pytest.raises(ValueError, match="only implemented at"):
+        faults.parse_spec("swap_commit:action=scale_fail")
+
+
+# ---------------------------------------------------------------------------
+# Hot swap: version-flip determinism + rollback (simulated ranks)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def kv_pair():
+    from horovod_tpu.run.rendezvous import KVStoreClient, KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    kv = KVStoreClient(f"127.0.0.1:{server.port}", server.secret)
+    try:
+        yield server, kv
+    finally:
+        server.stop()
+
+
+def test_version_flip_deterministic_across_simulated_ranks(
+        tmp_path, kv_pair):
+    """Two simulated ranks driven by the leader's broadcast transitions
+    flip to bitwise-identical params on the same step, and the durable
+    record lands BEFORE the flip broadcast."""
+    _, kv = kv_pair
+    model, params0 = _params(3)
+    _, params1 = _params(9)
+    wdir = str(tmp_path / "w")
+    publish_weights(wdir, params1, 1)
+
+    engines = [SlotEngine(model.cfg, params0, 1) for _ in range(2)]
+    swaps = [SwapManager(wdir, params0, poll_steps=1) for _ in range(2)]
+    leader = swaps[0]
+    scope = "serve_e0"
+
+    doc = leader.leader_step(kv, scope, [0, 1], step=1)
+    assert doc == {"phase": "prefetch", "version": 1}
+    for rank, (sw, eng) in enumerate(zip(swaps, engines)):
+        sw.apply(doc, eng, kv, scope, rank, epoch=0, step=1)
+    # votes in, but nothing flipped yet: exactly one version served
+    assert all(sw.version == 0 for sw in swaps)
+    assert kv.get(SCOPE, VERSION_KEY) is None
+
+    doc = leader.leader_step(kv, scope, [0, 1], step=2)
+    assert doc == {"phase": "flip", "version": 1}
+    # durable record written before anyone applies the flip
+    assert kv.get(SCOPE, VERSION_KEY) == b"1"
+    for rank, (sw, eng) in enumerate(zip(swaps, engines)):
+        sw.apply(doc, eng, kv, scope, rank, epoch=0, step=2)
+    assert all(sw.version == 1 for sw in swaps)
+    for a, b in zip(jax.tree_util.tree_leaves(engines[0].params),
+                    jax.tree_util.tree_leaves(engines[1].params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(engines[0].params),
+                    jax.tree_util.tree_leaves(params1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_swap_rollback_on_seeded_checksum_corruption(
+        tmp_path, kv_pair, monkeypatch):
+    """A version published through a corrupt_write fault fails every
+    rank's prefetch checksum; the leader broadcasts abort, the fleet
+    keeps the incumbent, and the bad version is not re-offered."""
+    _, kv = kv_pair
+    model, params0 = _params(3)
+    _, params1 = _params(9)
+    wdir = str(tmp_path / "w")
+    monkeypatch.setenv("HVDTPU_FAULT_SPEC",
+                       "shard_write:action=corrupt_write")
+    faults.reset()
+    try:
+        publish_weights(wdir, params1, 1)
+    finally:
+        monkeypatch.delenv("HVDTPU_FAULT_SPEC")
+        faults.reset()
+
+    eng = SlotEngine(model.cfg, params0, 1)
+    sw = SwapManager(wdir, params0, poll_steps=1)
+    scope = "serve_e0"
+    doc = sw.leader_step(kv, scope, [0], step=1)
+    assert doc == {"phase": "prefetch", "version": 1}
+    sw.apply(doc, eng, kv, scope, 0, epoch=0, step=1)
+    assert kv.get(scope, "swapok_1_0") == b"fail"
+    doc = sw.leader_step(kv, scope, [0], step=2)
+    assert doc == {"phase": "abort", "version": 1}
+    sw.apply(doc, eng, kv, scope, 0, epoch=0, step=2)
+    assert sw.version == 0
+    assert kv.get(SCOPE, VERSION_KEY) is None
+    for a, b in zip(jax.tree_util.tree_leaves(eng.params),
+                    jax.tree_util.tree_leaves(params0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the rejected version is not re-offered...
+    assert sw.leader_step(kv, scope, [0], step=3) is None
+    # ...but a NEWER committed version is
+    publish_weights(wdir, params1, 2)
+    doc = sw.leader_step(kv, scope, [0], step=4)
+    assert doc == {"phase": "prefetch", "version": 2}
+
+
+def test_announce_rejects_foreign_job_fingerprint(tmp_path, kv_pair):
+    _, kv = kv_pair
+    _, params0 = _params(3)
+    sw = SwapManager(str(tmp_path / "w"), params0, poll_steps=1)
+    kv.put(SCOPE, "weights",
+           pickle.dumps({"version": 5, "fp": "not-this-job"}))
+    assert sw.poll_candidate(kv) is None
+
+
+def test_publish_weights_rejects_version_zero(tmp_path):
+    _, params0 = _params(3)
+    with pytest.raises(ValueError, match=">= 1"):
+        publish_weights(str(tmp_path / "w"), params0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Request-log compaction
+# ---------------------------------------------------------------------------
+
+
+def test_pump_gcs_compacted_finished_outputs(kv_pair):
+    server, kv = kv_pair
+    pump = IngestPump(server, out_ttl_secs=0.05)
+    kv.put(SCOPE, "log_watermark", b"2")
+    # below-watermark log orphans (leader crashed between publishing
+    # the watermark and deleting) are swept by the pump
+    kv.put(SCOPE, "log/0", pickle.dumps({"rid": "a", "n": 0}))
+    kv.put(SCOPE, "log/2", pickle.dumps({"rid": "c", "n": 2}))
+    kv.put(SCOPE, "out/a", pickle.dumps(
+        {"rid": "a", "done": True, "n": 0, "tokens": [1]}))
+    kv.put(SCOPE, "out/b", pickle.dumps(
+        {"rid": "b", "done": True, "n": 2, "tokens": [2]}))   # >= mark
+    kv.put(SCOPE, "out/c", pickle.dumps(
+        {"rid": "c", "done": False, "n": 1, "tokens": []}))   # inflight
+    pump._gc_finished_outputs()                # first sight: starts ttl
+    assert kv.get(SCOPE, "log/0") is None      # orphan swept
+    assert kv.get(SCOPE, "log/2") is not None  # at/above the watermark
+    assert kv.get(SCOPE, "out/a") is not None
+    time.sleep(0.1)
+    pump._gc_finished_outputs()
+    assert kv.get(SCOPE, "out/a") is None      # compacted + ttl expired
+    assert kv.get(SCOPE, "out/b") is not None  # above the watermark
+    assert kv.get(SCOPE, "out/c") is not None  # not done
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: resize + swap chaos through a live fleet
+# ---------------------------------------------------------------------------
+
+
+def _spec(slots=2, **extra):
+    o = dict(_OVERRIDES)
+    spec = {"size": "nano", "overrides": o, "seed": 3,
+            "num_slots": slots, "idle_secs": 0.005}
+    spec.update(extra)
+    return spec
+
+
+def _oracle(prompts, steps, seed=3, params=None):
+    model = gpt("nano", **_OVERRIDES)
+    if params is None:
+        params = model.init(jax.random.PRNGKey(seed),
+                            jnp.zeros((1, 8), jnp.int32))
+    return [
+        np.asarray(generate(model.cfg, params,
+                            jnp.asarray([p], jnp.int32), s))[0].tolist()
+        for p, s in zip(prompts, steps)
+    ]
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_autoscale_grow_under_load_then_drain_release():
+    """ISSUE 13 acceptance (2): load-driven grow through a re-minted
+    epoch with requests in flight (tokens bitwise-equal to an
+    uninterrupted run — the resize is a survived failure as far as
+    clients can tell), then drain-driven shrink releasing the standby
+    cleanly, cooldown respected in the decision trace."""
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, 64, rs.randint(3, 9)).tolist()
+               for _ in range(12)]
+    # Long generations through ONE slot keep the queue above the
+    # high-water mark for seconds — the hysteresis window must see
+    # SUSTAINED pressure across several controller ticks, not a spike.
+    steps = [48] * 12
+    oracle = _oracle(prompts, steps)
+    job = ServeJob(
+        _spec(slots=1), np=1, min_workers=1, max_workers=2,
+        autoscale={"scale_up_queue": 2, "up_window_secs": 0.2,
+                   "scale_down_idle_secs": 1.0,
+                   "up_cooldown_secs": 1.0, "down_cooldown_secs": 1.0},
+        live_stats_secs=0.2,
+        env={"JAX_PLATFORMS": "cpu"}, timeout=300,
+    ).start()
+    try:
+        rids = [job.client.submit(p, max_new_tokens=s)
+                for p, s in zip(prompts, steps)]
+        docs = [job.client.result(r, timeout=240) for r in rids]
+        # wait for the drain-driven release before stopping
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            ev = [e[0] for e in (job._job.trace if job._job else [])]
+            if "scale_down" in ev:
+                break
+            time.sleep(0.25)
+        results, ejob = job.stop()
+    finally:
+        job.shutdown()
+    # zero dropped, bitwise-equal through the resize replays
+    assert [d["tokens"] for d in docs] == oracle
+    events = [e[0] for e in ejob.trace]
+    assert "scale_up" in events and "scale_down" in events, ejob.trace
+    assert events.count("failure") == 0    # resizes are not failures
+    # requests finished inside a re-minted (post-resize) epoch
+    assert max(d["epoch"] for d in docs) >= 1
+    # the released standby exited cleanly with a release summary
+    assert results[1].get("released") is True
+    assert results[0]["completed"] == 12
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_chaos_kill_mid_swap_converges_on_one_version():
+    """ISSUE 13 acceptance (1): a rank killed between shard prefetch
+    and version flip (swap_commit/action=swap_abort).  The fleet
+    re-forms, converges on exactly one weight version (the durable
+    record), drops zero requests, and every token stream is
+    bitwise-equal to single-stream generate under that version (the
+    published version carries the same params, so the oracle covers
+    both sides of the flip)."""
+    import tempfile
+
+    model, params0 = _params(3)
+    wdir = tempfile.mkdtemp(prefix="hvdtpu_swapw_")
+    # Same weights, new version stamp: the mechanics (prefetch, votes,
+    # durable record, flip, mid-swap death, convergence) are fully
+    # exercised while every request stays oracle-comparable.
+    publish_weights(wdir, params0, 1)
+
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(0, 64, rs.randint(3, 9)).tolist()
+               for _ in range(8)]
+    steps = [3, 4, 5, 6, 3, 4, 5, 6]
+    oracle = _oracle(prompts, steps)
+    job = ServeJob(
+        _spec(slots=2, weights_dir=wdir, swap_poll_steps=4), np=2,
+        env={"JAX_PLATFORMS": "cpu",
+             "HVDTPU_FAULT_SPEC": "swap_commit:action=swap_abort:rank=1"},
+        max_retries=2, timeout=300,
+    ).start()
+    try:
+        rids = []
+        for p, s in zip(prompts, steps):
+            rids.append(job.client.submit(p, max_new_tokens=s))
+            time.sleep(0.05)
+        docs = [job.client.result(r, timeout=240) for r in rids]
+        results, ejob = job.stop()
+    finally:
+        job.shutdown()
+    # zero dropped, bitwise-equal
+    assert [d["tokens"] for d in docs] == oracle
+    # the mid-swap death was a real failure+respawn
+    events = [e[0] for e in ejob.trace]
+    assert events.count("failure") == 1 and events.count("respawn") == 1
+    # single-version convergence: every rank drained on the SAME
+    # version — the durable record's (the flip record landed before the
+    # death, so it must be 1)
+    versions = {r: v.get("weight_version") for r, v in results.items()}
+    assert versions == {0: 1, 1: 1}, versions
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_log_compaction_bounds_store_and_replay(tmp_path):
+    """The ingest log does not grow with total requests ever served:
+    after everything finishes, the watermark has retired every entry
+    and the log keys below it are deleted."""
+    job = ServeJob(_spec(slots=2), np=1,
+                   env={"JAX_PLATFORMS": "cpu"}, timeout=240).start()
+    try:
+        rids = [job.client.submit([1 + i, 2, 3], max_new_tokens=3)
+                for i in range(6)]
+        for r in rids:
+            job.client.result(r, timeout=180)
+        # leader publishes the watermark + deletes synchronously with
+        # the done docs, so results back means compaction happened
+        raw = job._server.scan(SCOPE + "/log_watermark")
+        mark = int(raw[SCOPE + "/log_watermark"].decode())
+        assert mark == 6
+        assert job._server.scan(SCOPE + "/log/") == {}
+        job.stop()
+    finally:
+        job.shutdown()
