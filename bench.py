@@ -145,6 +145,17 @@ def write_degraded_record(why: str, *, rc: int, phase: str,
         "failure_phase": phase,
         "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
     }
+    # Degraded records carry the memory breakdown too (census says
+    # "source: unavailable" when the failure predates jax init): the
+    # item-5 sweep reads headroom off EVERY record on the trajectory,
+    # and a record that died in warmup still knows what was resident.
+    if parsed is None or "memory" not in parsed:
+        try:
+            from horovod_tpu.obs import memplane  # noqa: PLC0415
+
+            doc["memory"] = memplane.memory_record()
+        except Exception:
+            pass
     path = os.path.join(d, f"BENCH_r{n:02d}.json")
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
@@ -752,6 +763,13 @@ def _serve_bench(args) -> int:
         perf = results[ranks[0]].get("perf")
         if perf:
             out["perf"] = perf
+        # Worker-side memory breakdown (obs/memplane.py): census +
+        # per-program compiled bytes + the KV pool's resident
+        # footprint — replicated fleet, so rank 0's view stands in
+        # for all.
+        mem = results[ranks[0]].get("memory")
+        if mem:
+            out["memory"] = mem
         # Continuous batching actually happened: admissions that entered
         # while other slots were mid-decode (max across ranks — the
         # counts are identical by the schedule invariant).
@@ -837,6 +855,26 @@ def attach_regression(out: dict, record_dir: str = None,
                     "baseline": old,
                     "pct": round((new - old) / old * 100.0, 2),
                 }
+        # Peak device-memory delta, INFORMATIONAL only: memory growth
+        # is worth seeing next to the perf number (a +20% throughput
+        # that costs 2x HBM changes the item-5 bucket-size choice), but
+        # it never flips the regression flag — the flag means "the
+        # measurement got worse", and more bytes is not that.
+        def _peak(doc):
+            dev = ((doc.get("memory") or {}).get("census") or {}
+                   ).get("device") or {}
+            return dev.get("peak_bytes") or (
+                (doc.get("memory") or {}).get("census") or {}
+            ).get("total_bytes")
+
+        old_peak, new_peak = _peak(parsed), _peak(out)
+        if (isinstance(old_peak, (int, float)) and old_peak
+                and isinstance(new_peak, (int, float))):
+            deltas["peak_bytes"] = {
+                "baseline": old_peak,
+                "pct": round((new_peak - old_peak) / old_peak * 100.0, 2),
+                "informational": True,
+            }
         out["baseline_record"] = {
             "file": fname,
             "stale_records_skipped": skipped,
@@ -860,7 +898,8 @@ def collect_engine_gauges() -> dict:
     try:
         from horovod_tpu.obs import get_registry
 
-        wanted_prefixes = ("autotune.", "overlap.", "perf.")
+        wanted_prefixes = ("autotune.", "overlap.", "perf.", "mem.",
+                           "serve.kv.")
         wanted_names = {
             "engine.negotiation_skip_rate",
             "engine.cache_hit_rate",
@@ -1067,6 +1106,34 @@ def main() -> int:
         compiled = step.lower(*carry, *const).compile()
         # compile done; warmup window
         _touch_progress(next_window=300, phase="warmup")
+        # Memory plane (obs/memplane.py): the train step's artifact-
+        # derived breakdown, owner tags over the live state (the
+        # closures read the CURRENT carry — it is rebound every
+        # iteration), and the census collector so every registry
+        # snapshot below carries mem.* gauges.  Best-effort: memory
+        # accounting must never sink a measurement.
+        try:
+            from horovod_tpu.obs import memplane  # noqa: PLC0415
+
+            memplane.register_program(
+                f"train_step.{args.overlap}", compiled
+            )
+            _overlap_on = args.overlap != "off"
+
+            def _params_now():
+                c = carry[0]
+                return c[0] if _overlap_on else c
+
+            def _opt_now():
+                if _overlap_on:
+                    return carry[0][1]
+                return carry[1] if len(carry) > 1 else None
+
+            memplane.register_owner("params", _params_now)
+            memplane.register_owner("optimizer_state", _opt_now)
+            memplane.install_census()
+        except Exception:
+            pass
         # Donation audit: params/opt_state must stay aliased end-to-end
         # through whichever step wrapper built the program (donation
         # silently degrades to a copy on mismatch, so check the
@@ -1179,6 +1246,12 @@ def main() -> int:
         out["overlap_mode"] = args.overlap
     if donation_audit is not None:
         out["donation"] = donation_audit
+    try:
+        from horovod_tpu.obs import memplane  # noqa: PLC0415
+
+        out["memory"] = memplane.memory_record()
+    except Exception:
+        pass
     gauges = collect_engine_gauges()
     if gauges:
         out["engine_gauges"] = gauges

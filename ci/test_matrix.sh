@@ -846,7 +846,10 @@ for p, s in zip(prompts, steps):
 # are still churning (they stream as deltas, so poll until all four
 # series have landed)
 WANT = ("hvdtpu_serve_queue_depth", "hvdtpu_serve_active_slots",
-        "hvdtpu_serve_admitted", "hvdtpu_serve_tokens_per_sec")
+        "hvdtpu_serve_admitted", "hvdtpu_serve_tokens_per_sec",
+        # Memory plane (ISSUE 14): KV occupancy must stream live —
+        # the paged-attention baseline is read off a running fleet.
+        "hvdtpu_serve_kv_waste_ratio")
 deadline = time.monotonic() + 120
 serve_series = []
 while time.monotonic() < deadline:
@@ -1004,6 +1007,43 @@ JAX_PLATFORMS=cpu \
 PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
     timeout 420 python -m pytest \
     "tests/test_trace.py::test_trace_acceptance_leader_kill_waterfall_and_mfu" \
+    -x -q
+
+# Memory gate (ISSUE 14): the HBM memory plane.  hvdtpu-lint clean
+# over the new surface, the unit suite, then the two artifact gates:
+# every collective-bearing program's per-device footprint must stay
+# under the committed memory_budget.json ceiling (and a seeded 64x
+# oversized program must be rejected — a budget that cannot fail is
+# decorative), the PR-9 ZeRO-1 claim is asserted from the compiled
+# programs' input buffers (optimizer-state bytes under bucket+zero1
+# <= 1/world + eps of bucket mode on the 8-device mesh), and the OOM
+# chaos acceptance: a seeded backend-shaped RESOURCE_EXHAUSTED on one
+# rank must leave a postmortem whose verdict names the dying rank AND
+# its dominant memory owner.
+echo "== mem gate: lint + unit suite =="
+python -m horovod_tpu.analysis horovod_tpu/obs/memplane.py \
+    scripts/mem_gate.py \
+    --baseline horovod_tpu/analysis/baseline.json
+JAX_PLATFORMS=cpu \
+    timeout 300 python -m pytest tests/test_memplane.py -q \
+    -m "not multiprocess and not slow"
+echo "== mem gate: compile-heavy coverage (slot-engine kv + 8-dev zero1) =="
+JAX_PLATFORMS=cpu \
+    timeout 400 python -m pytest \
+    "tests/test_memplane.py::test_slot_engine_kv_stats_match_hand_computed" \
+    "tests/test_memplane.py::test_zero1_budget_math_on_8_device_mesh" \
+    -x -q
+echo "== mem gate: per-program budget + zero1 ratio from the artifact =="
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 580 python scripts/mem_gate.py
+echo "== mem gate: seeded budget violation must fail =="
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 580 python scripts/mem_gate.py --seed-violation
+echo "== mem gate: OOM chaos -> postmortem names rank + dominant owner =="
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    timeout 400 python -m pytest \
+    "tests/test_memplane.py::test_oom_chaos_postmortem_names_rank_and_owner" \
     -x -q
 
 # Elastic chaos smoke through the real launcher: a rank is killed

@@ -39,11 +39,18 @@ quantitative):
   (compiled ``cost_analysis()`` with analytic fallbacks) over measured
   step time, published live as ``perf.mfu`` / ``perf.model_tflops`` /
   ``perf.step_ms`` gauges.
+* **memory plane** (obs/memplane.py) — the byte axis: compiled
+  per-program breakdowns (``memory_analysis()``, version-tolerant),
+  an owner-tagged ``jax.live_arrays()`` census with backend
+  ``memory_stats()`` (``mem.*`` gauges, KV-cache occupancy math), and
+  the OOM black box (``mem.oom`` flight-recorder events feeding the
+  post-mortem's memory verdict).
 
 See docs/observability.md and docs/postmortem.md.
 """
 
 from . import flightrec  # noqa: F401
+from . import memplane  # noqa: F401
 from . import profile  # noqa: F401
 from . import progress  # noqa: F401
 from . import straggler  # noqa: F401

@@ -224,6 +224,18 @@ def record(kind: str, name: str = "", cycle: int = -1,
 
 def record_exception(exc: BaseException, where: str = "") -> None:
     get_recorder().record_exception(exc, where=where)
+    # The memory plane's OOM black box rides the same death path: a
+    # RESOURCE_EXHAUSTED exception additionally drops a ``mem.oom``
+    # event (last census + dominant owner) so the post-mortem can name
+    # WHAT was resident when the allocator gave up, not just that it
+    # did.  Defensive import: a stripped tree without the plane must
+    # still record the exception itself.
+    try:
+        from . import memplane  # noqa: PLC0415
+
+        memplane.maybe_record_oom(exc, where=where)
+    except Exception:
+        pass
 
 
 def _resolve_rank() -> str:

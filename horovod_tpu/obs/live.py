@@ -96,6 +96,23 @@ _METRIC_HELP = {
                             "milliseconds",
     "engine.negotiation_ms": "Control-plane exchange time per cycle, "
                              "milliseconds",
+    "mem.hbm_bytes_in_use": "Backend-reported device bytes in use "
+                            "(memory_stats; absent on CPU)",
+    "mem.hbm_peak_bytes": "Backend-reported peak device bytes in use",
+    "mem.hbm_limit_bytes": "Backend-reported device memory limit",
+    "mem.headroom_bytes": "Device memory limit minus bytes in use",
+    "mem.live_bytes": "Sum of live jax array bytes on this process "
+                      "(host-triggered census, obs/memplane.py)",
+    "mem.owner_bytes": "Live array bytes per logical owner (params / "
+                       "optimizer_state / grad_buckets / kv_cache / "
+                       "other)",
+    "serve.kv.allocated_bytes": "KV bytes the fixed-row slot pool "
+                                "reserves for the busy slots "
+                                "(slots-in-use x max_len rows)",
+    "serve.kv.live_bytes": "KV bytes the busy slots actually wrote "
+                           "(sum of per-slot positions)",
+    "serve.kv.waste_ratio": "1 - live/allocated KV bytes: the tail "
+                            "paged attention would reclaim",
 }
 
 
@@ -236,6 +253,9 @@ class LiveAggregator:
         perf = self._perf_part(views)
         if perf:
             parts.append(perf)
+        mem = self._mem_part(views)
+        if mem:
+            parts.append(mem)
         return "live[" + time.strftime("%H:%M:%S") + "] " + " | ".join(parts)
 
     @staticmethod
@@ -446,6 +466,55 @@ class LiveAggregator:
         if step_ms is not None:
             token += f" step {step_ms:.0f}ms"
         return token
+
+    @staticmethod
+    def _mem_part(views) -> Optional[str]:
+        """One digest token for the memory plane (obs/memplane.py):
+        ``mem 11.2/16.0G kv 38% waste 62%`` — device bytes in use over
+        the limit (worst rank: the fleet OOMs at its fullest chip),
+        falling back to the census live-bytes total when the backend
+        reports no HBM (CPU dev mode, suffix ``live``), plus KV-cache
+        utilization/waste when the serving plane published occupancy.
+        Absent on jobs that never armed the census."""
+        in_use = limit = live = None
+        kv_alloc = kv_live = waste = None
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                if name == "mem.hbm_bytes_in_use":
+                    v = float(m["value"])
+                    in_use = v if in_use is None else max(in_use, v)
+                elif name == "mem.hbm_limit_bytes":
+                    v = float(m["value"])
+                    limit = v if limit is None else max(limit, v)
+                elif name == "mem.live_bytes":
+                    v = float(m["value"])
+                    live = v if live is None else max(live, v)
+                elif name == "serve.kv.allocated_bytes":
+                    v = float(m["value"])
+                    kv_alloc = v if kv_alloc is None else max(kv_alloc, v)
+                elif name == "serve.kv.live_bytes":
+                    v = float(m["value"])
+                    kv_live = v if kv_live is None else max(kv_live, v)
+                elif name == "serve.kv.waste_ratio":
+                    v = float(m["value"])
+                    waste = v if waste is None else max(waste, v)
+        if in_use is None and live is None and waste is None:
+            return None
+        gib = 2.0 ** 30
+        bits = []
+        if in_use is not None and limit:
+            bits.append(f"mem {in_use / gib:.1f}/{limit / gib:.1f}G")
+        elif in_use is not None:
+            bits.append(f"mem {in_use / gib:.1f}G")
+        elif live is not None:
+            bits.append(f"mem {live / gib:.2f}G live")
+        if kv_alloc:
+            util = (kv_live or 0.0) / kv_alloc
+            bits.append(f"kv {util:.0%} waste {waste or 0.0:.0%}")
+        elif waste is not None:
+            bits.append(f"kv waste {waste:.0%}")
+        return " ".join(bits) if bits else None
 
     # ---------------------------------------------------------- history
 

@@ -168,6 +168,34 @@ def _rank_summary(doc: dict) -> dict:
             "ms": float(fields["ms"]) if "ms" in fields else None,
             "commits": ev.get("cycle"),
         }
+    # OOM black box: the memory plane drops a ``mem.oom`` event (last
+    # census + dominant owner) on every RESOURCE_EXHAUSTED death path.
+    # The NEWEST one is this incarnation's memory story — the proof of
+    # WHAT was resident when the allocator gave up.
+    ooms = [e for e in events if e.get("kind") == "mem.oom"]
+    last_oom = None
+    if ooms:
+        ev = ooms[-1]
+        fields = dict(
+            kv.split("=", 1) for kv in (ev.get("detail") or "").split()
+            if "=" in kv
+        )
+
+        def _num(key, cast):
+            try:
+                return cast(fields[key])
+            except (KeyError, TypeError, ValueError):
+                return None
+
+        last_oom = {
+            "where": fields.get("where"),
+            "owner": fields.get("owner"),
+            "share": _num("share", float),
+            "owner_bytes": _num("owner_bytes", int),
+            "total_bytes": _num("total_bytes", int),
+            "in_use": _num("in_use", int),
+            "limit": _num("limit", int),
+        }
     return {
         "rank": int(doc.get("rank")),
         "epoch": doc.get("epoch") or 0,
@@ -182,6 +210,7 @@ def _rank_summary(doc: dict) -> dict:
         "last_collective": (last_complete or {}).get("name") or None,
         "last_exception": doc.get("last_exception"),
         "last_restore": last_restore,
+        "last_oom": last_oom,
         "submitted": [e.get("name") for e in aligned
                       if e.get("kind") == "enqueue"],
         "completed": [e.get("name") for e in aligned
@@ -345,6 +374,10 @@ def analyze(
             str(r["rank"]): r["last_restore"]
             for r in ranks if r.get("last_restore")
         },
+        "memory": {
+            str(r["rank"]): r["last_oom"]
+            for r in ranks if r.get("last_oom")
+        },
         "ranks": ranks,
         "live_last_round": _read_live_history(live_history),
     }
@@ -447,6 +480,31 @@ def verdict(report: dict) -> str:
             f"{div['index'] + 1}: {ops} — ranks disagreeing on the op "
             f"sequence is the classic desync hang."
         )
+    mem = report.get("memory") or {}
+    if mem:
+        def _gb(b):
+            return (f"{b / 2 ** 30:.2f}GB" if b and b >= 2 ** 30
+                    else f"{(b or 0) / 2 ** 20:.1f}MB")
+
+        bits = []
+        for rank, m in sorted(mem.items(), key=lambda kv: int(kv[0])):
+            m = m or {}
+            bit = f"rank {rank} died allocating in {m.get('where')!r}"
+            if m.get("owner"):
+                bit += f"; {m['owner']} held"
+                if m.get("share") is not None:
+                    bit += f" {m['share']:.0%} of"
+                bit += " the tagged device memory"
+                if m.get("owner_bytes"):
+                    bit += f" ({_gb(m['owner_bytes'])}"
+                    if m.get("total_bytes"):
+                        bit += f" of {_gb(m['total_bytes'])}"
+                    bit += ")"
+            if m.get("in_use") is not None and m.get("limit"):
+                bit += (f"; HBM {_gb(m['in_use'])} in use of "
+                        f"{_gb(m['limit'])}")
+            bits.append(bit)
+        parts.append("OUT OF DEVICE MEMORY: " + "; ".join(bits) + ".")
     prov = report.get("restore_provenance") or {}
     if prov:
         parts.append(

@@ -236,6 +236,15 @@ def maybe_start_from_env() -> Optional[StreamPublisher]:
     Called from ``hvd.init()`` and the elastic heartbeat start, so both
     launch modes stream without user code changes."""
     global _current, _atexit_installed
+    # The memory plane's env opt-in rides the same worker-init hook:
+    # HVDTPU_MEM_CENSUS=1 arms the census collector here regardless of
+    # whether streaming itself is on (the exit dump consumes it too).
+    try:
+        from . import memplane  # noqa: PLC0415
+
+        memplane.maybe_install_from_env()
+    except Exception:
+        pass
     cfg = _env_config()
     if cfg is None:
         return None

@@ -23,6 +23,7 @@ __all__ = [
     "fabric_section",
     "autoscale_section",
     "perf_section",
+    "mem_section",
     "summarize",
 ]
 
@@ -400,6 +401,85 @@ def perf_section(dumps: Dict[str, dict]) -> Optional[str]:
             row += f", step {vals['perf.step_ms']:.3g}ms"
         rows.append(row)
     return "\n".join(rows) if rows else None
+
+
+def _fmt_bytes(b: float) -> str:
+    """Human bytes for the memory rows (binary units, one decimal)."""
+    b = float(b)
+    for unit, div in (("GiB", 2.0 ** 30), ("MiB", 2.0 ** 20),
+                      ("KiB", 2.0 ** 10)):
+        if b >= div:
+            return f"{b / div:.1f}{unit}"
+    return f"{int(b)}B"
+
+
+def mem_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job device-memory report (obs/memplane.py gauges):
+    per-rank HBM in-use/peak/limit (census live-bytes fallback on
+    backends that report no stats — CPU dev mode says so instead of
+    inventing an HBM), the owner breakdown (params / optimizer_state /
+    kv_cache / …), KV-cache occupancy, and the per-program compiled
+    breakdowns.  None when no rank armed the memory plane."""
+    rows = []
+    programs: Dict[str, Dict[str, float]] = {}
+    for label in sorted(dumps, key=_rank_sort_key):
+        vals: Dict[str, float] = {}
+        owners: Dict[str, float] = {}
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if name in ("mem.hbm_bytes_in_use", "mem.hbm_peak_bytes",
+                        "mem.hbm_limit_bytes", "mem.headroom_bytes",
+                        "mem.live_bytes", "serve.kv.allocated_bytes",
+                        "serve.kv.live_bytes", "serve.kv.waste_ratio"):
+                vals[name] = float(m["value"])
+            elif name == "mem.owner_bytes":
+                owner = (m.get("tags") or {}).get("owner", "?")
+                owners[owner] = float(m["value"])
+            elif name and name.startswith("mem.compiled."):
+                prog = (m.get("tags") or {}).get("program", "?")
+                programs.setdefault(prog, {})[
+                    name[len("mem.compiled."):]
+                ] = float(m["value"])
+        if not vals and not owners:
+            continue
+        if "mem.hbm_bytes_in_use" in vals:
+            row = f"rank {label}: hbm {_fmt_bytes(vals['mem.hbm_bytes_in_use'])}"
+            if vals.get("mem.hbm_limit_bytes"):
+                row += f"/{_fmt_bytes(vals['mem.hbm_limit_bytes'])}"
+            if vals.get("mem.hbm_peak_bytes"):
+                row += f" (peak {_fmt_bytes(vals['mem.hbm_peak_bytes'])})"
+        else:
+            row = (f"rank {label}: live "
+                   f"{_fmt_bytes(vals.get('mem.live_bytes', 0))} "
+                   f"(no backend memory stats — census only)")
+        total = sum(owners.values())
+        if total:
+            shares = " ".join(
+                f"{k}={owners[k] / total:.0%}"
+                for k in sorted(owners, key=lambda k: -owners[k])
+                if owners[k]
+            )
+            row += f", owners {shares}"
+        if vals.get("serve.kv.allocated_bytes"):
+            row += (
+                f", kv {_fmt_bytes(vals.get('serve.kv.live_bytes', 0))}"
+                f"/{_fmt_bytes(vals['serve.kv.allocated_bytes'])} live "
+                f"(waste {vals.get('serve.kv.waste_ratio', 0.0):.0%})"
+            )
+        rows.append(row)
+    if not rows:
+        return None
+    for prog in sorted(programs):
+        b = programs[prog]
+        rows.append(
+            f"program {prog}: total "
+            f"{_fmt_bytes(b.get('total_bytes', 0))} "
+            f"(arg {_fmt_bytes(b.get('argument_bytes', 0))}, "
+            f"temp {_fmt_bytes(b.get('temp_bytes', 0))}, "
+            f"out {_fmt_bytes(b.get('output_bytes', 0))}, "
+            f"alias {_fmt_bytes(b.get('alias_bytes', 0))})"
+        )
+    return "\n".join(rows)
 
 
 def _rank_sort_key(label: str):

@@ -510,6 +510,24 @@ class OverlapPlan:
             # a stripped environment (no obs plane) must still train.
             pass
 
+    def register_memory(self, compiled, program: Optional[str] = None
+                        ) -> dict:
+        """Publish the compiled train step's memory breakdown as
+        ``mem.compiled.*{program=overlap.<mode>}`` gauges (memory
+        plane, obs/memplane.py) — call at the compile site with the
+        executable (``step.lower(...).compile()``), the same artifact
+        :func:`inspect_schedule` proves the overlap from.  This is the
+        registration that makes the ZeRO-1 claim checkable: the
+        ``bucket`` vs ``bucket+zero1`` argument bytes differ by
+        exactly the sharded state (scripts/mem_gate.py gates the
+        ratio).  Returns the breakdown; version-tolerant (``source:
+        unavailable`` on interpreters without ``memory_analysis``)."""
+        from ..obs import memplane  # noqa: PLC0415
+
+        return memplane.register_program(
+            program or f"overlap.{self.mode}", compiled
+        )
+
     # -------------------------------------------------------------- state
 
     def init(self, params):

@@ -1868,3 +1868,7 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if perf is not None:
         print("\n== mfu / model flops ==")
         print(perf)
+    mem = obs_summary.mem_section(dumps)
+    if mem is not None:
+        print("\n== device memory (memory plane) ==")
+        print(mem)

@@ -148,6 +148,38 @@ class DevicePlane:
                     "on the one-device-per-process row mesh",
                     sorted(len(v) for v in by_proc.values()),
                 )
+        # Memory-plane program names already registered: the first
+        # fused shape per schedule stands as the representative
+        # breakdown (one extra small AOT compile per name, once per
+        # process — subsequent cycles pay nothing).
+        self._mem_registered: set = set()
+
+    # ----------------------------------------------------- memory plane
+
+    def _register_memory(self, name: str, fn, *args) -> None:
+        """Publish one compiled collective program's memory breakdown
+        (obs/memplane.py) the first time that schedule runs.  The jit
+        dispatch cache and the AOT lowering are separate caches, so
+        this costs ONE extra compile of a small psum program per
+        schedule name per process — bounded, and the per-program
+        ``mem.compiled.*`` gauges are what makes the engine's wire
+        plane visible to the budget gate.  Never fatal."""
+        if name in self._mem_registered:
+            return
+        try:
+            from ..obs import memplane  # noqa: PLC0415
+
+            # Only when the plane is armed (census installed /
+            # HVDTPU_MEM_CENSUS): this registration is the one compile
+            # site where reading the artifact costs a REAL extra
+            # compile, and a job that never asked for memory
+            # accounting must not pay it on every engine spin-up.
+            if not memplane.accounting_armed():
+                return
+            self._mem_registered.add(name)
+            memplane.register_program(name, fn.lower(*args).compile())
+        except Exception:  # pragma: no cover - defensive
+            self._mem_registered.add(name)
 
     # ------------------------------------------------------------- staging
 
@@ -280,7 +312,9 @@ class DevicePlane:
             reduce_op, pre, post, str(flat.dtype), acc_dtype,
             exact_int_avg, dcn_wire,
         )
-        out = self._local(fn(self._stage_slices(flat)))
+        staged = self._stage_slices(flat)
+        self._register_memory("engine.allreduce_hier", fn, staged)
+        out = self._local(fn(staged))
         return out[:n]
 
     # ------------------------------------------- sharded (multi-chip) path
@@ -371,7 +405,9 @@ class DevicePlane:
                 reduce_op, pre, post, str(flat.dtype), acc_dtype,
                 exact_int_avg,
             )
-            out = fn(self._stage_sharded(flat))
+            staged = self._stage_sharded(flat)
+            self._register_memory("engine.fused_allreduce", fn, staged)
+            out = fn(staged)
             shards = out.addressable_shards
             pick = next(
                 (s for s in shards if s.data.devices() == {caller_dev}),
@@ -381,7 +417,9 @@ class DevicePlane:
         fn = self._allreduce_fn(
             reduce_op, pre, post, str(flat.dtype), acc_dtype, exact_int_avg
         )
-        return self._local(fn(self.stage(flat)))
+        staged = self.stage(flat)
+        self._register_memory("engine.fused_allreduce", fn, staged)
+        return self._local(fn(staged))
 
     @functools.lru_cache(maxsize=64)
     def _allgather_fn(self):

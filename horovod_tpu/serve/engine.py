@@ -18,6 +18,7 @@ which is future work (docs/inference.md, honest limits).
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import jax
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.decode import assign_slot, decode_step, init_cache
+from ..obs import memplane
 
 __all__ = ["SlotEngine", "prompt_bucket"]
 
@@ -80,8 +82,12 @@ class SlotEngine:
 
         # One jitted assign serves every bucket: jax.jit's own trace
         # cache keys on the padded shape, so power-of-two padding alone
-        # bounds compiles at O(log max_len).
+        # bounds compiles at O(log max_len).  The per-bucket AOT
+        # executables live in _assign_exec (same single-compile handoff
+        # as _step_exec) so each bucket's memory breakdown is read off
+        # the artifact the moment it compiles.
         self._assign_compiled = jax.jit(_assign, donate_argnums=(1,))
+        self._assign_exec: Dict[int, object] = {}
 
         def _step(params, cache, tokens, mask):
             logits, cache = decode_step(cfg, params, cache, tokens,
@@ -99,6 +105,17 @@ class SlotEngine:
         self._step_exec = None
         self._step_flops: Optional[float] = None
         self._step_flops_known = False
+        # Memory-plane owner tags: the census buckets live arrays by
+        # who holds them.  Registered through a weakref so a dropped
+        # engine (tests build many) is pruned, not pinned alive by its
+        # own observability.
+        ref = weakref.ref(self)
+        memplane.register_owner(
+            "kv_cache", lambda: (lambda e: e.cache if e else None)(ref())
+        )
+        memplane.register_owner(
+            "params", lambda: (lambda e: e.params if e else None)(ref())
+        )
 
     # --------------------------------------------------------- admission
 
@@ -122,10 +139,18 @@ class SlotEngine:
         bucket = prompt_bucket(len(seq), self.serve_len)
         padded = np.zeros(bucket, np.int32)
         padded[:len(seq)] = seq
-        self.cache, first = self._assign_compiled(
-            self.params, self.cache, jnp.asarray(slot, jnp.int32),
-            jnp.asarray(padded), jnp.asarray(len(seq), jnp.int32),
-        )
+        args = (self.params, self.cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded), jnp.asarray(len(seq), jnp.int32))
+        assign_fn = self._assign_exec.get(bucket)
+        if assign_fn is None:
+            # First admission at this bucket: AOT-compile once (the jit
+            # dispatch cache never runs — ONE compile per bucket, same
+            # handoff as _step_exec) and register the artifact's memory
+            # breakdown while we hold it.
+            assign_fn = self._assign_compiled.lower(*args).compile()
+            memplane.register_program(f"serve.assign_b{bucket}", assign_fn)
+            self._assign_exec[bucket] = assign_fn
+        self.cache, first = assign_fn(*args)
         if cur is not None:
             self._cur[slot] = cur
             return None
@@ -178,10 +203,33 @@ class SlotEngine:
                 jnp.asarray(mask),
             ).compile()
             self._step_exec = compiled
+            memplane.register_program("serve.decode_step", compiled)
             self._step_flops = flops_from_compiled(compiled)
         except Exception:
             self._step_flops = None
         return self._step_flops
+
+    # ------------------------------------------------------ kv occupancy
+
+    def kv_stats(self, active: Iterable[int] = ()) -> dict:
+        """Allocated-vs-live KV bytes for the slots in ``active`` —
+        the waste number ROADMAP item 1's paged attention will attack
+        (obs/memplane.py kv_occupancy, measured before the fix lands so
+        its win is provable).  ``allocated`` charges each busy slot its
+        full worst-case ``cache_len`` row (that IS what the contiguous
+        pool reserves); ``live`` counts only written positions.  Costs
+        one tiny pos-vector device read — call it at gauge cadence, it
+        rides the serving loop's existing per-step host sync."""
+        pool = int(self.cache["k"].nbytes) + int(self.cache["v"].nbytes)
+        per_pos = pool / float(self.num_slots * self.cache_len)
+        positions = np.asarray(self.cache["pos"]).reshape(-1)
+        if positions.shape[0] < self.num_slots:  # legacy scalar pos
+            positions = np.full(self.num_slots, int(positions[0] if
+                                                    positions.size else 0))
+        return memplane.kv_occupancy(
+            positions.tolist(), list(active), self.cache_len, per_pos,
+            pool_bytes=pool,
+        )
 
     # ---------------------------------------------------------- hot swap
 

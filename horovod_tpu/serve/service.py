@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..elastic.exceptions import HorovodShutdownError
 from ..obs import get_registry
 from ..obs import flightrec as obs_flightrec
+from ..obs import memplane
 from ..obs import progress as obs_progress
 from ..obs import trace as obs_trace
 from ..testing.faults import maybe_fail
@@ -433,6 +434,10 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
         admissions = sched.admit(step)
         for adm in admissions:
             t_a0 = time.time()
+            # Deterministic OOM chaos on the prefill-allocation path:
+            # admission is where a real fleet usually dies (a long
+            # prompt's prefill is the allocation spike).
+            memplane.alloc_guard("assign_slot", rank=ctx.rank)
             tok = engine.admit(adm.slot, adm.req.prompt, adm.resume)
             t_a1 = time.time()
             # A recycled slot must never inherit the previous tenant's
@@ -525,6 +530,7 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
         active = sorted(sched.active)
         if active:
             t_d0 = time.time()
+            memplane.alloc_guard("decode_step", rank=ctx.rank)
             toks = engine.step(active)
             t_d1 = time.time()
             step_ms = (t_d1 - t_d0) * 1000.0
@@ -620,6 +626,14 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                                active=len(active))
         reg.gauge("serve.queue_depth").set(sched.queue_depth)
         reg.gauge("serve.active_slots").set(sched.active_slots)
+        # KV occupancy: what the fixed-row pool reserves for the busy
+        # slots vs the positions they actually wrote — the waste paged
+        # attention (ROADMAP 1) will reclaim.  Rides the loop's
+        # existing per-step host sync (one tiny pos read).
+        kv = engine.kv_stats(sched.active)
+        reg.gauge("serve.kv.allocated_bytes").set(kv["allocated_bytes"])
+        reg.gauge("serve.kv.live_bytes").set(kv["live_bytes"])
+        reg.gauge("serve.kv.waste_ratio").set(kv["waste_ratio"])
         # Sliding wall-clock window, fed the SAME timestamps the
         # decode-compute spans carry: the digest and the trace report
         # cannot disagree about throughput.
@@ -648,6 +662,13 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 out["weight_version"] = swap.version
             if profiler is not None:
                 out["perf"] = profiler.summary()
+            # The rank's memory story rides the drain summary so a
+            # `bench.py --serve` record embeds a WORKER-side breakdown
+            # (census + per-program compiled bytes + the pool the KV
+            # slots pin), not just the launcher's empty view.
+            mem = memplane.memory_record()
+            mem["kv_pool_bytes"] = engine.kv_stats(())["pool_bytes"]
+            out["memory"] = mem
             return out
         if not active and not admissions and not sdoc["new"] and is_leader:
             # Idle pacing: peers are paced by the schedule fetch; the
@@ -700,6 +721,11 @@ def serve_worker(spec: Optional[dict] = None):
         flops, jax.devices()[0].device_kind,
         source="cost_analysis" if flops else "unavailable",
     )
+    # Memory plane: the engine registered its owner tags (kv_cache,
+    # params) at construction; arming the census collector here makes
+    # every live-stream snapshot carry mem.* gauges — the serving
+    # fleet's HBM story streams to /metrics alongside its latencies.
+    memplane.install_census()
     # Weight hot-swap rider (spec["weights_dir"]): versions survive
     # epoch re-formation on this object; version 0 is the seed-derived
     # init params every rank built identically above.

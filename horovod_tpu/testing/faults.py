@@ -22,7 +22,8 @@ Grammar::
   before the user function runs), ``shard_write`` (ckpt/sharded.py
   per-rank shard write), ``replica_push`` (ckpt/replica.py peer-replica
   push after each commit), ``trace_flush`` (obs/trace.py span-dump
-  path).
+  path), ``mem_alloc`` (obs/memplane.py alloc_guard on the serve
+  decode/prefill paths).
 * ``rank`` — only fire on this rank (resolved from the ``rank=`` call
   argument, else ``HVDTPU_RANK``, else ``HVDTPU_ELASTIC_RANK``).  Absent
   means any rank.
@@ -66,8 +67,13 @@ Grammar::
   ``scale_fail`` instructs the launcher's autoscale grow path (point
   ``scale_admit``) to treat the standby host as refusing admission —
   the deterministic failed-grow input the exponential-backoff policy
-  is chaos-tested against.  ``worker_exit``/``task_fn`` points default
-  to ``exit``.
+  is chaos-tested against; ``oom`` instructs an allocation-heavy call
+  site (point ``mem_alloc``, consumed through
+  ``obs.memplane.alloc_guard``) to raise a backend-shaped
+  RESOURCE_EXHAUSTED — the deterministic out-of-device-memory input
+  the OOM black box (``mem.oom`` flight-recorder event + post-mortem
+  memory verdict) is chaos-tested against.  ``worker_exit``/``task_fn``
+  points default to ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
 * ``name`` — only fire when the call site passes a matching ``name=``
@@ -96,6 +102,7 @@ _ADVISORY_POINTS = {
     "trace_drop": ("trace_flush",),
     "swap_abort": ("swap_commit",),
     "scale_fail": ("scale_admit",),
+    "oom": ("mem_alloc",),
 }
 
 
@@ -179,7 +186,7 @@ def parse_spec(raw: str) -> List[FaultSpec]:
                 if value not in ("raise", "exit", "abort", "hang", "delay",
                                  "corrupt_write", "drop_replica",
                                  "trace_drop", "swap_abort",
-                                 "scale_fail"):
+                                 "scale_fail", "oom"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -271,9 +278,9 @@ def maybe_fail(
 
     Returns the fired action name for the *advisory* actions the call
     site must apply itself (``corrupt_write``, ``drop_replica``,
-    ``trace_drop``, ``swap_abort``, ``scale_fail``) and ``None``
-    otherwise — existing callers that ignore the return value keep
-    their exact semantics.
+    ``trace_drop``, ``swap_abort``, ``scale_fail``, ``oom``) and
+    ``None`` otherwise — existing callers that ignore the return value
+    keep their exact semantics.
     """
     specs = _load().get(point)
     counter = None
@@ -306,7 +313,7 @@ def maybe_fail(
             detail=f"{spec.action}:{spec.describe()}",
         )
         if spec.action in ("corrupt_write", "drop_replica", "trace_drop",
-                           "swap_abort", "scale_fail"):
+                           "swap_abort", "scale_fail", "oom"):
             # Advisory actions: the call site owns the I/O, so the
             # registry can only instruct it — corrupt the payload it is
             # about to write, or skip the push entirely.

@@ -1,0 +1,631 @@
+"""Memory plane tests (ISSUE 14): owner-tagged census, version-tolerant
+compiled accounting, KV occupancy math, the OOM black box, and the
+ZeRO-1 budget assertion.
+
+Fast paths run in tier-1; anything that compiles a model or spawns
+processes is ``slow`` (tier-1's 870s budget is at the line) and runs
+from the CI mem gate by node id.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu.elastic as elastic
+from horovod_tpu.obs import flightrec, memplane, postmortem
+from horovod_tpu.obs.registry import MetricsRegistry
+from horovod_tpu.testing import faults
+from horovod_tpu.utils import env as envmod
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    memplane.reset_owners()
+    memplane.reset_programs()
+    memplane.reset_census()
+    faults.reset()
+    yield
+    memplane.reset_owners()
+    memplane.reset_programs()
+    memplane.reset_census()
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# version-tolerant memory_analysis parse
+# ---------------------------------------------------------------------------
+
+
+def test_parse_memory_analysis_attribute_object_form():
+    compiled = jax.jit(lambda x: x * 2 + 1).lower(
+        jnp.ones((8, 8), jnp.float32)
+    ).compile()
+    st = memplane.parse_memory_analysis(compiled)
+    assert st["source"] == "memory_analysis"
+    assert st["argument_bytes"] == 256
+    assert st["output_bytes"] == 256
+    assert st["total_bytes"] == (
+        st["argument_bytes"] + st["output_bytes"] + st["temp_bytes"]
+        - st["alias_bytes"]
+    )
+
+
+class _Fake:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def memory_analysis(self):
+        if isinstance(self._ma, Exception):
+            raise self._ma
+        return self._ma
+
+
+def test_parse_memory_analysis_dict_form():
+    st = memplane.parse_memory_analysis(_Fake(
+        {"argument_size_in_bytes": 40, "temp_size_in_bytes": 2,
+         "alias_size_in_bytes": 8}
+    ))
+    assert st["source"] == "memory_analysis"
+    assert st["argument_bytes"] == 40 and st["temp_bytes"] == 2
+    assert st["total_bytes"] == 40 + 0 + 2 - 8
+
+
+def test_parse_memory_analysis_list_form():
+    st = memplane.parse_memory_analysis(_Fake(
+        [{"argument_size_in_bytes": 4, "output_size_in_bytes": 4}]
+    ))
+    assert st["source"] == "memory_analysis"
+    assert st["total_bytes"] == 8
+
+
+def test_parse_memory_analysis_absent_and_broken_degrade():
+    # no memory_analysis attribute at all
+    assert memplane.parse_memory_analysis(object()) == {
+        "source": "unavailable"
+    }
+    # raising analysis
+    assert memplane.parse_memory_analysis(
+        _Fake(RuntimeError("not implemented"))
+    )["source"] == "unavailable"
+    # empty list / None / field-free dict
+    assert memplane.parse_memory_analysis(_Fake([]))["source"] \
+        == "unavailable"
+    assert memplane.parse_memory_analysis(_Fake(None))["source"] \
+        == "unavailable"
+    assert memplane.parse_memory_analysis(_Fake({}))["source"] \
+        == "unavailable"
+
+
+def test_register_program_publishes_tagged_gauges():
+    reg = MetricsRegistry()
+    st = memplane.register_program(
+        "prog_a", _Fake({"argument_size_in_bytes": 100,
+                         "temp_size_in_bytes": 20}), registry=reg)
+    assert st["source"] == "memory_analysis"
+    assert memplane.program_report()["prog_a"]["total_bytes"] == 120
+    names = {(m["name"], tuple(sorted((m.get("tags") or {}).items())))
+             for m in reg.snapshot()}
+    assert ("mem.compiled.argument_bytes", (("program", "prog_a"),)) \
+        in names
+    assert ("mem.compiled.total_bytes", (("program", "prog_a"),)) in names
+    # unavailable source registers the report but publishes no gauges
+    reg2 = MetricsRegistry()
+    st2 = memplane.register_program("prog_b", object(), registry=reg2)
+    assert st2 == {"source": "unavailable"}
+    assert memplane.program_report()["prog_b"]["source"] == "unavailable"
+    assert not [m for m in reg2.snapshot()
+                if m["name"].startswith("mem.compiled.")]
+
+
+# ---------------------------------------------------------------------------
+# owner-tagged census
+# ---------------------------------------------------------------------------
+
+
+def test_census_buckets_owners_and_other():
+    a = jnp.ones((1024,), jnp.float32)          # 4096 B
+    b = {"k": jnp.ones((256,), jnp.float32)}    # 1024 B
+    memplane.register_owner("params", lambda: {"w": a})
+    memplane.register_owner("kv_cache", lambda: b)
+    doc = memplane.census(publish=False)
+    assert doc["source"] == "live_arrays"
+    assert doc["owners"]["params"] == 4096
+    assert doc["owners"]["kv_cache"] == 1024
+    # every live byte is either claimed or other, never double-counted
+    assert doc["total_bytes"] >= 4096 + 1024 + doc["owners"]["other"] - 1
+    assert doc["owners"]["other"] == doc["total_bytes"] - 4096 - 1024
+    assert memplane.last_census()["owners"] == doc["owners"]
+    del a, b
+
+
+def test_census_first_owner_wins_no_double_count():
+    shared = jnp.ones((512,), jnp.float32)
+    memplane.register_owner("params", lambda: shared)
+    memplane.register_owner("kv_cache", lambda: shared)
+    doc = memplane.census(publish=False)
+    assert doc["owners"]["params"] == 2048
+    assert doc["owners"]["kv_cache"] == 0
+    del shared
+
+
+def test_census_prunes_dead_suppliers():
+    alive = jnp.ones((64,), jnp.float32)
+    memplane.register_owner("params", lambda: alive)
+    memplane.register_owner("kv_cache", lambda: None)  # dead engine ref
+    doc = memplane.census(publish=False)
+    assert doc["owners"]["kv_cache"] == 0
+    # the dead supplier was pruned: a second census never calls it again
+    with memplane._lock:
+        assert memplane._owners["kv_cache"] == []
+        assert len(memplane._owners["params"]) == 1
+    del alive
+
+
+def test_census_publishes_gauges_and_collector():
+    reg = MetricsRegistry()
+    a = jnp.ones((1024,), jnp.float32)
+    memplane.register_owner("params", lambda: a)
+    memplane.install_census(registry=reg)
+    metrics = {(m["name"], tuple(sorted((m.get("tags") or {}).items()))):
+               m for m in reg.snapshot()}  # snapshot runs the collector
+    assert metrics[("mem.owner_bytes", (("owner", "params"),))]["value"] \
+        == 4096
+    assert metrics[("mem.live_bytes", ())]["value"] >= 4096
+    # CPU has no backend memory stats: the hbm gauges must be ABSENT,
+    # not zero (docs promise None-tolerance, not invented HBM)
+    assert ("mem.hbm_bytes_in_use", ()) not in metrics
+    del a
+
+
+def test_census_explicit_other_owner_accumulates():
+    # free-form registration under the canonical "other" name must ADD
+    # to the unclaimed remainder, not be overwritten by it
+    a = jnp.ones((256,), jnp.float32)
+    memplane.register_owner("other", lambda: a)
+    doc = memplane.census(publish=False)
+    assert doc["owners"]["other"] >= 1024
+    assert sum(doc["owners"].values()) == doc["total_bytes"]
+    del a
+
+
+def test_env_knob_arms_census_at_worker_init(monkeypatch):
+    # HVDTPU_MEM_CENSUS=1 must arm the collector through the same
+    # worker-init hook both launch modes call (obs/stream.py)
+    calls = []
+    monkeypatch.setattr(memplane, "install_census",
+                        lambda **kw: calls.append(1))
+    monkeypatch.setenv(memplane.CENSUS_ENV, "1")
+    from horovod_tpu.obs import stream
+
+    stream.maybe_start_from_env()
+    assert calls, "maybe_start_from_env did not arm the census"
+    assert memplane.accounting_armed()
+
+
+def test_dominant_owner():
+    assert memplane.dominant_owner({"owners": {}}) == (None, 0.0)
+    owner, share = memplane.dominant_owner(
+        {"owners": {"kv_cache": 820, "params": 100, "other": 80}}
+    )
+    assert owner == "kv_cache" and abs(share - 0.82) < 1e-9
+
+
+def test_device_memory_stats_none_tolerant_on_cpu():
+    # the container runs CPU: no device reports, source says so
+    assert memplane.device_memory_stats()["source"] == "unavailable"
+
+
+# ---------------------------------------------------------------------------
+# KV occupancy math
+# ---------------------------------------------------------------------------
+
+
+def test_kv_occupancy_hand_computed_states():
+    # slots 0 and 2 busy: pos 5 and 3 of a 64-row cache, 10 B/position
+    kv = memplane.kv_occupancy([5, 0, 3, 64], [0, 2], 64, 10.0,
+                               pool_bytes=2560)
+    assert kv["slots_in_use"] == 2
+    assert kv["allocated_bytes"] == 2 * 64 * 10
+    assert kv["live_bytes"] == (5 + 3) * 10
+    assert abs(kv["waste_ratio"] - (1 - 80 / 1280)) < 1e-12
+    assert kv["pool_bytes"] == 2560
+
+
+def test_kv_occupancy_idle_full_and_clamped():
+    # idle pool: zero allocated, zero waste (not a division crash)
+    idle = memplane.kv_occupancy([0, 0], [], 16, 4.0)
+    assert idle["allocated_bytes"] == 0 and idle["waste_ratio"] == 0.0
+    # a full slot wastes nothing
+    full = memplane.kv_occupancy([16], [0], 16, 4.0)
+    assert full["waste_ratio"] == 0.0
+    # a slot whose pos ran past the cache end clamps to the row
+    over = memplane.kv_occupancy([99], [0], 16, 4.0)
+    assert over["live_bytes"] == 16 * 4
+    # duplicate slot ids count once
+    dup = memplane.kv_occupancy([8, 8], [0, 0, 0], 16, 1.0)
+    assert dup["slots_in_use"] == 1 and dup["allocated_bytes"] == 16
+
+
+@pytest.mark.slow
+def test_slot_engine_kv_stats_match_hand_computed():
+    from horovod_tpu.models.transformer import gpt
+    from horovod_tpu.serve.engine import SlotEngine
+
+    overrides = dict(num_layers=1, num_heads=2, emb_dim=32, max_len=64,
+                     vocab_size=64, dtype=jnp.float32,
+                     attention_impl="reference")
+    model = gpt("nano", **overrides)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))
+    eng = SlotEngine(model.cfg, params, num_slots=4)
+    eng.admit(0, [1, 2, 3, 4, 5])
+    eng.admit(2, [7, 8, 9])
+    eng.step([0, 2])
+    pool = int(eng.cache["k"].nbytes) + int(eng.cache["v"].nbytes)
+    per_pos = pool / (4 * eng.cache_len)
+    pos = np.asarray(eng.cache["pos"])
+    kv = eng.kv_stats([0, 2])
+    assert kv["pool_bytes"] == pool
+    assert kv["allocated_bytes"] == int(2 * eng.cache_len * per_pos)
+    assert kv["live_bytes"] == int((int(pos[0]) + int(pos[2])) * per_pos)
+    expected_waste = 1 - kv["live_bytes"] / kv["allocated_bytes"]
+    assert abs(kv["waste_ratio"] - expected_waste) < 1e-9
+    # the compile sites registered their artifacts
+    rep = memplane.program_report()
+    assert "serve.assign_b8" in rep
+    eng.step_flops()
+    assert "serve.decode_step" in memplane.program_report()
+    # the census sees the engine's owner tags
+    doc = memplane.census(publish=False)
+    assert doc["owners"]["kv_cache"] >= pool
+    assert doc["owners"]["params"] > 0
+
+
+# ---------------------------------------------------------------------------
+# OOM black box
+# ---------------------------------------------------------------------------
+
+
+def test_fault_oom_restricted_to_mem_alloc_point():
+    specs = faults.parse_spec("mem_alloc:rank=1:action=oom")
+    assert specs[0].action == "oom" and specs[0].point == "mem_alloc"
+    with pytest.raises(ValueError, match="only implemented at"):
+        faults.parse_spec("ckpt_write:action=oom")
+    with pytest.raises(ValueError, match="only implemented at"):
+        faults.parse_spec("enqueue:action=oom")
+
+
+def test_alloc_guard_raises_backend_shaped(monkeypatch):
+    monkeypatch.setenv(faults.SPEC_ENV, "mem_alloc:action=oom")
+    faults.reset()
+    with pytest.raises(Exception) as ei:
+        memplane.alloc_guard("decode_step")
+    assert memplane.is_resource_exhausted(ei.value)
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    assert "decode_step" in str(ei.value)
+    # one-shot by default: the next visit proceeds
+    memplane.alloc_guard("decode_step")
+
+
+def test_alloc_guard_noop_without_spec(monkeypatch):
+    monkeypatch.delenv(faults.SPEC_ENV, raising=False)
+    faults.reset()
+    memplane.alloc_guard("decode_step")  # must not raise
+
+
+def test_maybe_record_oom_detects_and_records():
+    flightrec.reset_recorder()
+    kv = jnp.ones((4096,), jnp.float32)
+    memplane.register_owner("kv_cache", lambda: kv)
+    memplane.census(publish=False)
+    assert not memplane.maybe_record_oom(ValueError("boom"))
+    err = memplane.resource_exhausted_error("Out of memory 1.2G")
+    assert memplane.maybe_record_oom(err, where="decode_step")
+    evs = [e for e in flightrec.get_recorder().snapshot()
+           if e["kind"] == "mem.oom"]
+    assert evs, "no mem.oom event recorded"
+    detail = evs[-1]["detail"]
+    assert "where=decode_step" in detail
+    assert "owner=" in detail and "share=" in detail
+    del kv
+
+
+def test_record_exception_hook_drops_oom_event():
+    flightrec.reset_recorder()
+    a = jnp.ones((2048,), jnp.float32)
+    memplane.register_owner("params", lambda: a)
+    flightrec.record_exception(
+        memplane.resource_exhausted_error("Out of memory"),
+        where="excepthook",
+    )
+    kinds = [e["kind"] for e in flightrec.get_recorder().snapshot()]
+    assert "exception" in kinds and "mem.oom" in kinds
+    del a
+
+
+# ---------------------------------------------------------------------------
+# digest / summary formatting
+# ---------------------------------------------------------------------------
+
+
+def _fake_view(metrics, rank=0, epoch=0):
+    from horovod_tpu.obs.live import LiveAggregator
+
+    agg = LiveAggregator()
+    agg.ingest({
+        "rank": rank, "epoch": epoch, "seq": 0, "t": time.time(),
+        # the stream wire form (obs/stream.py _compact): n/k/g/v
+        "metrics": [
+            {"n": n, "k": "g", **({"g": t} if t else {}), "v": v}
+            for n, t, v in metrics
+        ],
+    })
+    return agg
+
+
+def test_digest_mem_token_hbm_and_kv():
+    agg = _fake_view([
+        ("mem.hbm_bytes_in_use", {}, 11.2 * 2 ** 30),
+        ("mem.hbm_limit_bytes", {}, 16.0 * 2 ** 30),
+        ("serve.kv.allocated_bytes", {}, 1000.0),
+        ("serve.kv.live_bytes", {}, 380.0),
+        ("serve.kv.waste_ratio", {}, 0.62),
+    ])
+    digest = agg.digest(expected_ranks=1)
+    assert "mem 11.2/16.0G" in digest
+    assert "kv 38% waste 62%" in digest
+
+
+def test_digest_mem_token_census_fallback_on_cpu():
+    agg = _fake_view([("mem.live_bytes", {}, 1.25 * 2 ** 30)])
+    assert "mem 1.25G live" in agg.digest(expected_ranks=1)
+
+
+def test_digest_mem_token_absent_without_memory_plane():
+    agg = _fake_view([("serve.queue_depth", {}, 3.0)])
+    assert "mem " not in agg.digest(expected_ranks=1)
+
+
+def _dump_doc(metrics, rank=0):
+    return {
+        "schema": "hvdtpu-metrics-v1", "rank": rank,
+        "metrics": [
+            {"name": n, "type": "gauge", "tags": t, "value": v}
+            for n, t, v in metrics
+        ],
+    }
+
+
+def test_summary_mem_section_rows_and_programs():
+    from horovod_tpu.obs import summary as obs_summary
+
+    dumps = {
+        "0": _dump_doc([
+            ("mem.live_bytes", {}, 512 * 2 ** 20),
+            ("mem.owner_bytes", {"owner": "params"}, 300 * 2 ** 20),
+            ("mem.owner_bytes", {"owner": "kv_cache"}, 100 * 2 ** 20),
+            ("serve.kv.allocated_bytes", {}, 100 * 2 ** 20),
+            ("serve.kv.live_bytes", {}, 38 * 2 ** 20),
+            ("serve.kv.waste_ratio", {}, 0.62),
+            ("mem.compiled.total_bytes",
+             {"program": "serve.decode_step"}, 4 * 2 ** 20),
+            ("mem.compiled.argument_bytes",
+             {"program": "serve.decode_step"}, 3 * 2 ** 20),
+        ], rank=0),
+    }
+    section = obs_summary.mem_section(dumps)
+    assert section is not None
+    assert "rank 0: live 512.0MiB" in section
+    assert "no backend memory stats" in section
+    assert "params=75%" in section and "kv_cache=25%" in section
+    assert "waste 62%" in section
+    assert "program serve.decode_step: total 4.0MiB" in section
+    # a job that never armed the plane prints nothing
+    assert obs_summary.mem_section(
+        {"0": _dump_doc([("serve.queue_depth", {}, 1.0)])}
+    ) is None
+
+
+def test_summary_mem_section_hbm_row():
+    from horovod_tpu.obs import summary as obs_summary
+
+    dumps = {"1": _dump_doc([
+        ("mem.hbm_bytes_in_use", {}, 11.2 * 2 ** 30),
+        ("mem.hbm_limit_bytes", {}, 16.0 * 2 ** 30),
+        ("mem.hbm_peak_bytes", {}, 12.5 * 2 ** 30),
+    ], rank=1)}
+    section = obs_summary.mem_section(dumps)
+    assert "rank 1: hbm 11.2GiB/16.0GiB (peak 12.5GiB)" in section
+
+
+# ---------------------------------------------------------------------------
+# postmortem memory verdict
+# ---------------------------------------------------------------------------
+
+
+def _flightrec_dump(tmp_path, rank, events, trigger="atexit",
+                    last_exception=None):
+    doc = {
+        "schema": flightrec.SCHEMA, "rank": rank, "pid": 1000 + rank,
+        "wall_time": time.time() + rank, "trigger": trigger, "epoch": 0,
+        "capacity": 64, "recorded": len(events), "overwritten": 0,
+        "last_exception": last_exception,
+        "events": [
+            {"seq": i, "t": time.time(), "kind": k, "name": n,
+             "cycle": -1, "detail": d}
+            for i, (k, n, d) in enumerate(events)
+        ],
+    }
+    path = tmp_path / f"flightrec.rank{rank}.json"
+    path.write_text(json.dumps(doc))
+    return doc
+
+
+def test_postmortem_memory_section_and_verdict(tmp_path):
+    _flightrec_dump(
+        tmp_path, 1,
+        [("enqueue", "g0", ""),
+         ("complete", "g0", ""),
+         ("mem.oom", "decode_step",
+          "where=decode_step owner=kv_cache share=0.82 "
+          "owner_bytes=880803840 total_bytes=1073741824 "
+          "in_use=16106127360 limit=17179869184"),
+         ("exception", "XlaRuntimeError", "RESOURCE_EXHAUSTED: ...")],
+        trigger="exception",
+        last_exception={"type": "XlaRuntimeError",
+                        "message": "RESOURCE_EXHAUSTED", "where": "",
+                        "traceback": ""},
+    )
+    _flightrec_dump(tmp_path, 0,
+                    [("enqueue", "g0", ""), ("complete", "g0", "")])
+    report = postmortem.analyze(postmortem.load_dumps(str(tmp_path)),
+                                expected_ranks=2)
+    assert report["first_failure"]["rank"] == 1
+    mem = report["memory"]
+    assert mem["1"]["owner"] == "kv_cache"
+    assert mem["1"]["where"] == "decode_step"
+    assert abs(mem["1"]["share"] - 0.82) < 1e-9
+    v = postmortem.verdict(report)
+    assert "OUT OF DEVICE MEMORY" in v
+    assert "rank 1 died allocating in 'decode_step'" in v
+    assert "kv_cache held 82%" in v
+    assert "15.00GB in use of 16.00GB" in v
+
+
+def test_postmortem_without_oom_has_no_memory_paragraph(tmp_path):
+    _flightrec_dump(tmp_path, 0, [("complete", "g0", "")])
+    report = postmortem.analyze(postmortem.load_dumps(str(tmp_path)))
+    assert report["memory"] == {}
+    assert "OUT OF DEVICE MEMORY" not in postmortem.verdict(report)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 budget math on the 8-device mesh (the mem gate's own measure)
+# ---------------------------------------------------------------------------
+
+
+def _load_mem_gate():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                        "mem_gate.py")
+    spec = importlib.util.spec_from_file_location("mem_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_zero1_budget_math_on_8_device_mesh():
+    """The acceptance claim: optimizer-state bytes per device under
+    bucket+zero1 <= (1/world + eps) of bucket mode, measured from the
+    compiled programs' input buffers on the tier-1 8-device mesh."""
+    gate = _load_mem_gate()
+    assert len(jax.devices()) == gate.WORLD
+    measured = gate.measure()
+    z = measured["zero1"]
+    assert z["bucket_opt_bytes"] > 0
+    ratio = z["zero1_opt_bytes"] / z["bucket_opt_bytes"]
+    assert ratio <= 1.0 / gate.WORLD + gate.ZERO1_EPS, ratio
+    # and the breakdowns came off the artifact, not a guess
+    for prog in ("overlap_bucket", "overlap_zero1"):
+        assert measured["programs"][prog]["source"] == "memory_analysis"
+    # the ZeRO argument bytes shrink roughly with the shard: the
+    # sharded step's donated inputs are 1/world-sized
+    assert measured["programs"]["overlap_zero1"]["argument_bytes"] \
+        < measured["programs"]["overlap_bucket"]["argument_bytes"]
+
+
+def test_mem_gate_check_flags_violation_and_passes_budget():
+    gate = _load_mem_gate()
+    measured = {
+        "programs": {"engine_allreduce": {
+            "source": "memory_analysis", "argument_bytes": 10,
+            "temp_bytes": 0, "output_bytes": 0, "alias_bytes": 0,
+            "generated_code_bytes": 0, "total_bytes": 10,
+        }},
+        "zero1": {"world": 8, "bucket_opt_bytes": 800,
+                  "zero1_opt_bytes": 100},
+    }
+    budget = {"programs": {"engine_allreduce": {"total_bytes_max": 20}},
+              "zero1": {"max_opt_ratio": 0.155}}
+    assert gate.check(measured, budget) == 0
+    measured["programs"]["engine_allreduce"]["total_bytes"] = 21
+    assert gate.check(measured, budget) == 1
+    # zero1 violation counts too
+    measured["programs"]["engine_allreduce"]["total_bytes"] = 10
+    measured["zero1"]["zero1_opt_bytes"] = 200
+    assert gate.check(measured, budget) == 1
+
+
+# ---------------------------------------------------------------------------
+# 2-proc OOM chaos acceptance
+# ---------------------------------------------------------------------------
+
+
+def _oom_train():
+    """Worker whose rank-1 third step dies of an injected backend-shaped
+    RESOURCE_EXHAUSTED on the mem_alloc point, with a kv_cache-dominant
+    tagged footprint — the OOM black box must name both."""
+    import jax.numpy as jnp  # noqa: PLC0415
+    import numpy as np  # noqa: PLC0415
+
+    import horovod_tpu.elastic as elastic  # noqa: PLC0415
+    from horovod_tpu.obs import memplane  # noqa: PLC0415
+
+    ctx = elastic.context()
+    kv = jnp.ones((4 << 20,), jnp.float32)      # 16 MiB: dominates
+    params = jnp.ones((1 << 16,), jnp.float32)  # 256 KiB
+    memplane.register_owner("kv_cache", lambda: kv)
+    memplane.register_owner("params", lambda: params)
+    memplane.census(publish=False)
+    state = elastic.State(w=np.zeros(2, dtype=np.float64), step=0)
+
+    @elastic.run
+    def loop(state):
+        while state.step < 6:
+            memplane.alloc_guard("decode_step", rank=ctx.rank)
+            state.w = state.w + ctx.allreduce(
+                np.ones(2), name=f"g{state.step}")
+            state.step += 1
+            state.commit()
+        return state.step
+
+    return loop(state)
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_oom_chaos_postmortem_names_rank_and_owner(tmp_path):
+    """ISSUE 14 acceptance: a seeded ``mem_alloc:action=oom`` on rank 1
+    leaves a mem.oom event in its black box and a postmortem whose
+    verdict names the OOM rank AND its dominant memory owner."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "HVDTPU_FAULT_SPEC": "mem_alloc:step=3:rank=1:action=oom",
+        envmod.FLIGHTREC_DUMP: str(tmp_path),
+    }
+    with pytest.raises(RuntimeError):
+        elastic.launch(_oom_train, np=2, env=env, max_retries=0,
+                       timeout=120)
+    report = json.load(open(tmp_path / "postmortem.json"))
+    assert report["schema"] == postmortem.REPORT_SCHEMA
+    assert report["first_failure"]["rank"] == 1
+    assert report["first_failure"]["exception"] in (
+        "XlaRuntimeError", "ResourceExhaustedError")
+    mem = report["memory"]
+    assert "1" in mem and "0" not in mem, mem
+    assert mem["1"]["owner"] == "kv_cache"
+    # the allocation SITE's name, not the generic death-path hook's
+    assert mem["1"]["where"] == "decode_step", mem
+    assert mem["1"]["share"] and mem["1"]["share"] > 0.5
+    v = report["verdict"]
+    assert "OUT OF DEVICE MEMORY" in v
+    assert "rank 1 died allocating in 'decode_step'" in v
+    assert "kv_cache" in v
