@@ -637,44 +637,82 @@ def _arm_watchdog(args) -> None:
     threading.Thread(target=_fire, daemon=True).start()
 
 
-def _serve_bench(args) -> int:
-    """``--serve``: open-loop serving benchmark through the
-    continuous-batching plane (horovod_tpu/serve/).
-
-    A deterministic Poisson arrival process (seeded exponential gaps at
-    ``--serve-rate`` req/s) submits ``--serve-requests`` mixed-length
-    prompts AT SCHEDULE — open-loop, so queueing under load is measured
-    instead of hidden by back-pressure — while a fine-grained poller
-    stamps each request's first token and completion on the client
-    clock.  The record lands ttft/tpot percentiles and end-to-end
-    tokens/sec; on CPU it is a degraded trajectory placeholder like
-    every other CPU bench number (write_degraded_record via
-    _auto_record)."""
+def _run_serve_load(args, np_: int, width: int, on_cpu: bool) -> dict:
+    """One fleet under one open-loop workload: launch ``np_`` serving
+    ranks (``width`` >= 1 turns on the width-sharded fleet — np_//width
+    independent serving groups, each rank's paged decode shard_mapped
+    over ``width`` local devices), submit the deterministic mixed-
+    length request schedule, and measure ttft/tpot/tokens-per-sec on
+    the client clock.  Returns the raw measurement dict the record (or
+    the scaling comparison) embeds."""
     import threading
 
     from horovod_tpu.serve import ServeJob
 
-    _touch_progress(next_window=max(args.watchdog_secs, 300),
-                    phase="serve")
-    on_cpu = args.cpu or jax.devices()[0].platform == "cpu"
     overrides = dict(
         num_layers=2, num_heads=4, emb_dim=64, max_len=256,
         vocab_size=512, attention_impl="reference", dtype=jnp.float32,
     )
     spec = {"size": "nano", "overrides": overrides, "seed": 0,
-            "num_slots": args.serve_slots, "idle_secs": 0.005}
+            "num_slots": args.serve_slots, "idle_secs": 0.005,
+            # Stream batching at 8: the first token still publishes
+            # immediately (ttft is real), but steady-state streaming
+            # costs half the signed puts — on a CPU fleet the store
+            # roundtrips are a measurable slice of the step.
+            "stream_every": 8,
+            "kv_mode": args.serve_kv_mode,
+            "page_size": args.serve_page_size,
+            "width": width}
+    if args.serve_kv_pages:
+        spec["kv_pages"] = args.serve_kv_pages
+    env = {"JAX_PLATFORMS": "cpu"} if on_cpu else {}
+    if on_cpu:
+        # Single-threaded eigen per worker: the serving model is tiny,
+        # so the default all-cores threadpool buys nothing per process
+        # and makes concurrent fleet members thrash each other —
+        # exactly what a scaling comparison must not measure.  Width
+        # shards additionally need `width` local devices (faked the
+        # same way the test harness does).
+        flags = ["--xla_cpu_multi_thread_eigen=false"]
+        if width > 1:
+            flags.append(
+                f"--xla_force_host_platform_device_count={width}"
+            )
+        env["XLA_FLAGS"] = " ".join(flags)
     n_req = args.serve_requests
+    # Mixed-length workload, identical across fleets (and across the
+    # two legs of a --serve-scaling comparison): prompt lengths span
+    # 2-6 KV pages at the default page size, so the paged pool's
+    # partial-last-page waste is measured on realistic traffic, not on
+    # single-page stubs.
     rng = np.random.RandomState(42)
     gaps = rng.exponential(1.0 / args.serve_rate, n_req)
-    prompts = [rng.randint(0, 512, rng.randint(4, 13)).tolist()
+    prompts = [rng.randint(0, 512, rng.randint(16, 49)).tolist()
                for _ in range(n_req)]
-    budgets = [int(rng.randint(4, 13)) for _ in range(n_req)]
+    budgets = [int(rng.randint(16, 33)) for _ in range(n_req)]
 
     job = ServeJob(
-        spec, np=args.serve_np,
-        env={"JAX_PLATFORMS": "cpu"} if on_cpu else None,
+        spec, np=np_, env=env or None,
         timeout=max(_budget_left(args) - 60, 120),
     ).start()
+    # Warmup OUTSIDE the measured window: one request per prompt-length
+    # bucket the workload will hit (16/32/64) drives every rank through
+    # its decode-step + per-bucket assign compiles.  Without this the
+    # measurement is compile-dominated and a fleet comparison measures
+    # XLA, not serving.  A width-sharded fleet partitions the log
+    # round-robin across its groups, so each bucket is submitted
+    # ``groups`` consecutive times — consecutive log indices land one
+    # on every group, whatever the group count — or a group would pay
+    # its first bucket-b compile mid-measurement (~500ms observed, a
+    # third of the whole window).
+    groups = max(np_ // width, 1) if width else 1
+    warm = []
+    for warm_len in (10, 20, 40):
+        for _ in range(groups):
+            warm.append(job.client.submit([7] * warm_len,
+                                          max_new_tokens=9))
+    for rid in warm:
+        job.client.result(rid, timeout=max(_budget_left(args) - 60, 120))
     submit_t: dict = {}
     rids: list = []
 
@@ -693,6 +731,7 @@ def _serve_bench(args) -> int:
     try:
         sub = threading.Thread(target=_submitter, daemon=True)
         t_start = time.perf_counter()
+        t_start_wall = time.time()
         sub.start()
         first_t: dict = {}
         done: dict = {}
@@ -713,11 +752,44 @@ def _serve_bench(args) -> int:
                     first_t[rid] = time.perf_counter()
                 if doc.get("done"):
                     done[rid] = (time.perf_counter(),
-                                 len(doc.get("tokens", [])))
-            time.sleep(0.003)
+                                 len(doc.get("tokens", [])),
+                                 doc.get("t_done"))
+            # A full sweep already costs ~0.5ms of server time per
+            # pending rid; sweeping again immediately would make the
+            # measuring client the store's biggest tenant and depress
+            # exactly the number being measured.
+            time.sleep(0.01)
         sub.join(timeout=10)
-        t_end = max(t for t, _ in done.values())
-        total_tokens = sum(n for _, n in done.values())
+        total_tokens = sum(n for _, n, _ in done.values())
+        # Throughput from SERVER-side completion stamps (the leaders'
+        # eviction wall clocks) against the client's submit wall clock
+        # — one host in this harness, so the clocks agree.  The
+        # client's own polling cadence would otherwise be the largest
+        # term in a fleet comparison (poll-granularity error per
+        # request exceeded the per-step decode time).
+        server_ends = [t for _, _, t in done.values() if t]
+        if server_ends:
+            elapsed = max(server_ends) - t_start_wall
+        else:  # pre-t_done servers: fall back to the client clock
+            elapsed = max(t for t, _, _ in done.values()) - t_start
+        # SUSTAINED rate: tokens completed in the p20->p80 completion
+        # window over that window's duration — the steady-state number
+        # with the ramp (first admissions/prefills) and the drain tail
+        # (last <slots requests trickling out) excluded.  Makespan
+        # throughput stays the headline `value`; the scaling ratio is
+        # judged on sustained (both fleets fully busy), which is what
+        # "sustains N tokens/sec" means.
+        sustained = None
+        if len(server_ends) >= 10:
+            ends = sorted(
+                (t, n) for t, n in
+                ((t, n) for _, n, t in done.values() if t)
+            )
+            lo = ends[int(len(ends) * 0.2)][0]
+            hi = ends[int(len(ends) * 0.8)][0]
+            mid_tokens = sum(n for t, n in ends if lo < t <= hi)
+            if hi > lo:
+                sustained = mid_tokens / (hi - lo)
         ttft = [
             (first_t[r] - submit_t[r]) * 1000.0
             for r in rids if r in first_t
@@ -733,29 +805,133 @@ def _serve_bench(args) -> int:
     def pct(xs, q):
         return round(float(np.percentile(xs, q)), 2) if xs else None
 
-    throughput = total_tokens / max(t_end - t_start, 1e-9)
-    out = {
-        "metric": "serve_nano_tokens_per_sec",
-        "value": round(throughput, 2),
-        "unit": "tokens/sec",
-        "device": jax.devices()[0].device_kind,
-        "serve": {
-            "np": args.serve_np,
-            "slots": args.serve_slots,
-            "requests": n_req,
-            "arrival_rate_per_sec": args.serve_rate,
-            "total_tokens": total_tokens,
-            "ttft_ms": {"p50": pct(ttft, 50), "p90": pct(ttft, 90),
-                        "p99": pct(ttft, 99)},
-            "tpot_ms": {"p50": pct(tpot, 50), "p90": pct(tpot, 90),
-                        "p99": pct(tpot, 99)},
-        },
+    throughput = total_tokens / max(elapsed, 1e-9)
+    meas = {
+        "np": np_,
+        "width": width,
+        "groups": max(np_ // width, 1) if width else 1,
+        "slots": args.serve_slots,
+        "requests": n_req,
+        "arrival_rate_per_sec": args.serve_rate,
+        "total_tokens": total_tokens,
+        "tokens_per_sec": round(throughput, 2),
+        "sustained_tokens_per_sec": (round(sustained, 2)
+                                     if sustained else None),
+        "ttft_ms": {"p50": pct(ttft, 50), "p90": pct(ttft, 90),
+                    "p99": pct(ttft, 99)},
+        "tpot_ms": {"p50": pct(tpot, 50), "p90": pct(tpot, 90),
+                    "p99": pct(tpot, 99)},
     }
     ranks = sorted(results or {})
+    meas["_results"] = results or {}
     if ranks:
-        out["serve"]["completed_per_rank"] = {
+        meas["completed_per_rank"] = {
             str(r): results[r]["completed"] for r in ranks
         }
+        # Continuous batching actually happened: admissions that entered
+        # while other slots were mid-decode (max across ranks — the
+        # counts are identical by the schedule invariant).
+        meas["admitted_while_busy"] = max(
+            results[r].get("admitted_while_busy", 0) for r in ranks
+        )
+        # KV-occupancy verdict (worst rank): the paged pool's measured
+        # waste and, recomputed on the SAME traffic, what the PR-10
+        # contiguous reservation would have wasted (the PR-14 baseline).
+        kvs = [results[r]["kv"] for r in ranks if results[r].get("kv")]
+        if kvs:
+            meas["kv"] = {
+                "mode": kvs[0].get("mode"),
+                "waste_ratio_mean": round(max(
+                    k.get("waste_ratio_mean", 0.0) for k in kvs), 4),
+                "contiguous_equiv_waste_mean": round(max(
+                    k.get("contiguous_equiv_waste_mean", 0.0)
+                    for k in kvs), 4),
+                "page_size": kvs[0].get("page_size"),
+                "num_pages": kvs[0].get("num_pages"),
+                "pool_bytes": kvs[0].get("pool_bytes"),
+            }
+    return meas
+
+
+def _serve_bench(args) -> int:
+    """``--serve``: open-loop serving benchmark through the
+    continuous-batching plane (horovod_tpu/serve/).
+
+    A deterministic Poisson arrival process (seeded exponential gaps at
+    ``--serve-rate`` req/s) submits ``--serve-requests`` mixed-length
+    prompts AT SCHEDULE — open-loop, so queueing under load is measured
+    instead of hidden by back-pressure — while a fine-grained poller
+    stamps each request's first token and completion on the client
+    clock.  The record lands ttft/tpot percentiles, end-to-end
+    tokens/sec, and the paged pool's KV-waste verdict against the
+    contiguous-equivalent baseline; ``--serve-scaling`` additionally
+    runs the SAME workload at np=w and np=2w (w = --serve-width or 1)
+    and embeds the fleet-scaling ratio — the width-sharded fleet's "np
+    multiplies tokens/sec" claim measured, not asserted.  On CPU it is
+    a degraded trajectory placeholder like every other CPU bench
+    number (write_degraded_record via _auto_record)."""
+    _touch_progress(next_window=max(args.watchdog_secs, 300),
+                    phase="serve")
+    on_cpu = args.cpu or jax.devices()[0].platform == "cpu"
+    width = int(args.serve_width or 0)
+    if args.serve_scaling:
+        w = max(width, 1)
+        attempts = max(int(args.serve_scaling_attempts), 1)
+        # Best-of-N per leg: this host's scheduler sometimes lands two
+        # hot worker threads on SMT siblings and the whole run (both
+        # groups alike) decodes at half speed — a bimodal environment
+        # artifact, observed on single-fleet runs too.  Best-of is the
+        # standard mitigation and is labeled in the record.
+        def _rate(m):
+            return m["sustained_tokens_per_sec"] or m["tokens_per_sec"]
+
+        base = max((_run_serve_load(args, w, w, on_cpu)
+                    for _ in range(attempts)), key=_rate)
+        doubled = max((_run_serve_load(args, 2 * w, w, on_cpu)
+                       for _ in range(attempts)), key=_rate)
+        ratio = _rate(doubled) / max(_rate(base), 1e-9)
+        # The basis must describe what was ACTUALLY divided: a leg with
+        # too few server-side completion stamps falls back to makespan
+        # throughput, and a mislabeled record would judge the >=1.7x
+        # claim on a basis it misdescribes.
+        both_sustained = (base["sustained_tokens_per_sec"] is not None
+                          and doubled["sustained_tokens_per_sec"]
+                          is not None)
+        basis = ("sustained (p20-p80 completion window)"
+                 if both_sustained else "makespan tokens_per_sec")
+        main, results = doubled, doubled.pop("_results")
+        base.pop("_results", None)
+        scaling = {
+            "np_w": {k: v for k, v in base.items()
+                     if k != "completed_per_rank"},
+            "np_2w": {k: v for k, v in doubled.items()
+                      if k != "completed_per_rank"},
+            "tokens_per_sec_ratio": round(ratio, 3),
+            "ratio_basis": basis,
+            "best_of": attempts,
+            # Honest provenance: on the CPU mesh each rank simulates
+            # its whole device set, so the ratio is structural evidence
+            # of the fleet partition (independent groups over the log),
+            # not a hardware throughput claim.
+            "provenance": ("cpu-mesh structural evidence"
+                           if on_cpu else "device measurement"),
+        }
+    else:
+        main = _run_serve_load(args, args.serve_np, width, on_cpu)
+        results = main.pop("_results")
+        scaling = None
+
+    out = {
+        "metric": "serve_nano_tokens_per_sec",
+        "value": main["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "device": jax.devices()[0].device_kind,
+        "serve": {k: v for k, v in main.items()},
+    }
+    if scaling is not None:
+        out["serve"]["scaling"] = scaling
+    ranks = sorted(results or {})
+    if ranks:
         # Decode-step MFU from the serving ranks' own cost_analysis()
         # accounting (estimate-flagged on CPU) — the leader's view; the
         # numbers are near-identical across ranks by the identical-
@@ -765,17 +941,10 @@ def _serve_bench(args) -> int:
             out["perf"] = perf
         # Worker-side memory breakdown (obs/memplane.py): census +
         # per-program compiled bytes + the KV pool's resident
-        # footprint — replicated fleet, so rank 0's view stands in
-        # for all.
+        # footprint — rank 0's view stands in for all.
         mem = results[ranks[0]].get("memory")
         if mem:
             out["memory"] = mem
-        # Continuous batching actually happened: admissions that entered
-        # while other slots were mid-decode (max across ranks — the
-        # counts are identical by the schedule invariant).
-        out["serve"]["admitted_while_busy"] = max(
-            results[r].get("admitted_while_busy", 0) for r in ranks
-        )
     if on_cpu:
         out["degraded"] = True
         _auto_record("cpu fallback: numbers not comparable to TPU "
@@ -1022,6 +1191,27 @@ def main() -> int:
     parser.add_argument("--serve-rate", type=float, default=4.0,
                         help="mean arrival rate, requests/sec "
                              "(seeded exponential gaps)")
+    parser.add_argument("--serve-width", type=int, default=0,
+                        help="width-sharded fleet (0 = replicated): "
+                             "np//width serving groups, each rank's "
+                             "paged decode shard_mapped over width "
+                             "devices")
+    parser.add_argument("--serve-kv-mode", default="paged",
+                        choices=["paged", "contiguous"],
+                        help="KV layout (paged = block tables; "
+                             "contiguous = PR-10 worst-case rows)")
+    parser.add_argument("--serve-page-size", type=int, default=8,
+                        help="KV page size in token rows (paged mode)")
+    parser.add_argument("--serve-kv-pages", type=int, default=0,
+                        help="KV page-pool size (0 = worst case)")
+    parser.add_argument("--serve-scaling", action="store_true",
+                        help="run the same workload at np=w and np=2w "
+                             "(w = --serve-width or 1) and embed the "
+                             "fleet-scaling tokens/sec ratio")
+    parser.add_argument("--serve-scaling-attempts", type=int, default=2,
+                        help="best-of-N runs per scaling leg (host-"
+                             "scheduler noise mitigation; labeled in "
+                             "the record)")
     parser.add_argument("--attempts", type=int, default=4,
                         help="retries (fresh process) on tunnel UNAVAILABLE")
     parser.add_argument("--watchdog-secs", type=int, default=780,

@@ -317,12 +317,15 @@ def sanitizer_axes(node: ast.Call,
 
 # Built-in contract surface: the serve scheduler module is documented as
 # a pure state machine ("every rank derives the identical schedule" —
-# serve/scheduler.py docstring, the serving HVD001 invariant), and the
-# trace sampler's verdict must be a pure function of the trace id
-# (obs/trace.py, the PR-11 determinism contract).  "*" = every function
-# in the module.
+# serve/scheduler.py docstring, the serving HVD001 invariant), the page
+# allocator's block tables feed the compiled decode step on every rank
+# (serve/paged.py — a divergent table desyncs the decode math itself),
+# and the trace sampler's verdict must be a pure function of the trace
+# id (obs/trace.py, the PR-11 determinism contract).  "*" = every
+# function in the module.
 CONTRACT_REGISTRY: Dict[str, Set[str]] = {
     "horovod_tpu/serve/scheduler.py": {"*"},
+    "horovod_tpu/serve/paged.py": {"*"},
     "horovod_tpu/obs/trace.py": {"sampled"},
 }
 

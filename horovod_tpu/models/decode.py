@@ -61,6 +61,9 @@ __all__ = [
     "generate",
     "reset_slot",
     "assign_slot",
+    "init_paged_pool",
+    "decode_step_paged",
+    "assign_slot_paged",
 ]
 
 
@@ -115,11 +118,15 @@ def _attend_cached(cfg, q, k_cache, v_cache, pos):
     Positions beyond each slot's own ``pos`` are masked; with
     ``cfg.attention_window`` the band's lower edge is masked too (parity
     with the flash kernel's sliding window); GQA queries fold onto their
-    kv group via reshape, no K/V broadcast."""
+    kv group via reshape, no K/V broadcast.  The kv-head count is read
+    off the CACHE shape, not the config, so a width-sharded caller
+    (heads split over a mesh axis) reuses this math bitwise on its
+    shard."""
     b, h, hd = q.shape
     s = k_cache.shape[1]
-    group = h // cfg.kv_heads
-    qg = q.reshape(b, cfg.kv_heads, group, hd).astype(jnp.float32)
+    hkv = k_cache.shape[2]
+    group = h // hkv
+    qg = q.reshape(b, hkv, group, hd).astype(jnp.float32)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     st = jnp.einsum("bkgd,bskd->bkgs", qg, kf) * (hd ** -0.5)
@@ -139,11 +146,14 @@ def _attend_prefix(cfg, q, k_cache, v_cache):
     ``q [b, s, h, hd]``, ``k/v_cache [b, S, hkv, hd]`` -> ``[b, s, h,
     hd]``.  Query position ``t`` sees exactly the mask the scanned path
     applies at ``pos == t`` (future positions min-filled, window lower
-    edge too), so the two prefills softmax over identical score rows."""
+    edge too), so the two prefills softmax over identical score rows.
+    kv-head count comes from the cache shape (width-shard-reusable,
+    like :func:`_attend_cached`)."""
     b, s, h, hd = q.shape
     big = k_cache.shape[1]
-    group = h // cfg.kv_heads
-    qg = q.reshape(b, s, cfg.kv_heads, group, hd).astype(jnp.float32)
+    hkv = k_cache.shape[2]
+    group = h // hkv
+    qg = q.reshape(b, s, hkv, group, hd).astype(jnp.float32)
     kf = k_cache.astype(jnp.float32)
     vf = v_cache.astype(jnp.float32)
     st = jnp.einsum("btkgd,bskd->btkgs", qg, kf) * (hd ** -0.5)
@@ -370,6 +380,229 @@ def assign_slot(cfg: TransformerConfig, params, cache, slot, tokens,
     k = lax.dynamic_update_slice(cache["k"], one["k"], (0, slot, 0, 0, 0))
     v = lax.dynamic_update_slice(cache["v"], one["v"], (0, slot, 0, 0, 0))
     pos = cache["pos"].at[slot].set(length)
+    last = jnp.take(logits[0], length - 1, axis=0)
+    return {"k": k, "v": v, "pos": pos}, last
+
+
+def init_paged_pool(cfg: TransformerConfig, num_pages: int,
+                    page_size: int, num_slots: int,
+                    kv_heads: Optional[int] = None):
+    """Paged KV pool: per-layer K/V in fixed-size PAGES (``page_size``
+    token rows each) shared by every slot, plus per-slot write
+    positions.  A slot's cache is whatever pages its block table
+    (serve/paged.py) names, so resident KV bytes scale with tokens
+    actually written instead of ``slots x max_len`` — the vLLM block-
+    table idea on top of :func:`decode_step`'s masked-write machinery.
+
+    ``kv_heads`` overrides the per-pool head count for width-sharded
+    pools (each device of the width axis holds only ITS heads' pages).
+    """
+    if cfg.moe_experts > 0:
+        raise ValueError("decode cache supports dense blocks only")
+    hkv = kv_heads if kv_heads is not None else cfg.kv_heads
+    kv = (cfg.num_layers, num_pages, page_size, hkv, cfg.head_dim)
+    return {
+        "k": jnp.zeros(kv, cfg.dtype),
+        "v": jnp.zeros(kv, cfg.dtype),
+        "pos": jnp.zeros((num_slots,), jnp.int32),
+    }
+
+
+def decode_step_paged(cfg: TransformerConfig, params, pool, tables,
+                      tokens_t, write_mask=None, *, tp_axis=None,
+                      rep=None):
+    """One decode step through the BLOCK TABLE: ``tokens_t [b]`` ->
+    ``(logits [b, vocab], pool)`` where each slot's K/V append lands in
+    page ``tables[slot, pos // page_size]`` at row ``pos % page_size``,
+    and attention gathers the slot's pages back into its virtually
+    contiguous prefix — logical position ``t`` maps to gathered index
+    ``t`` exactly, so the math (and the tokens) are BITWISE the
+    contiguous :func:`decode_step`'s whenever the virtual length
+    matches (pinned by tests/test_paged.py).
+
+    ``tables [b, max_pages]`` int32: page ids into the pool; entries
+    past a slot's allocated prefix carry ``num_pages`` (the null page)
+    — out of bounds, so scatter-``drop`` discards writes there and the
+    gather zero-fills (masked by ``pos`` regardless).  Decoding past
+    the virtual capacity drops the write and NaN-poisons that slot's
+    logits, the same loud-failure contract as the contiguous path.
+
+    ``tp_axis``/``rep``: width sharding (Megatron TP inside the
+    serving fleet).  When set, ``params`` is this shard's block tree
+    and ``rep`` the replicated tree (both from
+    ``tensor_parallel.stack_tp_params``), the pool holds only this
+    shard's ``kv_heads // width`` heads' pages, and each block rejoins
+    through the two row-parallel psums over ``tp_axis`` — call inside
+    ``shard_map`` (serve/engine.py does).
+    """
+    if tp_axis is None:
+        p = _params(params)
+        rep = p
+        tp = 1
+    else:
+        from ..ops.collectives import axis_size  # noqa: PLC0415
+
+        p = params
+        tp = axis_size(tp_axis)
+    b = tokens_t.shape[0]
+    pos = _slot_pos(pool, b)
+    num_pages, ps = pool["k"].shape[1], pool["k"].shape[2]
+    mp = tables.shape[1]
+    virt = mp * ps
+
+    # Per-slot embedding scaffold — same math as decode_step (the
+    # bitwise pin between the two paths is what catches drift).
+    x = jnp.take(
+        rep["wte"]["embedding"], tokens_t[:, None], axis=0
+    ).astype(cfg.dtype)
+    if cfg.pos_embedding == "learned":
+        pe = jnp.take(rep["wpe"], pos, axis=0,
+                      mode="fill", fill_value=jnp.nan)
+        x = x + pe.astype(cfg.dtype)[:, None]
+    rope_tabs = None
+    if cfg.pos_embedding == "rope":
+        from ..ops.rope import rope_tables  # noqa: PLC0415
+
+        rope_tabs = rope_tables(pos, cfg.head_dim, cfg.rope_theta)
+
+    # Write coordinates: page id of each slot's next position (null
+    # page for frozen rows and overruns -> scatter drops them).
+    page_of = jnp.take_along_axis(
+        tables, jnp.minimum(pos // ps, mp - 1)[:, None], axis=1
+    )[:, 0]
+    in_range = pos < virt
+    if write_mask is None:
+        advance = jnp.ones((b,), jnp.int32)
+        w_page = jnp.where(in_range, page_of, num_pages)
+    else:
+        advance = write_mask.astype(jnp.int32)
+        w_page = jnp.where(write_mask & in_range, page_of, num_pages)
+    w_off = pos % ps
+
+    k_new, v_new = pool["k"], pool["v"]
+    for i in range(cfg.num_layers):
+
+        def attend(q, k_t, v_t, _i=i):
+            # q [b, 1, nh, hd]; k_t/v_t [b, 1, nkv, hd].  RoPE per-row
+            # here (block_math got rope_tabs=None), append into the
+            # slot's current page, then gather the block table back
+            # into the virtually contiguous [b, virt, nkv, hd] prefix.
+            nonlocal k_new, v_new
+            if rope_tabs is not None:
+                q = _rope_rows(q, *rope_tabs)
+                k_t = _rope_rows(k_t, *rope_tabs)
+            k_new = k_new.at[_i, w_page, w_off].set(
+                k_t[:, 0].astype(cfg.dtype), mode="drop"
+            )
+            v_new = v_new.at[_i, w_page, w_off].set(
+                v_t[:, 0].astype(cfg.dtype), mode="drop"
+            )
+            kc = jnp.take(k_new[_i], tables, axis=0,
+                          mode="fill", fill_value=0)
+            vc = jnp.take(v_new[_i], tables, axis=0,
+                          mode="fill", fill_value=0)
+            kc = kc.reshape(b, virt, kc.shape[-2], kc.shape[-1])
+            vc = vc.reshape(b, virt, vc.shape[-2], vc.shape[-1])
+            att = _attend_cached(cfg, q[:, 0], kc, vc, pos)
+            return att[:, None]
+
+        if tp_axis is None:
+            x = raw_block_forward(cfg, p[f"block{i}"], x, pos[:, None],
+                                  None, attend=attend)
+        else:
+            from ..parallel.tensor_parallel import _tp_block  # noqa: PLC0415
+
+            x = _tp_block(cfg, p[f"block{i}"], rep[f"block{i}"], x,
+                          pos[:, None], None, tp_axis, tp,
+                          attend=attend)
+
+    from ..parallel.tensor_parallel import _gpt_head  # noqa: PLC0415
+
+    logits = _gpt_head(rep, cfg, x)[:, 0]
+    overrun = pos >= virt
+    if write_mask is not None:
+        overrun = overrun & write_mask
+    logits = jnp.where(overrun[:, None], jnp.nan, logits)
+    return logits, {"k": k_new, "v": v_new, "pos": pos + advance}
+
+
+def _prefill_shard(cfg, p, rep, tokens, lengths, tp_axis):
+    """Width-sharded single-forward prefill: :func:`prefill`'s math on
+    this shard's heads — the mini-cache holds ``kv_heads // width``
+    heads, blocks rejoin through the row-parallel psums.  Returns
+    ``(logits [b, s, vocab], {"k", "v", "pos"})`` like prefill."""
+    from ..parallel.tensor_parallel import (  # noqa: PLC0415
+        _gpt_embed, _gpt_head, _tp_block,
+    )
+
+    from ..ops.collectives import axis_size  # noqa: PLC0415
+
+    tp = axis_size(tp_axis)
+    nkv = cfg.kv_heads // tp
+    b, s = tokens.shape
+    x, positions, rope_tabs = _gpt_embed(rep, cfg, tokens, 0,
+                                         jnp.arange(s))
+    k_new = jnp.zeros((cfg.num_layers, b, s, nkv, cfg.head_dim),
+                      cfg.dtype)
+    v_new = jnp.zeros_like(k_new)
+    for i in range(cfg.num_layers):
+
+        def attend(q, k_t, v_t, _i=i):
+            nonlocal k_new, v_new
+            k_new = lax.dynamic_update_slice(
+                k_new, k_t.astype(cfg.dtype)[None], (_i, 0, 0, 0, 0)
+            )
+            v_new = lax.dynamic_update_slice(
+                v_new, v_t.astype(cfg.dtype)[None], (_i, 0, 0, 0, 0)
+            )
+            return _attend_prefix(cfg, q, k_new[_i], v_new[_i])
+
+        x = _tp_block(cfg, p[f"block{i}"], rep[f"block{i}"], x,
+                      positions, rope_tabs, tp_axis, tp, attend=attend)
+
+    logits = _gpt_head(rep, cfg, x)
+    pos = jnp.asarray(lengths, jnp.int32)
+    return logits, {"k": k_new, "v": v_new, "pos": pos}
+
+
+def assign_slot_paged(cfg: TransformerConfig, params, pool, tables,
+                      slot, tokens, length=None, *, tp_axis=None,
+                      rep=None):
+    """Admit ONE request into the paged pool: prefill the prompt into a
+    contiguous mini-cache (the exact :func:`prefill` math, so the
+    contiguous bitwise pins carry over), then scatter its rows into the
+    slot's pages.  Positions past the slot's allocated prefix hit the
+    null page and are dropped; every other slot's pages are bitwise
+    untouched.  Returns ``(pool, last_logits [vocab])``.
+
+    ``tp_axis``/``rep``: width-sharded admission — the mini-cache and
+    the pool both hold only this shard's heads (see
+    :func:`decode_step_paged`).
+    """
+    s = tokens.shape[0]
+    ps = pool["k"].shape[2]
+    mp = tables.shape[1]
+    if s > mp * ps:
+        raise ValueError(
+            f"assign_slot_paged: {s} prompt tokens exceed the "
+            f"{mp * ps}-row virtual slot capacity"
+        )
+    if length is None:
+        length = s
+    length = jnp.asarray(length, jnp.int32)
+    if tp_axis is None:
+        logits, one = prefill(cfg, params, tokens[None], max_len=s,
+                              lengths=length[None])
+    else:
+        logits, one = _prefill_shard(cfg, params, rep, tokens[None],
+                                     length[None], tp_axis)
+    pidx = jnp.arange(s)
+    row = jnp.take(tables, slot, axis=0)
+    pages = jnp.take(row, pidx // ps)
+    offs = pidx % ps
+    k = pool["k"].at[:, pages, offs].set(one["k"][:, 0], mode="drop")
+    v = pool["v"].at[:, pages, offs].set(one["v"][:, 0], mode="drop")
+    pos = pool["pos"].at[slot].set(length)
     last = jnp.take(logits[0], length - 1, axis=0)
     return {"k": k, "v": v, "pos": pos}, last
 

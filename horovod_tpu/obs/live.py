@@ -373,9 +373,20 @@ class LiveAggregator:
         (max) per-rank queue/latency: the digest exists to surface the
         pressure, not to average it away."""
         depth = slots = None
-        tps = 0.0
         ttft = None
+        pages_free = pages_used = None
+        # tokens/sec: groups of a width-sharded fleet are INDEPENDENT
+        # capacity — sum the per-group rates (max within a group: its
+        # replicated peers report the same stream).  Ranks without a
+        # serve.group gauge (legacy replicated fleet) all fold into
+        # one bucket, preserving the old max semantics.
+        tps_by_group: dict = {}
         for view in views.values():
+            group_id = None
+            for m in view.metrics.values():
+                if m.get("name") == "serve.group":
+                    group_id = m.get("value")
+                    break
             for m in view.metrics.values():
                 name = m.get("name")
                 if name == "serve.queue_depth":
@@ -385,17 +396,33 @@ class LiveAggregator:
                     v = float(m["value"])
                     slots = v if slots is None else max(slots, v)
                 elif name == "serve.tokens_per_sec":
-                    tps = max(tps, float(m["value"]))
+                    v = float(m["value"])
+                    tps_by_group[group_id] = max(
+                        tps_by_group.get(group_id, 0.0), v)
                 elif name == "serve.ttft_ms" and m.get("count"):
                     p50 = m.get("p50")
                     if p50 is not None:
                         ttft = p50 if ttft is None else max(ttft, p50)
+                elif name == "serve.kv.page_free":
+                    v = float(m["value"])
+                    # Tightest (min-free) rank: page pressure is what
+                    # gates admission, so surface the worst of it.
+                    pages_free = v if pages_free is None \
+                        else min(pages_free, v)
+                elif name == "serve.kv.page_used":
+                    v = float(m["value"])
+                    pages_used = v if pages_used is None \
+                        else max(pages_used, v)
         if depth is None and slots is None:
             return None
+        tps = sum(tps_by_group.values())
         token = (f"serve q={int(depth or 0)} "
                  f"slots={int(slots or 0)} {tps:.0f} tok/s")
         if ttft is not None:
             token += f" ttft p50 {ttft:.0f}ms"
+        if pages_free is not None or pages_used is not None:
+            token += (f" pages {int(pages_used or 0)}u/"
+                      f"{int(pages_free or 0)}f")
         return token
 
     def _autoscale_part(self, views) -> Optional[str]:

@@ -239,7 +239,9 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
             if name in ("serve.admitted", "serve.evicted",
                         "serve.rejected", "serve.replayed",
                         "serve.steps", "serve.tokens_per_sec",
-                        "serve.admitted_while_busy"):
+                        "serve.admitted_while_busy",
+                        "serve.kv.waste_ratio", "serve.kv.page_size",
+                        "serve.kv.page_free", "serve.kv.page_used"):
                 vals[name] = float(m["value"])
             elif name in ("serve.ttft_ms", "serve.tpot_ms") \
                     and m.get("count"):
@@ -266,6 +268,18 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
                 row += (
                     f", {short} p50 {m.get('p50') or 0:.3g}ms "
                     f"p99 {m.get('p99') or 0:.3g}ms"
+                )
+        if "serve.kv.page_size" in vals:
+            # Paged-pool line (absent on contiguous pools): what the
+            # admission gate saw at the final snapshot.
+            row += (
+                f", kv pages {int(vals.get('serve.kv.page_used', 0))}"
+                f"u/{int(vals.get('serve.kv.page_free', 0))}f"
+                f" x{int(vals['serve.kv.page_size'])}rows"
+            )
+            if "serve.kv.waste_ratio" in vals:
+                row += (
+                    f" waste {vals['serve.kv.waste_ratio']:.2f}"
                 )
         rows.append(row)
     return "\n".join(rows) if rows else None

@@ -233,12 +233,16 @@ def _gpt_head(rep, cfg, x):
     return logits.astype(jnp.float32)
 
 
-def _tp_block(cfg, p, rep, x, positions, rope_tabs, tp_axis, tp):
+def _tp_block(cfg, p, rep, x, positions, rope_tabs, tp_axis, tp,
+              attend=None):
     """One transformer block on this rank's head/width shard: the shared
     ``block_math`` wiring with column-parallel qkv/fc1 and row-parallel
     proj/fc2 closures — each row-parallel matmul rejoined by one psum,
     its bias applied once after (the bias lives on the replicated
-    tree)."""
+    tree).  ``attend`` overrides the attention schedule exactly as in
+    ``block_math`` — the width-sharded paged decode path
+    (models/decode.py) supplies one that appends to its per-shard KV
+    pages and attends its own heads."""
     from ..models.transformer import (  # noqa: PLC0415
         block_math, raw_dense, raw_layer_norm,
     )
@@ -268,6 +272,7 @@ def _tp_block(cfg, p, rep, x, positions, rope_tabs, tp_axis, tp):
         mlp=mlp,
         num_heads=cfg.num_heads // tp,
         num_kv_heads=cfg.kv_heads // tp,
+        attend=attend,
     )
 
 
@@ -282,7 +287,9 @@ def tp_gpt_apply(sharded_params, replicated_params, cfg, tokens,
     to the unsharded model's.  Use ``check_vma=True`` (replication
     tracking) when differentiating — see ``stack_tp_params``.
     """
-    tp = lax.axis_size(tp_axis)
+    from ..ops.collectives import axis_size  # noqa: PLC0415
+
+    tp = axis_size(tp_axis)
     p = jax.tree_util.tree_map(lambda a: a[0], sharded_params)
     rep = replicated_params
     x, positions, rope_tabs = _gpt_embed(rep, cfg, tokens, pos_offset,

@@ -91,6 +91,10 @@ _ARG_ENV_MAP = {
     "serve_slots": (envmod.SERVE_SLOTS, "serve.slots"),
     "serve_max_len": (envmod.SERVE_MAX_LEN, "serve.max-len"),
     "serve_seed": (envmod.SERVE_SEED, "serve.seed"),
+    "serve_kv_mode": (envmod.SERVE_KV_MODE, "serve.kv-mode"),
+    "serve_page_size": (envmod.SERVE_PAGE_SIZE, "serve.page-size"),
+    "serve_kv_pages": (envmod.SERVE_KV_PAGES, "serve.kv-pages"),
+    "serve_width": (envmod.SERVE_WIDTH, "serve.width"),
     "serve_weights_dir": (envmod.SERVE_WEIGHTS_DIR, "serve.weights-dir"),
     "serve_swap_poll_steps": (
         envmod.SERVE_SWAP_POLL_STEPS,

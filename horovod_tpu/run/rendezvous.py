@@ -30,7 +30,6 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.error import HTTPError, URLError
-from urllib.request import Request, urlopen
 
 SECRET_ENV = "HVDTPU_SECRET"
 _MAC_HEADER = "X-HVDTPU-MAC"
@@ -231,58 +230,104 @@ class KVStoreClient:
 
     404 means "not published yet" (wait() keeps polling); transport errors
     carry the address so misconfiguration fails loudly, not as a generic
-    timeout."""
+    timeout.
+
+    Connections are PERSISTENT (HTTP/1.1 keep-alive, one per calling
+    thread): the serving plane drives several KV operations per decode
+    step from every group leader, and a fresh TCP connect per call —
+    urllib's behavior — costs a connection handshake plus a server-side
+    handler-thread spawn each time, which was measured as the
+    throughput ceiling of a multi-group fleet (ISSUE 15's np-scaling
+    leg) long before the decode math saturated.  A stale or dropped
+    connection is re-dialed once per call; every verb here is
+    idempotent, so the single retry cannot double-apply anything."""
 
     def __init__(self, addr: str, secret: Optional[str] = None):
         self._base = f"http://{addr}"
         self._addr = addr
+        host, _, port = addr.rpartition(":")
+        self._host, self._port = host, int(port)
         self._secret = secret or os.environ.get(SECRET_ENV, "")
+        self._local = threading.local()
+
+    def _request(self, method: str, path: str, body: Optional[bytes],
+                 headers: dict):
+        """One request over the thread's persistent connection; a dead
+        connection (server restarted, keep-alive reaped, first use) is
+        re-dialed and the request retried ONCE.  Returns (status,
+        headers, body).  Connection-refused surfaces as URLError to
+        keep wait()'s startup-grace semantics."""
+        import http.client  # noqa: PLC0415
+
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        self._host or "127.0.0.1", self._port,
+                        timeout=30,
+                    )
+                    self._local.conn = conn
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, resp.headers, data
+            except (http.client.HTTPException, OSError) as e:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                self._local.conn = None
+                if attempt or isinstance(e, ConnectionRefusedError):
+                    raise URLError(e) from e
 
     def put(self, scope: str, key: str, value: bytes) -> None:
-        req = Request(
-            f"{self._base}/{scope}/{key}", data=value, method="PUT"
+        status, _, _ = self._request(
+            "PUT", f"/{scope}/{key}", value,
+            {_MAC_HEADER: _mac(self._secret, "PUT", f"{scope}/{key}",
+                               value),
+             "Content-Length": str(len(value))},
         )
-        req.add_header(_MAC_HEADER,
-                       _mac(self._secret, "PUT", f"{scope}/{key}", value))
-        try:
-            urlopen(req, timeout=30).read()
-        except HTTPError as e:
-            if e.code == 403:
-                raise PermissionError(
-                    f"KV store at {self._addr} rejected the payload signature"
-                ) from e
-            raise
+        if status == 403:
+            raise PermissionError(
+                f"KV store at {self._addr} rejected the payload signature"
+            )
+        if status != 200:
+            raise HTTPError(f"{self._base}/{scope}/{key}", status,
+                            "unexpected status", None, None)
 
     def delete(self, scope: str, key: str) -> None:
         """Authenticated delete; absent keys are a no-op (the replica
         tier garbage-collects superseded chunks with this)."""
-        req = Request(f"{self._base}/{scope}/{key}", method="DELETE")
-        req.add_header(_MAC_HEADER, _delete_mac(self._secret,
-                                                f"{scope}/{key}"))
-        try:
-            urlopen(req, timeout=30).read()
-        except HTTPError as e:
-            if e.code == 403:
-                raise PermissionError(
-                    f"KV store at {self._addr} rejected the delete "
-                    f"signature"
-                ) from e
-            raise
+        status, _, _ = self._request(
+            "DELETE", f"/{scope}/{key}", None,
+            {_MAC_HEADER: _delete_mac(self._secret, f"{scope}/{key}")},
+        )
+        if status == 403:
+            raise PermissionError(
+                f"KV store at {self._addr} rejected the delete "
+                f"signature"
+            )
+        if status != 200:
+            raise HTTPError(f"{self._base}/{scope}/{key}", status,
+                            "unexpected status", None, None)
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         """None = key not published yet; raises on transport failure."""
         try:
-            resp = urlopen(f"{self._base}/{scope}/{key}", timeout=30)
-        except HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+            status, headers, body = self._request(
+                "GET", f"/{scope}/{key}", None, {}
+            )
         except URLError as e:
             raise ConnectionError(
                 f"cannot reach KV store at {self._addr}: {e.reason}"
             ) from e
-        body = resp.read()
-        mac = resp.headers.get(_MAC_HEADER, "")
+        if status == 404:
+            return None
+        if status != 200:
+            raise HTTPError(f"{self._base}/{scope}/{key}", status,
+                            "unexpected status", None, None)
+        mac = headers.get(_MAC_HEADER, "")
         if not hmac.compare_digest(
             mac, _mac(self._secret, "GET", f"{scope}/{key}", body)
         ):

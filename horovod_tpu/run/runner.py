@@ -250,8 +250,49 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     serve.add_argument(
         "--serve-seed", type=int, action=_StoreOverrideAction,
         dest="serve_seed", default=None,
-        help="Params init seed — identical on every rank by "
-             "construction (HVDTPU_SERVE_SEED, default 0).",
+        help="Params init seed AND the per-request sampling root — "
+             "identical on every rank by construction "
+             "(HVDTPU_SERVE_SEED, default 0).  Sampled tokens are "
+             "keyed on (request id, emission index, this seed), so "
+             "the stream survives elastic replay bit-exactly.",
+    )
+    serve.add_argument(
+        "--serve-width", type=int, action=_StoreOverrideAction,
+        dest="serve_width", default=None,
+        help="Width-sharded serving fleet (HVDTPU_SERVE_WIDTH, default "
+             "0 = replicated standbys): the world splits into "
+             "np//width serving GROUPS, each independently serving its "
+             "partition of the request log — doubling np doubles "
+             "sustained tokens/sec instead of adding hot standbys — "
+             "and each rank's paged decode step is shard_mapped over "
+             "width devices of its (replica, width) mesh view "
+             "(Megatron tensor parallelism: per-shard KV pages hold "
+             "only that shard's heads).  Requires the paged KV mode.",
+    )
+    serve.add_argument(
+        "--serve-page-size", type=int, action=_StoreOverrideAction,
+        dest="serve_page_size", default=None,
+        help="KV page size in token rows (HVDTPU_SERVE_PAGE_SIZE, "
+             "default 16): paged KV allocates cache in pages as "
+             "positions actually advance, so memory tracks tokens "
+             "written, not slots x max-len worst case.",
+    )
+    serve.add_argument(
+        "--serve-kv-pages", type=int, action=_StoreOverrideAction,
+        dest="serve_kv_pages", default=None,
+        help="KV page-pool size (HVDTPU_SERVE_KV_PAGES; default: the "
+             "worst case, slots x pages-per-slot).  Admission capacity "
+             "is judged in free pages: a bounded pool admits MORE "
+             "short requests than the contiguous design's slot count "
+             "would, and rejects a request whose worst case can never "
+             "fit.",
+    )
+    serve.add_argument(
+        "--serve-kv-mode", action=_StoreOverrideAction,
+        dest="serve_kv_mode", default=None, choices=["paged", "contiguous"],
+        help="KV cache layout (HVDTPU_SERVE_KV_MODE, default paged); "
+             "contiguous keeps the PR-10 worst-case-row pool (the "
+             "PR-14 waste baseline) for A/B comparison.",
     )
     serve.add_argument(
         "--serve-weights-dir", action=_StoreOverrideAction,

@@ -15,6 +15,14 @@ out of the pieces the training stack already trusts:
 * :mod:`.service`   — the SPMD serving loop on the elastic launcher
   (dead ranks respawn and replay in-flight requests from the durable
   log; zero dropped requests) and the :class:`ServeJob` python driver.
+* :mod:`.paged`     — pure page allocator + per-slot block tables
+  (vLLM-style paged KV): allocated bytes track tokens written,
+  admission capacity is judged in free pages, and the allocator is a
+  rank-deterministic state machine like the scheduler (HVD012).
+* :mod:`.sampling`  — replicated per-request PRNG sampling: tokens
+  keyed purely on (request id, emission index, serve seed), so
+  sampled streams are identical on every rank and bit-exact across
+  elastic replay.
 * :mod:`.longctx`   — sequence-sharded slot caches for long-context
   requests (Ulysses all-to-all prefill, flash-merge decode).
 * :mod:`.autoscale` — load-driven grow/shrink of the serving world
@@ -39,6 +47,7 @@ from .autoscale import (  # noqa: F401
 from .engine import SlotEngine  # noqa: F401
 from .frontend import IngestPump, ServeClient, validate_request  # noqa: F401
 from .hotswap import SwapManager, publish_weights  # noqa: F401
+from .paged import PagedKV, page_reject_reason, pages_for  # noqa: F401
 from .scheduler import (  # noqa: F401
     ActiveSlot, Admission, Eviction, Request, SlotScheduler,
 )

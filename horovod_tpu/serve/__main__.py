@@ -36,7 +36,20 @@ def parse_spec(argv=None) -> dict:
     p.add_argument("--max-len", type=int, default=None,
                    help="slot cache length (default: the model's max_len)")
     p.add_argument("--seed", type=int, default=None,
-                   help="params init seed, identical on every rank")
+                   help="params init seed + sampling root, identical "
+                        "on every rank")
+    p.add_argument("--kv-mode", default=None,
+                   choices=["paged", "contiguous"],
+                   help="KV cache layout (default paged: block-table "
+                        "pages, admission judged in free pages)")
+    p.add_argument("--page-size", type=int, default=None,
+                   help="KV page size in token rows (default 16)")
+    p.add_argument("--kv-pages", type=int, default=None,
+                   help="KV page-pool size (default: worst case)")
+    p.add_argument("--width", type=int, default=None,
+                   help="width-sharded fleet: np//width serving groups, "
+                        "each rank's paged decode shard_mapped over "
+                        "width devices (default 0 = replicated)")
     p.add_argument("--attention", default="reference",
                    choices=["reference", "flash"],
                    help="attention implementation for the served model "
@@ -64,8 +77,16 @@ def parse_spec(argv=None) -> dict:
         "size": pick(args.size, envmod.SERVE_MODEL, str, "nano"),
         "num_slots": pick(args.slots, envmod.SERVE_SLOTS, int, 4),
         "seed": pick(args.seed, envmod.SERVE_SEED, int, 0),
+        "kv_mode": pick(args.kv_mode, envmod.SERVE_KV_MODE, str,
+                        "paged"),
+        "page_size": pick(args.page_size, envmod.SERVE_PAGE_SIZE, int,
+                          16),
+        "width": pick(args.width, envmod.SERVE_WIDTH, int, 0),
         "overrides": {"attention_impl": args.attention},
     }
+    kv_pages = pick(args.kv_pages, envmod.SERVE_KV_PAGES, int, 0)
+    if kv_pages:
+        spec["kv_pages"] = kv_pages
     max_len = pick(args.max_len, envmod.SERVE_MAX_LEN, int, 0)
     if max_len:
         spec["max_len"] = max_len

@@ -73,6 +73,12 @@ def validate_request(doc: dict, serve_len: int,
             f"prompt ({len(prompt)}) + max_new_tokens ({mnt}) exceeds "
             f"the {serve_len}-token serving context"
         )
+    temp = doc.get("temperature", 0.0)
+    if not isinstance(temp, (int, float)) or temp < 0:
+        return "temperature must be a number >= 0"
+    top_k = doc.get("top_k", 0)
+    if not isinstance(top_k, int) or top_k < 0:
+        return "top_k must be an int >= 0"
     return None
 
 
@@ -91,14 +97,24 @@ class ServeClient:
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
+               temperature: float = 0.0,
+               top_k: int = 0,
                rid: Optional[str] = None) -> str:
-        """Enqueue one generation request; returns its request id."""
+        """Enqueue one generation request; returns its request id.
+
+        ``temperature > 0`` samples instead of greedy argmax (``top_k``
+        truncates the candidate set); the stream is still deterministic
+        — tokens are keyed on (rid, emission index, serve seed), so a
+        resubmission with the SAME rid reproduces the same text and
+        elastic replay continues it bit-exactly (serve/sampling.py)."""
         rid = rid or uuid.uuid4().hex[:16]
         doc = {
             "rid": rid,
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
             "eos_id": None if eos_id is None else int(eos_id),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
             # Client-clock submit stamp: the trace waterfall's first
             # span (submit -> ingest) is measured against this; the
             # rid doubles as the request's trace id.

@@ -42,13 +42,20 @@ __all__ = ["Request", "ActiveSlot", "Admission", "Eviction",
 class Request:
     """One generation request.  ``arrival`` is informational (latency
     accounting) — scheduling NEVER reads it; order of arrival is fixed
-    by the ingest log's sequence numbers, not by clocks."""
+    by the ingest log's sequence numbers, not by clocks.
+
+    ``temperature``/``top_k`` select per-request sampling
+    (serve/sampling.py): pure DATA here — the scheduler never reads
+    them either; the engine keys the PRNG stream on (rid, emission
+    index, serve seed), so they stay rank-deterministic."""
 
     rid: str
     prompt: Tuple[int, ...]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     arrival: float = 0.0
+    temperature: float = 0.0
+    top_k: int = 0
 
     def __post_init__(self):
         if not self.prompt:
@@ -56,6 +63,10 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"request {self.rid!r}: max_new_tokens must be >= 1"
+            )
+        if self.temperature < 0:
+            raise ValueError(
+                f"request {self.rid!r}: temperature must be >= 0"
             )
 
 
@@ -146,16 +157,30 @@ class SlotScheduler:
         return [s for s in range(self.num_slots) if s not in self.active]
 
     # hvdtpu: deterministic
-    def admit(self, step: int = 0) -> List[Admission]:
+    def admit(self, step: int = 0, can_admit=None) -> List[Admission]:
         """Admit queued requests into free slots: FCFS, lowest slot
         first.  Mutates the schedule and returns the admissions in
         order.  ``step`` is recorded on the slot for observability
-        only — it never influences the decision."""
+        only — it never influences the decision.
+
+        ``can_admit(req, resume) -> bool`` is the CAPACITY gate (paged
+        KV: are there free pages for this request's worst case?).  FCFS
+        is strict: when the HEAD of the queue does not fit, admission
+        stops — skipping ahead would let a stream of small requests
+        starve a big one, and (worse) make the admit order depend on
+        capacity timing in a way that is harder to reason about across
+        elastic replays.  The gate MUST be a deterministic function of
+        the schedule so far (the engine's page accounting is), or ranks
+        diverge — the HVD001 invariant extends through this callback.
+        """
         out: List[Admission] = []
         for slot in self.free_slots():
             if not self.queue:
                 break
-            req, resume = self.queue.popleft()
+            req, resume = self.queue[0]
+            if can_admit is not None and not can_admit(req, resume):
+                break
+            self.queue.popleft()
             self.active[slot] = ActiveSlot(req=req, slot=slot,
                                            emitted=list(resume),
                                            admitted_step=step,

@@ -60,6 +60,7 @@ from ..testing.faults import maybe_fail
 from ..utils.logging import get_logger
 from .frontend import SCOPE, IngestPump, ServeClient, validate_request
 from .hotswap import VERSION_KEY, SwapManager
+from .paged import page_reject_reason
 from .scheduler import Request, SlotScheduler
 
 LOG = get_logger("serve")
@@ -124,9 +125,20 @@ _SCHED_KEEP = 256
 DEFAULT_SPEC: Dict[str, Any] = {
     "size": "nano",          # gpt(<size>) model family entry
     "overrides": {},         # TransformerConfig overrides
-    "seed": 0,               # params init seed (identical on every rank)
+    "seed": 0,               # params init seed AND the sampling root
+                             # (identical on every rank; serve/sampling.py)
     "num_slots": 4,
     "max_len": None,         # slot cache length (default cfg.max_len)
+    "kv_mode": "paged",      # paged KV (block tables) | "contiguous"
+    "page_size": 16,         # KV page size in token rows (paged mode)
+    "kv_pages": None,        # page-pool size (default: worst case)
+    "width": 0,              # 0 = replicated fleet (peers are hot
+                             # standbys, PR-10); >= 1 = width-sharded
+                             # fleet: the world splits into
+                             # size // width serving GROUPS, each
+                             # independently serving the log partition
+                             # n % groups == g — np multiplies
+                             # tokens/sec instead of adding standbys
     "idle_secs": 0.01,       # leader pacing when nothing is in flight
     "stream_every": 4,       # publish token streams every N tokens
     "weights_dir": None,     # weight hot-swap source (None = off)
@@ -136,6 +148,28 @@ DEFAULT_SPEC: Dict[str, Any] = {
 
 def _epoch_scope(epoch: int) -> str:
     return f"serve_e{epoch}"
+
+
+def _fleet_shape(world, rank, width: int):
+    """The width-sharded fleet layout, a pure function of the sorted
+    world and the spec: ``width == 0`` is the legacy replicated fleet
+    (one group, every rank a hot standby of the leader); ``width >= 1``
+    carves the world into ``size // width`` serving GROUPS of ``width``
+    ranks each (contiguous by world position — DCN carries the group
+    axis, ICI the width axis inside each rank's device mesh).  Each
+    group independently serves the ingest-log partition ``n % groups ==
+    group``; leftover ranks (world not divisible) idle as standbys and
+    become capacity at the next resize.  Returns ``(groups, group,
+    group_world, standby)`` with ``group=None`` for standbys."""
+    size = len(world)
+    idx = world.index(rank)
+    if width < 1:
+        return 1, 0, list(world), False
+    groups = max(size // width, 1)
+    if idx >= groups * width:
+        return groups, None, [], True
+    group = idx // width
+    return groups, group, list(world[group * width:(group + 1) * width]), False
 
 
 def _fetch(ctx, scope: str, key: str, what: str) -> bytes:
@@ -159,11 +193,15 @@ def _fetch(ctx, scope: str, key: str, what: str) -> bytes:
         time.sleep(0.005)
 
 
-def _build_recovery(kv) -> dict:
+def _build_recovery(kv, group: int = 0, groups: int = 1) -> dict:
     """Replay the durable request record: the ingest log from the
     finished watermark up, joined with each request's streamed tokens.
-    Only the leader runs this — peers adopt its published doc, so a log
-    entry racing in mid-scan can never split the world's view.
+    Only the (group) leader runs this — peers adopt its published doc,
+    so a log entry racing in mid-scan can never split the world's view.
+    In a width-sharded fleet each group's doc carries only ITS log
+    partition (``n % groups == group``); ``others`` maps the remaining
+    in-flight indices to their rids so group 0's leader (the global
+    leader) can advance the compaction watermark across groups.
 
     The watermark (``serve/log_watermark``) is the compaction floor the
     leader advances as requests finish: every entry below it is done
@@ -185,7 +223,9 @@ def _build_recovery(kv) -> dict:
         n += 1
     inflight = []
     done_ns: List[int] = []
+    others: Dict[int, str] = {}
     for idx, doc in enumerate(docs):
+        doc_n = int(doc.get("n", watermark + idx))
         out_raw = kv.get(SCOPE, f"out/{doc['rid']}")
         emitted: List[int] = []
         if out_raw is not None:
@@ -193,9 +233,14 @@ def _build_recovery(kv) -> dict:
             if out.get("done"):
                 # Finished (or rejected) before the break: only its
                 # compaction bookkeeping survives into the new epoch.
-                done_ns.append(int(doc.get("n", watermark + idx)))
+                done_ns.append(doc_n)
                 continue
             emitted = list(out.get("tokens", []))
+        if doc_n % groups != group:
+            # Another group's request: irrelevant to this group's
+            # schedule, but the global leader tracks it for compaction.
+            others[doc_n] = doc["rid"]
+            continue
         entry = dict(doc)
         entry["emitted"] = emitted
         inflight.append(entry)
@@ -203,14 +248,15 @@ def _build_recovery(kv) -> dict:
     version = int(raw.decode()) if raw is not None else 0
     return {"log_next": n, "inflight": inflight,
             "watermark": watermark, "done_ns": done_ns,
-            "weight_version": version}
+            "others": others, "weight_version": version}
 
 
 def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
                  admitted_step: int, error: Optional[str] = None,
                  finished_step: Optional[int] = None,
                  reason: Optional[str] = None,
-                 n: Optional[int] = None) -> None:
+                 n: Optional[int] = None,
+                 t_done: Optional[float] = None) -> None:
     doc = {
         "rid": rid,
         "tokens": list(tokens),
@@ -218,6 +264,12 @@ def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
         "epoch": epoch,
         "admitted_step": admitted_step,
     }
+    if t_done is not None:
+        # Leader-clock completion stamp: lets a measuring client
+        # compute throughput from server-side stamps instead of its
+        # own polling cadence (bench.py --serve; poll-granularity
+        # error was larger than the effects being measured).
+        doc["t_done"] = float(t_done)
     if error is not None:
         doc["error"] = error
     if finished_step is not None:
@@ -251,55 +303,105 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
     replayed request's waterfall shows both incarnations."""
     reg = get_registry()
     epoch = ctx.rendezvous()
-    leader = ctx.world[0]
+    width = int(spec.get("width") or 0)
+    groups, group, group_world, standby = _fleet_shape(
+        ctx.world, ctx.rank, width
+    )
+    reg.gauge("serve.world_size").set(ctx.size)
+    reg.gauge("serve.groups").set(groups)
+    if group is not None:
+        # This rank's serving group: the digest sums tokens/sec ACROSS
+        # groups (independent capacity) but takes the max WITHIN one
+        # (replicated peers report the same stream).
+        reg.gauge("serve.group").set(group)
+    if standby:
+        # World not divisible by the width: this rank is a hot standby
+        # until the next resize makes it part of a group.  It still
+        # heartbeats, ticks progress, and drains cleanly on stop.
+        LOG.info("epoch %d: rank %d standing by (world %d, width %d)",
+                 epoch, ctx.rank, ctx.size, width)
+        while True:
+            if ctx.world_changed():
+                raise HorovodShutdownError(
+                    f"epoch advanced past {epoch}; re-forming"
+                )
+            if ctx.kv.get(SCOPE, "stop") is not None:
+                return {"rank": ctx.rank, "epoch": epoch, "steps": 0,
+                        "standby": True,
+                        "completed": totals["completed"],
+                        "tokens": totals["tokens"]}
+            obs_progress.tick()
+            # A standby has nothing latency-sensitive to wake for:
+            # pace its stop/world probes gently so a parked rank does
+            # not tax the store the serving groups are using.
+            time.sleep(max(float(spec.get("idle_secs", 0.01)), 0.05))
+    leader = group_world[0]
     is_leader = ctx.rank == leader
+    # The GLOBAL leader (lowest live rank) owns the compaction
+    # watermark — the one piece of bookkeeping that must see every
+    # group's completions.
+    is_global = ctx.rank == ctx.world[0]
     scope = _epoch_scope(epoch)
     tracing = obs_trace.enabled()
     t_rate = obs_trace.sample_rate()
 
-    # Epoch-start recovery broadcast: the leader's replay of the durable
-    # request record IS the schedule seed — every rank (survivor or
-    # fresh respawn) rebuilds the identical scheduler state from it.
+    # Epoch-start recovery broadcast: the group leader's replay of the
+    # durable request record IS the schedule seed — every rank of the
+    # group (survivor or fresh respawn) rebuilds the identical
+    # scheduler state from it.  Groups recover independently; the log
+    # partition (n % groups) makes their replays disjoint.
     t_rec0 = time.time()
     if is_leader:
-        rec = _build_recovery(ctx.kv)
-        ctx.kv.put(scope, "recovery", pickle.dumps(rec))
+        rec = _build_recovery(ctx.kv, group, groups)
+        ctx.kv.put(scope, f"recovery/{group}", pickle.dumps(rec))
     else:
-        rec = pickle.loads(_fetch(ctx, scope, "recovery",
+        rec = pickle.loads(_fetch(ctx, scope, f"recovery/{group}",
                                   f"recovery doc for epoch {epoch}"))
-    # Gauges the autoscale controller and the live digest read: the
-    # size of the world this rank just rendezvoused into, and the
-    # weight version it serves.  Every rank converges on the durable
-    # version BEFORE any replay prefill — a replayed request's rebuilt
-    # cache must be computed under the version the new epoch serves.
-    reg.gauge("serve.world_size").set(ctx.size)
+    # Every rank converges on the durable weight version BEFORE any
+    # replay prefill — a replayed request's rebuilt cache must be
+    # computed under the version the new epoch serves.
     if swap is not None:
         swap.reset_epoch()
         swap.ensure_version(engine, rec.get("weight_version", 0))
     sched = SlotScheduler(spec["num_slots"])
     engine.reset()
     log_next = rec["log_next"]
-    # Request-log compaction (leader-only writes, like every other
-    # durable-record write): log index of every in-flight request, the
-    # done set above the watermark, and the watermark itself.
+    # Request-log compaction (global-leader-only writes, like every
+    # other durable-record write): log index of every in-flight
+    # request, the done set above the watermark, and the watermark
+    # itself.  ``other_rids`` maps the OTHER groups' in-flight indices
+    # to rids — the global leader cannot see their evictions directly,
+    # so it advances past them by polling their published done docs
+    # (one O(1) KV get per head-of-watermark candidate per step).
     n_of: Dict[str, int] = {}
     done_ns = set(rec.get("done_ns", []))
+    other_rids: Dict[int, str] = {int(k): v for k, v in
+                                  rec.get("others", {}).items()}
     watermark = rec.get("watermark", 0)
 
-    def _mark_done(rid: str) -> None:
-        """Leader bookkeeping: fold a finished request's log index into
+    def _advance_watermark() -> None:
+        """Global-leader bookkeeping: fold finished log indices into
         the watermark, push the new floor durably, THEN delete the
         compacted log keys (a crash between the two leaves orphan
         entries below the floor — harmless — never a floor above
-        surviving entries)."""
+        surviving entries).  Indices owned by other groups advance
+        when their done doc is visible."""
         nonlocal watermark
-        n = n_of.pop(rid, None)
-        if n is not None:
-            done_ns.add(n)
         old = watermark
-        while watermark in done_ns:
-            done_ns.discard(watermark)
-            watermark += 1
+        while True:
+            if watermark in done_ns:
+                done_ns.discard(watermark)
+                other_rids.pop(watermark, None)
+                watermark += 1
+                continue
+            rid = other_rids.get(watermark)
+            if rid is not None:
+                raw = ctx.kv.get(SCOPE, f"out/{rid}")
+                if raw is not None and pickle.loads(raw).get("done"):
+                    other_rids.pop(watermark)
+                    watermark += 1
+                    continue
+            break
         if watermark > old:
             ctx.kv.put(SCOPE, "log_watermark",
                        str(watermark).encode())
@@ -307,10 +409,47 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 ctx.kv.delete(SCOPE, f"log/{i}")
             reg.gauge("serve.log_watermark").set(watermark)
 
-    replayed = 0
-    for entry in rec["inflight"]:
+    def _mark_done(rid: str) -> None:
+        n = n_of.pop(rid, None)
+        if n is not None:
+            done_ns.add(n)
+        if is_global:
+            _advance_watermark()
+
+    def _reject_reason(entry) -> Optional[str]:
+        """Full per-entry verdict: the frontend validation plus the
+        page-feasibility check (a request whose worst case exceeds the
+        WHOLE page pool can never be admitted — rejecting it loudly
+        beats a permanently head-blocked FCFS queue).  Pure, so every
+        rank and every group reaches the same verdict."""
         reason = validate_request(entry, engine.serve_len,
                                   engine.cfg.vocab_size)
+        if reason is None and engine.paged is not None:
+            reason = page_reject_reason(
+                len(entry["prompt"]), entry["max_new_tokens"],
+                engine.page_size, engine.num_pages,
+            )
+        return reason
+
+    def _entry_request(entry) -> Request:
+        return Request(
+            rid=entry["rid"], prompt=tuple(entry["prompt"]),
+            max_new_tokens=entry["max_new_tokens"],
+            eos_id=entry.get("eos_id"),
+            arrival=entry.get("arrival", 0.0),
+            temperature=float(entry.get("temperature") or 0.0),
+            top_k=int(entry.get("top_k") or 0),
+        )
+
+    # Admission capacity in FREE PAGES (paged mode): each round's gate
+    # accumulates its own acceptances, so two same-round admissions are
+    # never judged against the same free pool.  A deterministic
+    # function of the schedule so far — the HVD001 invariant extends
+    # through this gate.
+
+    replayed = 0
+    for entry in rec["inflight"]:
+        reason = _reject_reason(entry)
         if reason is not None:
             # Same accounting as the live path: a reject during replay
             # must show in serve.rejected too, or the runbook's
@@ -327,13 +466,8 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             continue
         if is_leader and entry.get("n") is not None:
             n_of[entry["rid"]] = int(entry["n"])
-        req = Request(
-            rid=entry["rid"], prompt=tuple(entry["prompt"]),
-            max_new_tokens=entry["max_new_tokens"],
-            eos_id=entry.get("eos_id"),
-            arrival=entry.get("arrival", 0.0),
-        )
-        sched.enqueue(req, resume=entry.get("emitted", ()))
+        sched.enqueue(_entry_request(entry),
+                      resume=entry.get("emitted", ()))
         if entry.get("emitted"):
             replayed += 1
     if replayed:
@@ -358,6 +492,19 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
     dspan: Dict[int, Tuple[float, int]] = {}
     idle_secs = float(spec.get("idle_secs", 0.01))
     stream_every = max(int(spec.get("stream_every", 4)), 1)
+    # A single-rank group has no peers to broadcast to: publishing the
+    # step schedule would cost a signed KV roundtrip per step that
+    # nobody reads (recovery never replays sched keys — it rebuilds
+    # from log + out).  At ~2ms per roundtrip that is a large slice of
+    # a CPU decode step, and it is exactly the fleet shape the width-1
+    # scaling bench runs, so skip it.
+    solo = len(group_world) == 1
+    # The drain sentinel is write-once; probing it every busy step is
+    # another roundtrip per step.  Probe on idle steps and every 8th
+    # busy step (drain latency <= 8 steps), and latch the first hit.
+    stop_latched = False
+    was_busy = False
+    idle_streak = 0
     while True:
         step += 1
         t_step0 = time.time()
@@ -365,38 +512,80 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
         # collective's step-boundary injection point — same spec
         # grammar, same epoch-0 default that keeps respawns convergent.
         maybe_fail("worker_exit", step=step, rank=ctx.rank)
-        if ctx.world_changed():
+        # Epoch-bump probe: one KV get.  Busy steps only probe every
+        # 4th (detection lag <= 3 steps; peers blocked in _fetch watch
+        # the epoch continuously, and heartbeat/progress monitoring is
+        # out-of-band) — at CPU decode speeds an every-step probe was
+        # a measurable slice of the serving loop.
+        if (not was_busy or step % 4 == 0) and ctx.world_changed():
             raise HorovodShutdownError(
                 f"epoch advanced past {epoch} (a peer died); "
                 f"re-forming the serving world"
             )
 
-        # -- schedule broadcast (leader reads the log; peers follow) --
+        # -- schedule broadcast (the group leader reads the log and
+        # keeps its partition n % groups == group; its peers follow) --
         if is_leader:
             new_entries = []
-            while True:
+            # Log probe: one KV get per step minimum.  When the local
+            # queue already holds waiting work, new arrivals cannot
+            # change THIS step's admissions (FCFS — they join behind
+            # the queue), so probe every 4th step; total order is the
+            # log's either way.  An empty queue probes every step:
+            # that is the latency-sensitive case.
+            probe = sched.queue_depth == 0 or step % 4 == 0
+            while probe:
                 raw = ctx.kv.get(SCOPE, f"log/{log_next}")
                 if raw is None:
+                    if groups > 1 and not is_global:
+                        # The GLOBAL leader compacts log keys the
+                        # moment the contiguous prefix is done — keys
+                        # THIS group's lagging cursor may not have
+                        # scanned yet.  A gap at log_next therefore
+                        # means either "end of log" or "compacted
+                        # under me": re-read the watermark and jump
+                        # over the deleted range, or this group's
+                        # cursor polls a deleted key forever and its
+                        # partition starves.
+                        raw_wm = ctx.kv.get(SCOPE, "log_watermark")
+                        wm = (int(raw_wm.decode())
+                              if raw_wm is not None else 0)
+                        if wm > log_next:
+                            log_next = wm
+                            continue
                     break
-                new_entries.append(pickle.loads(raw))
+                doc = pickle.loads(raw)
+                doc_n = int(doc.get("n", log_next))
+                if doc_n % groups == group:
+                    new_entries.append(doc)
+                elif is_global:
+                    # Another group's request: remember its rid so the
+                    # compaction watermark can advance past it once its
+                    # done doc lands.
+                    other_rids[doc_n] = doc["rid"]
                 log_next += 1
-            stop = ctx.kv.get(SCOPE, "stop") is not None
-            sdoc = {"new": new_entries, "stop": stop}
+            if not stop_latched and (not was_busy or step % 8 == 0):
+                stop_latched = ctx.kv.get(SCOPE, "stop") is not None
+            sdoc = {"new": new_entries, "stop": stop_latched}
             if swap is not None:
                 # The poll-and-flip decision travels the SAME broadcast
                 # lane as admissions: derived from shared data (the
                 # committed manifest + the ranks' prefetch votes) by
-                # the leader alone, obeyed by everyone — the serving
-                # form of "all ranks agree to deviate".
-                sw = swap.leader_step(ctx.kv, scope, ctx.world, step)
+                # the group leader alone, obeyed by its group — the
+                # serving form of "all ranks agree to deviate".
+                sw = swap.leader_step(ctx.kv, scope, group_world, step)
                 if sw is not None:
                     sdoc["swap"] = sw
-            ctx.kv.put(scope, f"sched/{step}", pickle.dumps(sdoc))
-            if step > _SCHED_KEEP:
-                ctx.kv.delete(scope, f"sched/{step - _SCHED_KEEP}")
+            if not solo:
+                ctx.kv.put(scope, f"sched/{group}/{step}",
+                           pickle.dumps(sdoc))
+                if step > _SCHED_KEEP:
+                    ctx.kv.delete(scope,
+                                  f"sched/{group}/{step - _SCHED_KEEP}")
         else:
-            sdoc = pickle.loads(_fetch(ctx, scope, f"sched/{step}",
-                                       f"schedule for step {step}"))
+            sdoc = pickle.loads(_fetch(
+                ctx, scope, f"sched/{group}/{step}",
+                f"schedule for group {group} step {step}"))
         t_sched = time.time()
 
         # -- weight hot-swap transitions (between decode steps, before
@@ -407,8 +596,7 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                        epoch, step)
 
         for entry in sdoc["new"]:
-            reason = validate_request(entry, engine.serve_len,
-                                      engine.cfg.vocab_size)
+            reason = _reject_reason(entry)
             if reason is not None:
                 reg.counter("serve.rejected").inc()
                 if is_leader:
@@ -422,23 +610,24 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 continue
             if is_leader and entry.get("n") is not None:
                 n_of[entry["rid"]] = int(entry["n"])
-            sched.enqueue(Request(
-                rid=entry["rid"], prompt=tuple(entry["prompt"]),
-                max_new_tokens=entry["max_new_tokens"],
-                eos_id=entry.get("eos_id"),
-                arrival=entry.get("arrival", 0.0),
-            ))
+            sched.enqueue(_entry_request(entry))
 
-        # -- admissions: queued -> free slots, prefill each ----------
+        # -- admissions: queued -> free slots (and, in paged mode,
+        # free PAGES for the head request's worst case), prefill each
         busy_before = sched.active_slots
-        admissions = sched.admit(step)
+        admissions = sched.admit(step, can_admit=engine.admission_gate())
         for adm in admissions:
             t_a0 = time.time()
             # Deterministic OOM chaos on the prefill-allocation path:
             # admission is where a real fleet usually dies (a long
             # prompt's prefill is the allocation spike).
             memplane.alloc_guard("assign_slot", rank=ctx.rank)
-            tok = engine.admit(adm.slot, adm.req.prompt, adm.resume)
+            tok = engine.admit(
+                adm.slot, adm.req.prompt, adm.resume,
+                total_len=len(adm.req.prompt) + adm.req.max_new_tokens,
+                temperature=adm.req.temperature, top_k=adm.req.top_k,
+                rid=adm.req.rid,
+            )
             t_a1 = time.time()
             # A recycled slot must never inherit the previous tenant's
             # decode-window mark.
@@ -525,6 +714,11 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                              if ttft_ms is not None else None),
                 )
         evictions = sched.evict_finished()
+        for ev in evictions:
+            # Paged mode: an eviction returns the slot's pages to the
+            # free list immediately — the very next admissions (this
+            # step's were already decided) can reuse them.
+            engine.release_slot(ev.slot)
 
         # -- one decode iteration over the live slots ----------------
         active = sorted(sched.active)
@@ -558,7 +752,10 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                             tokens=n - mark[1],
                         )
                         dspan[slot] = (t_d1, n)
-            evictions += sched.evict_finished()
+            post = sched.evict_finished()
+            for ev in post:
+                engine.release_slot(ev.slot)
+            evictions += post
 
         # -- stream results (leader only writes; peers computed the
         # identical tokens and discard them) -------------------------
@@ -584,7 +781,7 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                              done=True, epoch=epoch,
                              admitted_step=ev.admitted_step,
                              finished_step=step, reason=ev.reason,
-                             n=n_of.get(ev.rid))
+                             n=n_of.get(ev.rid), t_done=time.time())
                 # Done doc durably published -> this log index can
                 # leave the replay set; the watermark advances and the
                 # compacted log keys are deleted.
@@ -614,6 +811,7 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
         # -- gauges + progress beat ----------------------------------
         t_step1 = time.time()
         busy = bool(active or admissions or sdoc["new"] or evictions)
+        was_busy = busy
         if tracing and busy:
             if is_leader:
                 obs_trace.add_span("serve.steps", "stream_publish",
@@ -634,6 +832,34 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
         reg.gauge("serve.kv.allocated_bytes").set(kv["allocated_bytes"])
         reg.gauge("serve.kv.live_bytes").set(kv["live_bytes"])
         reg.gauge("serve.kv.waste_ratio").set(kv["waste_ratio"])
+        if "page_size" in kv:
+            # Page-granular pool gauges (paged mode): what admission
+            # capacity is actually judged in.
+            reg.gauge("serve.kv.page_size").set(kv["page_size"])
+            reg.gauge("serve.kv.page_free").set(kv["pages_free"])
+            reg.gauge("serve.kv.page_used").set(kv["pages_used"])
+        if kv["allocated_bytes"] > 0:
+            # Busy-step waste aggregate for the drain summary (the
+            # gauges only show the LAST step, which at drain is an
+            # idle pool): what bench records and the CI waste gate
+            # judge the paged fix by.
+            totals["kv_busy_steps"] += 1
+            totals["kv_waste_sum"] += kv["waste_ratio"]
+            totals["kv_alloc_peak"] = max(totals["kv_alloc_peak"],
+                                          kv["allocated_bytes"])
+            contig = kv.get("contiguous_equiv_bytes", 0)
+            if contig > 0:
+                # The same step judged by the contiguous design's
+                # worst-case reservation — the PR-14 baseline on this
+                # very traffic.
+                totals["kv_contig_waste_sum"] += (
+                    1.0 - kv["live_bytes"] / contig
+                )
+        if is_global:
+            # Pick up OTHER groups' completions (their done docs) so
+            # the compaction floor keeps moving even when this group
+            # is idle.
+            _advance_watermark()
         # Sliding wall-clock window, fed the SAME timestamps the
         # decode-compute spans carry: the digest and the trace report
         # cannot disagree about throughput.
@@ -669,12 +895,43 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             mem = memplane.memory_record()
             mem["kv_pool_bytes"] = engine.kv_stats(())["pool_bytes"]
             out["memory"] = mem
+            # KV-occupancy verdict over the whole run (busy steps
+            # only — the drained pool is trivially empty): the number
+            # the bench record and the CI waste gate judge the paged
+            # pool by, against the PR-14 contiguous baseline.
+            out["kv"] = {
+                "mode": engine.kv_mode,
+                "waste_ratio_mean": (
+                    totals["kv_waste_sum"]
+                    / max(totals["kv_busy_steps"], 1)
+                ),
+                "contiguous_equiv_waste_mean": (
+                    totals["kv_contig_waste_sum"]
+                    / max(totals["kv_busy_steps"], 1)
+                ),
+                "allocated_peak_bytes": totals["kv_alloc_peak"],
+                "pool_bytes": mem["kv_pool_bytes"],
+            }
+            if engine.paged is not None:
+                out["kv"]["page_size"] = engine.page_size
+                out["kv"]["num_pages"] = engine.num_pages
+            if width:
+                out["kv"]["width"] = width
+                out["group"] = group
             return out
         if not active and not admissions and not sdoc["new"] and is_leader:
             # Idle pacing: peers are paced by the schedule fetch; the
-            # leader throttles itself so an empty queue costs a few
-            # KV gets per idle_secs, not a busy loop.
-            time.sleep(idle_secs)
+            # leader throttles itself so an empty queue costs a few KV
+            # gets per idle_secs, not a busy loop.  The pace BACKS OFF
+            # exponentially (cap 16x) — a drained group polling at
+            # full rate measurably slows the groups still serving
+            # through the shared store; the cost is bounded extra
+            # admission latency on an idle fleet.
+            idle_streak += 1
+            time.sleep(min(idle_secs * (1 << min(idle_streak, 4)),
+                           idle_secs * 16))
+        else:
+            idle_streak = 0
 
 
 def serve_worker(spec: Optional[dict] = None):
@@ -708,8 +965,17 @@ def serve_worker(spec: Optional[dict] = None):
     model = gpt(spec["size"], **spec.get("overrides", {}))
     dummy = jnp.zeros((1, min(8, model.cfg.max_len)), jnp.int32)
     params = model.init(jax.random.PRNGKey(spec["seed"]), dummy)
-    engine = SlotEngine(model.cfg, params, spec["num_slots"],
-                        spec.get("max_len"))
+    width = int(spec.get("width") or 0)
+    engine = SlotEngine(
+        model.cfg, params, spec["num_slots"], spec.get("max_len"),
+        kv_mode=spec.get("kv_mode") or "paged",
+        page_size=int(spec.get("page_size") or 16),
+        num_pages=spec.get("kv_pages"),
+        # spec width 0/1 both mean an unsharded engine; > 1 shard_maps
+        # the paged decode over the local device mesh's width axis.
+        width=max(width, 1),
+        sample_seed=int(spec.get("seed") or 0),
+    )
     # The serving MFU accountant: decode-step FLOPs from the compiled
     # artifact's own cost analysis over the measured step time,
     # published live as perf.* gauges (estimate-flagged off-TPU) —
@@ -736,7 +1002,10 @@ def serve_worker(spec: Optional[dict] = None):
             poll_steps=int(spec.get("swap_poll_steps") or 16),
         )
         get_registry().gauge("serve.weight_version").set(0)
-    totals = {"completed": 0, "tokens": 0, "done_rids": set(),
+    totals = {"completed": 0, "tokens": 0,
+              "kv_busy_steps": 0, "kv_waste_sum": 0.0,
+              "kv_contig_waste_sum": 0.0,
+              "kv_alloc_peak": 0, "done_rids": set(),
               "admitted_rids": set()}
     from ..exceptions import RankDroppedError  # noqa: PLC0415
 

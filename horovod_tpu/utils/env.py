@@ -127,6 +127,17 @@ SERVE_MODEL = "HVDTPU_SERVE_MODEL"
 SERVE_SLOTS = "HVDTPU_SERVE_SLOTS"
 SERVE_MAX_LEN = "HVDTPU_SERVE_MAX_LEN"
 SERVE_SEED = "HVDTPU_SERVE_SEED"
+# Paged KV memory + width-sharded fleets (serve/paged.py, ISSUE 15):
+# KV_MODE paged|contiguous, PAGE_SIZE token rows per page, KV_PAGES
+# the page-pool size (unset = worst case), WIDTH >= 1 carves the
+# world into size//WIDTH serving groups (each independently serving
+# its log partition) with each rank's paged decode shard_mapped over
+# WIDTH local devices.  All fleet-wide: the block tables and the
+# schedule must be identical on every rank of a group.
+SERVE_KV_MODE = "HVDTPU_SERVE_KV_MODE"
+SERVE_PAGE_SIZE = "HVDTPU_SERVE_PAGE_SIZE"
+SERVE_KV_PAGES = "HVDTPU_SERVE_KV_PAGES"
+SERVE_WIDTH = "HVDTPU_SERVE_WIDTH"
 # Weight hot-swap (serve/hotswap.py): WEIGHTS_DIR is the sharded-
 # checkpoint directory a concurrently-training publisher commits
 # versions into (unset = hot-swap off); SWAP_POLL_STEPS is the

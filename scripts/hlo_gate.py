@@ -31,7 +31,8 @@ import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-PROGRAMS = ("engine_allreduce", "overlap_bucket", "serve_decode")
+PROGRAMS = ("engine_allreduce", "overlap_bucket", "serve_decode",
+            "serve_paged_width")
 WORLD = 2  # simulated ranks; each compiles in its own process
 
 
@@ -135,6 +136,49 @@ def _emit(rank: int, out_dir: str) -> None:
         jnp.ones((b, s, 2, hd), jnp.float32),
         jnp.ones((b, s, 2, hd), jnp.float32),
         jnp.full((b,), 7, jnp.int32),
+    ))
+
+    # (4) serve paged width-sharded decode (ISSUE 15): the block-table
+    # gather + Megatron width shard over a (replica, width) mesh view —
+    # the program every rank of a width-sharded fleet serves from.  Two
+    # row-parallel psums per block over the width axis; a rank-leaked
+    # schedule here would desync the whole fleet's decode.
+    from horovod_tpu.models.decode import (
+        decode_step_paged, init_paged_pool,
+    )
+    from horovod_tpu.models.transformer import gpt
+    from horovod_tpu.parallel.tensor_parallel import stack_tp_params
+    from horovod_tpu.serve.engine import REPLICA_AXIS, WIDTH_AXIS
+
+    model = gpt("nano", num_layers=1, num_heads=2, emb_dim=32,
+                max_len=16, vocab_size=64, dtype=jnp.float32,
+                attention_impl="reference")
+    gcfg = model.cfg
+    gparams = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))
+    sh, rep = stack_tp_params(gparams, gcfg, 2)
+    wmesh = Mesh(np.asarray(jax.devices(), dtype=object).reshape(2, 2),
+                 (REPLICA_AXIS, WIDTH_AXIS))
+    pool = init_paged_pool(gcfg, num_pages=6, page_size=4, num_slots=2)
+    tables = jnp.zeros((2, 4), jnp.int32)
+    pool_spec = {"k": P(None, None, None, WIDTH_AXIS, None),
+                 "v": P(None, None, None, WIDTH_AXIS, None),
+                 "pos": P()}
+
+    def paged_step(sh_p, rep_p, pool_, tables_, toks, mask):
+        p = jax.tree_util.tree_map(lambda a: a[0], sh_p)
+        return decode_step_paged(gcfg, p, pool_, tables_, toks,
+                                 write_mask=mask, tp_axis=WIDTH_AXIS,
+                                 rep=rep_p)
+
+    pstep = jax.jit(shard_map_compat(
+        paged_step, mesh=wmesh,
+        in_specs=(P(WIDTH_AXIS), P(), pool_spec, P(), P(), P()),
+        out_specs=(P(), pool_spec),
+    ))
+    dump("serve_paged_width", pstep.lower(
+        sh, rep, pool, tables,
+        jnp.ones((2,), jnp.int32), jnp.ones((2,), bool),
     ))
 
 

@@ -284,6 +284,7 @@ def _stall_fn():
     return out
 
 
+@pytest.mark.slow  # tier-1 budget triage (ISSUE 15): run by node id in ci/test_matrix.sh slow_multiproc gate
 def test_stall_shutdown_aborts_instead_of_hanging():
     """Reference test_stall.py: a rank that never submits triggers the
     stall inspector's warning then coordinated shutdown
@@ -693,6 +694,7 @@ def _tf_interop_fn():
     return out
 
 
+@pytest.mark.slow  # tier-1 budget triage (ISSUE 15): run by node id in ci/test_matrix.sh slow_multiproc gate
 def test_tf_interop_across_processes(engine_env):
     pytest.importorskip("tensorflow")
     results = hvdrun.run(_tf_interop_fn, np=2, use_cpu=True,
@@ -1372,6 +1374,7 @@ def _tf_session_hook_fn():
     return out
 
 
+@pytest.mark.slow  # tier-1 budget triage (ISSUE 15): run by node id in ci/test_matrix.sh slow_multiproc gate
 def test_tf_broadcast_hook_in_monitored_session(engine_env):
     """BroadcastGlobalVariablesHook broadcasts on session creation — the
     TF1 estimator migration path (reference tensorflow/__init__.py:194-227)."""
@@ -1416,6 +1419,7 @@ def _tf_adasum_opt_fn():
     return {"v": out, "dup_ok": bool(dup_ok)}
 
 
+@pytest.mark.slow  # tier-1 budget triage (ISSUE 15): run by node id in ci/test_matrix.sh slow_multiproc gate
 def test_tf_adasum_optimizer_matches_numpy_reference(engine_env):
     """TF frontend delta-Adasum: final var == start + numpy-VHDD(deltas)
     (reference _DistributedAdasumOptimizer, tensorflow/__init__.py:313-407)."""
@@ -1545,6 +1549,7 @@ def _keras_fit_fn():
     return out
 
 
+@pytest.mark.slow  # tier-1 budget triage (ISSUE 15): run by node id in ci/test_matrix.sh slow_multiproc gate
 def test_keras_fit_across_processes():
     results = hvdrun.run(_keras_fit_fn, np=2, use_cpu=True, timeout=300,
                          env={"HVDTPU_EAGER_ENGINE": "python"})

@@ -390,6 +390,7 @@ def _oracle(prompts, steps):
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow
 def test_serve_job_staggered_requests_and_rejection():
     """Single-rank fleet: staggered mixed-length requests all complete
     with oracle tokens through slot churn; an oversized request is
@@ -421,6 +422,7 @@ def test_serve_job_staggered_requests_and_rejection():
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow
 def test_serve_chaos_kill_leader_respawn_zero_dropped():
     """ISSUE 10 acceptance: 2-proc fleet, 8 staggered mixed-length
     requests, the LEADER (rank 0 — the only rank that reads the ingest
@@ -461,3 +463,76 @@ def test_serve_chaos_kill_leader_respawn_zero_dropped():
     # both ranks drained cleanly and returned summaries
     assert sorted(results) == [0, 1]
     assert all(v["completed"] >= 1 for v in results.values())
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_serve_width_fleet_partition_chaos_and_sampling():
+    """ISSUE 15 acceptance: a width-sharded fleet (np=2, width=1 -> 2
+    independent serving groups over the log partition n % 2) serves 8
+    mixed requests — two of them SAMPLED (temperature/top-k) — with a
+    mid-stream kill of rank 1 (group 1's leader).  Every stream must
+    equal the single-engine oracle bit-for-bit: greedy via
+    ``generate``, sampled via the shared (rid, emission index, seed)
+    key derivation — the fleet shape, the chaos replay with paged
+    block tables, and the sampler must all be invisible in the tokens.
+    Both groups must have actually served (the partition is capacity,
+    not standby)."""
+    from horovod_tpu.serve.engine import SlotEngine as _Eng
+
+    spec = _spec()
+    spec.update({"width": 1, "kv_mode": "paged", "page_size": 8,
+                 "kv_pages": 16})
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, 64, rs.randint(3, 9)).tolist()
+               for _ in range(8)]
+    steps = [3, 4, 5, 6, 3, 4, 5, 6]
+    temps = [0.0, 0.0, 0.9, 0.0, 0.0, 0.8, 0.0, 0.0]
+    rids = [f"flt{i}" for i in range(8)]
+
+    o = dict(_OVERRIDES)
+    o["dtype"] = jnp.float32
+    model = gpt("nano", **o)
+    params = model.init(jax.random.PRNGKey(3),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    def oracle_one(prompt, n, temp, rid):
+        eng = _Eng(model.cfg, params, 1, kv_mode="paged", page_size=8,
+                   sample_seed=spec["seed"])
+        toks = [eng.admit(0, prompt, temperature=temp, top_k=8,
+                          rid=rid, total_len=len(prompt) + n)]
+        for _ in range(n - 1):
+            toks.append(eng.step([0])[0])
+        return toks
+
+    oracle = [oracle_one(p, n, t, r)
+              for p, n, t, r in zip(prompts, steps, temps, rids)]
+
+    job = ServeJob(
+        spec, np=2,
+        env={"JAX_PLATFORMS": "cpu",
+             "HVDTPU_FAULT_SPEC": "worker_exit:step=6:rank=1"},
+        max_retries=2, timeout=300,
+    ).start()
+    try:
+        for p, n, t, r in zip(prompts, steps, temps, rids):
+            job.client.submit(p, max_new_tokens=n, temperature=t,
+                              top_k=8, rid=r)
+            time.sleep(0.05)
+        docs = [job.client.result(r, timeout=240) for r in rids]
+        results, ejob = job.stop()
+    finally:
+        job.shutdown()
+    assert [d["tokens"] for d in docs] == oracle
+    events = [e[0] for e in ejob.trace]
+    assert events.count("failure") == 1 and events.count("respawn") == 1
+    # the partition is real capacity: each rank completed ITS group
+    assert sorted(results) == [0, 1]
+    assert all(v["completed"] >= 1 for v in results.values())
+    assert {v.get("group") for v in results.values()} == {0, 1}
+    # completed is per-incarnation: requests group 1 finished BEFORE
+    # the kill died with that incarnation's summary (their done docs
+    # survive, which is why the streams above are 8/8) — so the sum is
+    # >= 8 minus what pre-kill group 1 finished, never more than 8.
+    total_done = sum(v["completed"] for v in results.values())
+    assert 4 <= total_done <= 8
