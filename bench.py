@@ -637,14 +637,16 @@ def _arm_watchdog(args) -> None:
     threading.Thread(target=_fire, daemon=True).start()
 
 
-def _run_serve_load(args, np_: int, width: int, on_cpu: bool) -> dict:
+def _run_serve_load(args, np_: int, width: int, on_cpu: bool,
+                    frontends: int = 1) -> dict:
     """One fleet under one open-loop workload: launch ``np_`` serving
     ranks (``width`` >= 1 turns on the width-sharded fleet — np_//width
     independent serving groups, each rank's paged decode shard_mapped
-    over ``width`` local devices), submit the deterministic mixed-
-    length request schedule, and measure ttft/tpot/tokens-per-sec on
-    the client clock.  Returns the raw measurement dict the record (or
-    the scaling comparison) embeds."""
+    over ``width`` local devices; ``frontends`` > 1 shards the front
+    door into that many rid-hash-partitioned ingest pumps), submit the
+    deterministic mixed-length request schedule, and measure ttft/tpot/
+    tokens-per-sec on the client clock.  Returns the raw measurement
+    dict the record (or the scaling comparison) embeds."""
     import threading
 
     from horovod_tpu.serve import ServeJob
@@ -662,7 +664,8 @@ def _run_serve_load(args, np_: int, width: int, on_cpu: bool) -> dict:
             "stream_every": 8,
             "kv_mode": args.serve_kv_mode,
             "page_size": args.serve_page_size,
-            "width": width}
+            "width": width,
+            "frontends": max(int(frontends), 1)}
     if args.serve_kv_pages:
         spec["kv_pages"] = args.serve_kv_pages
     env = {"JAX_PLATFORMS": "cpu"} if on_cpu else {}
@@ -715,6 +718,7 @@ def _run_serve_load(args, np_: int, width: int, on_cpu: bool) -> dict:
         job.client.result(rid, timeout=max(_budget_left(args) - 60, 120))
     submit_t: dict = {}
     rids: list = []
+    fd_stats: dict = {}
 
     def _submitter():
         t = time.perf_counter()
@@ -799,6 +803,10 @@ def _run_serve_load(args, np_: int, width: int, on_cpu: bool) -> dict:
             for r in rids if r in first_t and done[r][1] > 1
         ]
         results, _ejob = job.stop()
+        # Per-shard ingest accounting from the front door itself —
+        # counters survive stop(); a lopsided split here means the rid
+        # hash is mixing badly, not that a pump is slow.
+        fd_stats = job.front_door.stats()
     finally:
         job.shutdown()
 
@@ -809,6 +817,7 @@ def _run_serve_load(args, np_: int, width: int, on_cpu: bool) -> dict:
     meas = {
         "np": np_,
         "width": width,
+        "frontends": max(int(frontends), 1),
         "groups": max(np_ // width, 1) if width else 1,
         "slots": args.serve_slots,
         "requests": n_req,
@@ -822,6 +831,16 @@ def _run_serve_load(args, np_: int, width: int, on_cpu: bool) -> dict:
         "tpot_ms": {"p50": pct(tpot, 50), "p90": pct(tpot, 90),
                     "p99": pct(tpot, 99)},
     }
+    if fd_stats:
+        meas["frontdoor"] = {
+            "frontends": fd_stats.get("frontends"),
+            "fd_epoch": fd_stats.get("fd_epoch"),
+            "takeovers": fd_stats.get("takeovers"),
+            "ingested_by_shard": {
+                str(s): n for s, n in sorted(
+                    (fd_stats.get("ingested_by_shard") or {}).items())
+            },
+        }
     ranks = sorted(results or {})
     meas["_results"] = results or {}
     if ranks:
@@ -874,7 +893,33 @@ def _serve_bench(args) -> int:
                     phase="serve")
     on_cpu = args.cpu or jax.devices()[0].platform == "cpu"
     width = int(args.serve_width or 0)
-    if args.serve_scaling:
+    fd = max(int(getattr(args, "serve_frontends", 0) or 0), 0)
+    frontdoor_scaling = None
+    if fd > 1 and not args.serve_scaling:
+        # Front-door comparison (PR-16): the SAME saturating trace
+        # through a single-pump door and through an F-way sharded one.
+        # On one host this measures ingest-path structure (per-shard
+        # cursors, no cross-shard serialization), not network fan-in —
+        # labeled as such below, same honesty rule as --serve-scaling.
+        single = _run_serve_load(args, args.serve_np, width, on_cpu,
+                                 frontends=1)
+        single.pop("_results", None)
+        main = _run_serve_load(args, args.serve_np, width, on_cpu,
+                               frontends=fd)
+        results = main.pop("_results")
+        scaling = None
+        ratio = (main["tokens_per_sec"]
+                 / max(single["tokens_per_sec"], 1e-9))
+        frontdoor_scaling = {
+            "f1": {k: v for k, v in single.items()
+                   if k != "completed_per_rank"},
+            f"f{fd}": {k: v for k, v in main.items()
+                       if k != "completed_per_rank"},
+            "tokens_per_sec_ratio": round(ratio, 3),
+            "provenance": ("cpu-mesh structural evidence"
+                           if on_cpu else "device measurement"),
+        }
+    elif args.serve_scaling:
         w = max(width, 1)
         attempts = max(int(args.serve_scaling_attempts), 1)
         # Best-of-N per leg: this host's scheduler sometimes lands two
@@ -917,7 +962,8 @@ def _serve_bench(args) -> int:
                            if on_cpu else "device measurement"),
         }
     else:
-        main = _run_serve_load(args, args.serve_np, width, on_cpu)
+        main = _run_serve_load(args, args.serve_np, width, on_cpu,
+                               frontends=max(fd, 1))
         results = main.pop("_results")
         scaling = None
 
@@ -930,6 +976,8 @@ def _serve_bench(args) -> int:
     }
     if scaling is not None:
         out["serve"]["scaling"] = scaling
+    if frontdoor_scaling is not None:
+        out["serve"]["frontdoor_scaling"] = frontdoor_scaling
     ranks = sorted(results or {})
     if ranks:
         # Decode-step MFU from the serving ranks' own cost_analysis()
@@ -1212,6 +1260,13 @@ def main() -> int:
                         help="best-of-N runs per scaling leg (host-"
                              "scheduler noise mitigation; labeled in "
                              "the record)")
+    parser.add_argument("--frontends", type=int, default=0,
+                        dest="serve_frontends",
+                        help="sharded front door: run the workload with "
+                             "F frontend ingest shards; F>1 also runs "
+                             "an F=1 leg on the same trace and embeds "
+                             "the ingest comparison + per-shard "
+                             "counters in the record")
     parser.add_argument("--attempts", type=int, default=4,
                         help="retries (fresh process) on tunnel UNAVAILABLE")
     parser.add_argument("--watchdog-secs", type=int, default=780,

@@ -992,6 +992,39 @@ JAX_PLATFORMS=cpu \
     "tests/test_autoscale_swap.py::test_log_compaction_bounds_store_and_replay" \
     -x -q
 
+# Front-door gate (ISSUE 16): the sharded, supervised request plane +
+# tenant-aware QoS.  hvdtpu-lint stays clean over the scheduler (the
+# tenant pick must be a pure fold over the ordered log — HVD001/012),
+# the fast decision-table suite (QoS table incl. the FCFS-degenerate
+# byte-identity, machine-readable rejection codes, FrontDoor takeover
+# on a bare KV store with no drop and no double-ingest, multi-shard
+# recovery interleave, client poll backoff), then the two chaos
+# acceptances by node id: (1) F=2 mixed-tenant fleet, frontend 0
+# killed abruptly mid-stream — the survivor adopts its shard, the
+# elastic monitor re-mints the epoch, and 8/8 requests complete
+# bitwise-equal to the single-stream oracle; (2) a flooding batch
+# tenant is budget-throttled (throttle counter lands in the drain
+# summary) while its interactive victims all complete promptly with
+# oracle tokens.
+echo "== frontdoor gate: lint + decision-table suite =="
+python -m horovod_tpu.analysis \
+    horovod_tpu/serve/scheduler.py horovod_tpu/serve/frontend.py \
+    horovod_tpu/serve/service.py \
+    --baseline horovod_tpu/analysis/baseline.json
+JAX_PLATFORMS=cpu \
+    timeout 300 python -m pytest tests/test_frontdoor.py \
+    -x -q -m "not slow"
+echo "== frontdoor gate: kill-a-frontend chaos -> zero drops, bitwise =="
+JAX_PLATFORMS=cpu \
+    timeout 400 python -m pytest \
+    "tests/test_frontdoor.py::test_frontdoor_kill_frontend_mid_stream_zero_drops_bitwise" \
+    -x -q
+echo "== frontdoor gate: noisy tenant throttled, victims complete =="
+JAX_PLATFORMS=cpu \
+    timeout 400 python -m pytest \
+    "tests/test_frontdoor.py::test_frontdoor_noisy_tenant_throttled_victims_complete" \
+    -x -q
+
 # Trace gate (ISSUE 11): request-level tracing + the live MFU
 # profiler.  The unit suite + hvdtpu-lint over the new obs files, a
 # 2-proc training smoke through the real launcher CLI with --trace

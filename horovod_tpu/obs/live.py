@@ -250,6 +250,9 @@ class LiveAggregator:
         autoscale = self._autoscale_part(views)
         if autoscale:
             parts.append(autoscale)
+        frontdoor = self._frontdoor_part()
+        if frontdoor:
+            parts.append(frontdoor)
         perf = self._perf_part(views)
         if perf:
             parts.append(perf)
@@ -423,6 +426,36 @@ class LiveAggregator:
         if pages_free is not None or pages_used is not None:
             token += (f" pages {int(pages_used or 0)}u/"
                       f"{int(pages_free or 0)}f")
+        return token
+
+    @staticmethod
+    def _frontdoor_part() -> Optional[str]:
+        """One digest token for the sharded front door (``frontdoor
+        2/2 up``, ``1/2 up 1 takeover`` after a kill): frontend count,
+        how many are alive, and the takeover total.  The FrontDoor runs
+        in the launcher process — its gauges live in the LAUNCHER-local
+        registry, not the rank views every other part merges — so this
+        part reads :func:`~..obs.registry.get_registry` directly.
+        Absent on training jobs and single-pump serving jobs that never
+        published ``serve.frontend.count``."""
+        from .registry import get_registry  # noqa: PLC0415
+
+        count = alive = takeovers = None
+        for m in get_registry().snapshot():
+            name = m.get("name")
+            if name == "serve.frontend.count":
+                count = int(float(m["value"]))
+            elif name == "serve.frontend.alive":
+                alive = int(float(m["value"]))
+            elif name == "serve.frontend.takeovers":
+                takeovers = int(float(m["value"]))
+        if count is None:
+            return None
+        token = f"frontdoor {alive if alive is not None else count}" \
+                f"/{count} up"
+        if takeovers:
+            token += (f" {takeovers} takeover"
+                      + ("s" if takeovers != 1 else ""))
         return token
 
     def _autoscale_part(self, views) -> Optional[str]:

@@ -234,15 +234,23 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
     for label in sorted(dumps, key=_rank_sort_key):
         vals = {}
         hists = {}
+        tenants: Dict[str, Dict[str, float]] = {}
         for m in dumps[label].get("metrics", []):
             name = m.get("name")
             if name in ("serve.admitted", "serve.evicted",
                         "serve.rejected", "serve.replayed",
                         "serve.steps", "serve.tokens_per_sec",
-                        "serve.admitted_while_busy",
+                        "serve.admitted_while_busy", "serve.frontends",
                         "serve.kv.waste_ratio", "serve.kv.page_size",
                         "serve.kv.page_free", "serve.kv.page_used"):
                 vals[name] = float(m["value"])
+            elif name in ("serve.tenant.throttled",
+                          "serve.tenant.admitted_tokens"):
+                t = (m.get("tags") or {}).get("tenant", "?")
+                short = ("throttled" if name.endswith("throttled")
+                         else "tokens")
+                bucket = tenants.setdefault(t, {})
+                bucket[short] = bucket.get(short, 0.0) + float(m["value"])
             elif name in ("serve.ttft_ms", "serve.tpot_ms") \
                     and m.get("count"):
                 hists[name] = m
@@ -257,6 +265,10 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
         )
         if vals.get("serve.replayed"):
             row += f", replayed {int(vals['serve.replayed'])}"
+        if vals.get("serve.frontends", 0) > 1:
+            # Sharded front door (PR-16): only worth a word when the
+            # log actually had more than one producer.
+            row += f", frontends {int(vals['serve.frontends'])}"
         if vals.get("serve.steps"):
             row += f", steps {int(vals['serve.steps'])}"
         if vals.get("serve.tokens_per_sec"):
@@ -282,6 +294,18 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
                     f" waste {vals['serve.kv.waste_ratio']:.2f}"
                 )
         rows.append(row)
+        if tenants:
+            # Tenant-QoS sub-row (PR-16): who got throttled and how
+            # many decode tokens each tenant was admitted — the
+            # "one tenant is starving the others" runbook starts here.
+            bits = []
+            for t in sorted(tenants):
+                b = tenants[t]
+                bits.append(
+                    f"{t} tok={int(b.get('tokens', 0))}"
+                    f" throttled={int(b.get('throttled', 0))}"
+                )
+            rows.append(f"rank {label} tenants: " + ", ".join(bits))
     return "\n".join(rows) if rows else None
 
 

@@ -100,6 +100,11 @@ _ARG_ENV_MAP = {
         envmod.SERVE_SWAP_POLL_STEPS,
         "serve.swap-poll-steps",
     ),
+    "serve_frontends": (envmod.SERVE_FRONTENDS, "serve.frontends"),
+    "serve_tenant_budget": (
+        envmod.SERVE_TENANT_BUDGET,
+        "serve.tenant-budget",
+    ),
     "serve_autoscale": (envmod.SERVE_AUTOSCALE, "serve.autoscale"),
     "max_workers": (envmod.MAX_WORKERS, "serve.max-workers"),
     "scale_up_queue": (envmod.SCALE_UP_QUEUE, "serve.scale-up-queue"),

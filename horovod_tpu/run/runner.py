@@ -313,6 +313,27 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "(HVDTPU_SERVE_SWAP_POLL_STEPS, default 16).",
     )
     serve.add_argument(
+        "--frontends", type=int, action=_StoreOverrideAction,
+        dest="serve_frontends", default=None,
+        help="Front-door shard count F (HVDTPU_SERVE_FRONTENDS, "
+             "default 1): F launcher-resident frontend pumps each own "
+             "the request-log partition crc32(rid) %% F; clients route "
+             "by the same pure hash.  A dead frontend's shards are "
+             "adopted by the lowest survivor (heartbeat takeover) and "
+             "the serving epoch is re-minted — in-flight requests "
+             "replay from the durable log with zero drops.",
+    )
+    serve.add_argument(
+        "--serve-tenant-budget", type=int, action=_StoreOverrideAction,
+        dest="serve_tenant_budget", default=None,
+        help="Tenant-aware admission (HVDTPU_SERVE_TENANT_BUDGET, "
+             "default off = plain FCFS): per-tenant token budget per "
+             "scheduling window.  Requests carry tenant + SLO class "
+             "(interactive/standard/batch); the scheduler admits by "
+             "deterministic weighted-fair queueing with budget "
+             "throttling, identically derived on every rank.",
+    )
+    serve.add_argument(
         "--serve-autoscale", action=_StoreTrueOverrideAction,
         dest="serve_autoscale", default=None,
         help="Load-driven autoscaling: the launcher watches the "
@@ -1174,6 +1195,8 @@ def launch_elastic_job(
     live_stats_secs: Optional[float] = None,
     live_history: Optional[str] = None,
     serve_ingest: bool = False,
+    serve_frontends: int = 1,
+    front_door=None,
 ) -> ElasticJobResult:
     """Elastic counterpart of :func:`launch_job`: per-rank failure
     detection (exit code + KV heartbeat + collective-path progress
@@ -1286,20 +1309,32 @@ def launch_elastic_job(
     )
 
     # Serving mode (--serve): the request front end rides the SAME
-    # rendezvous store — the launcher-resident ingest pump totally
-    # orders client submissions into the durable log rank 0 drains.
-    ingest_pump = None
-    if serve_ingest:
-        from ..serve.frontend import IngestPump  # noqa: PLC0415
+    # rendezvous store — the launcher-resident FRONT DOOR (F sharded
+    # ingest pumps + a heartbeat supervisor, serve/frontend.py) totally
+    # orders client submissions into the per-shard durable logs the
+    # serving leaders drain.  ``front_door``: a caller-constructed
+    # FrontDoor already wired to this store (ServeJob); the monitor
+    # adopts it for takeover handling without owning its lifecycle.
+    ingest_pump = front_door
+    owns_front_door = False
+    if serve_ingest and ingest_pump is None:
+        from ..serve.frontend import FrontDoor  # noqa: PLC0415
 
-        ingest_pump = IngestPump(kv_server)
+        ingest_pump = FrontDoor(kv_server,
+                                frontends=max(int(serve_frontends), 1))
         ingest_pump.start()
+        owns_front_door = True
         print(
             f"[serve] ingest endpoint http://{kv_addr} "
-            f"(signed KV protocol, scope serve/ — "
-            f"horovod_tpu.serve.ServeClient)",
+            f"({ingest_pump.frontends} frontend shard(s), signed KV "
+            f"protocol, scope serve/ — horovod_tpu.serve.ServeClient)",
             flush=True,
         )
+    if ingest_pump is not None and live_plane is not None:
+        # serve.frontend.* series are launcher-local (shard ownership,
+        # per-shard ingest counters, takeovers): expose them on the
+        # same /metrics page the worker gauges land on.
+        live_plane.add_render(ingest_pump.prometheus)
 
     from ..obs import get_registry  # noqa: PLC0415
     from ..obs.progress import ProgressPolicy  # noqa: PLC0415
@@ -1672,6 +1707,30 @@ def launch_elastic_job(
                     # replay in-flight work in the fresh epoch.
                     trace.append(("scale_down", epoch, tuple(victims)))
                     scaler.executed(decision, epoch, len(world))
+            if ingest_pump is not None \
+                    and not (set(finished) - released) \
+                    and set(world) <= set(procs.alive_ranks()):
+                # Frontend takeover -> epoch re-mint: a dead frontend's
+                # shards were adopted by a survivor; re-forming the
+                # serving world through EXACTLY the resize machinery
+                # makes every group replay from the durable per-shard
+                # logs — in-flight requests resume bitwise on course.
+                # Same stability guards as a resize: the events stay
+                # queued in the FrontDoor until the world is whole, so
+                # a takeover racing a failure respawn is processed
+                # after the respawn's epoch settles.
+                takeovers = ingest_pump.poll_takeover()
+                if takeovers:
+                    epoch += 1
+                    mint_epoch(epoch, world)
+                    for ev in takeovers:
+                        trace.append(("frontend_takeover", ev["fid"],
+                                      ev["owner"], epoch))
+                    LOG.warning(
+                        "elastic: %d frontend takeover(s); re-minted "
+                        "epoch %d for the serving world",
+                        len(takeovers), epoch,
+                    )
             if all(r in finished for r in world):
                 result.exit_codes = dict(finished)
                 result.epoch = epoch
@@ -1694,7 +1753,9 @@ def launch_elastic_job(
         procs.terminate()
         raise
     finally:
-        if ingest_pump is not None:
+        if ingest_pump is not None and owns_front_door:
+            # A caller-passed front door (ServeJob) outlives this
+            # launch — its owner stops it after collecting results.
             try:
                 ingest_pump.stop()
             except Exception:  # pragma: no cover - defensive
@@ -1837,6 +1898,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 live_stats_secs=getattr(args, "live_stats_secs", None),
                 live_history=getattr(args, "live_history_file", None),
                 serve_ingest=getattr(args, "serve", False),
+                serve_frontends=int(
+                    getattr(args, "serve_frontends", None)
+                    or envmod.env_int(envmod.SERVE_FRONTENDS, 1)
+                ),
             )
             return 0
         launch_job(

@@ -9,9 +9,11 @@ out of the pieces the training stack already trusts:
 * :mod:`.engine`    — compiled slot engine over the slot-based KV cache
   (models/decode.py): one ``decode_step`` shape for a churning mix,
   bucketed one-shot prefill for admissions.
-* :mod:`.frontend`  — request ingest + token streaming over the
-  launcher's HMAC-signed KV store; the launcher-resident ingest pump
-  totally orders arrivals into a durable log.
+* :mod:`.frontend`  — the sharded front door: F launcher-resident
+  frontend pumps (rid-hash partitioned, heartbeat-supervised with
+  takeover) totally order arrivals into per-shard durable logs over
+  the launcher's HMAC-signed KV store; token streaming back to
+  clients rides the same store.
 * :mod:`.service`   — the SPMD serving loop on the elastic launcher
   (dead ranks respawn and replay in-flight requests from the durable
   log; zero dropped requests) and the :class:`ServeJob` python driver.
@@ -45,10 +47,13 @@ from .autoscale import (  # noqa: F401
     AutoscaleConfig, AutoscalePolicy,
 )
 from .engine import SlotEngine  # noqa: F401
-from .frontend import IngestPump, ServeClient, validate_request  # noqa: F401
+from .frontend import (  # noqa: F401
+    FrontDoor, IngestPump, Rejection, RequestRejected, ServeClient,
+    validate_request,
+)
 from .hotswap import SwapManager, publish_weights  # noqa: F401
 from .paged import PagedKV, page_reject_reason, pages_for  # noqa: F401
 from .scheduler import (  # noqa: F401
-    ActiveSlot, Admission, Eviction, Request, SlotScheduler,
+    ActiveSlot, Admission, Eviction, Request, SlotScheduler, TenantQoS,
 )
 from .service import DEFAULT_SPEC, ServeJob, serve_worker  # noqa: F401

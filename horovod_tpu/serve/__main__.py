@@ -97,6 +97,12 @@ def parse_spec(argv=None) -> dict:
         spec["swap_poll_steps"] = pick(
             args.swap_poll_steps, envmod.SERVE_SWAP_POLL_STEPS, int, 16
         )
+    # Tenant-aware admission: fleet-wide (every rank must build the
+    # identical TenantQoS), so it travels the launcher-forwarded env
+    # like the model geometry does.
+    tenant_budget = pick(None, envmod.SERVE_TENANT_BUDGET, int, 0)
+    if tenant_budget:
+        spec["tenants"] = {"budget_tokens": tenant_budget}
     return spec
 
 

@@ -1,24 +1,40 @@
-"""Request front end: HTTP ingest on the launcher, streaming results.
+"""Request front door: sharded HTTP ingest on the launcher, streaming
+results.
 
 The serving plane reuses the launcher's HMAC-signed KV store
 (run/rendezvous.py) as its wire — the same plumbing that already
 carries rendezvous, heartbeats, live telemetry and checkpoint replicas.
-Three key families under the ``serve`` scope:
+Since ISSUE 16 the request plane is **sharded**: ``F`` frontend pumps
+(:class:`FrontDoor`) each own a rid-hash partition of the request log,
+so one frontend death strands nothing.  Key families under the
+``serve`` scope:
 
-* ``serve/req/<rid>``  — client submissions (signed PUT).  The HTTP
-  surface deliberately has no listing verb, so workers cannot drain
-  this directly; the launcher-resident :class:`IngestPump` (which owns
-  the store in-process, like the live aggregator) scans it and...
-* ``serve/log/<n>``    — ...rewrites each submission into a totally
-  ordered, immutable ingest log.  Rank 0 of the serving world drains
-  the log by sequence number and broadcasts each step's schedule to
-  its peers, so every rank admits identical requests in identical
-  order (the HVD001 invariant).  The log also IS the durable request
-  record elastic recovery replays from.
-* ``serve/out/<rid>``  — per-request streaming state, written by the
+* ``serve/req/<shard>/<rid>`` — client submissions (signed PUT).
+  Clients route by the pure hash ``crc32(rid) % F`` — the same
+  PYTHONHASHSEED-proof digest the sampling plane keys streams on — so
+  producer-side routing needs no coordination.  The HTTP surface
+  deliberately has no listing verb, so workers cannot drain this
+  directly; the launcher-resident shard pumps (which own the store
+  in-process, like the live aggregator) scan their partitions and...
+* ``serve/log/<shard>/<n>`` — ...rewrite each submission into a
+  per-shard, immutable ingest log with per-shard sequence numbers.
+  The interleave ``gkey = n * F + shard`` is the total order every
+  consumer derives identically; each serving group's leader drains the
+  partition ``gkey % groups == group`` (service.py).  The log also IS
+  the durable request record elastic recovery replays from.
+* ``serve/out/<rid>`` — per-request streaming state, written by the
   serving leader after every step: tokens emitted so far, done flag,
   admission/finish bookkeeping.  Clients poll it (signed GET) to
   stream tokens as they are generated.
+* ``serve/frontdoor`` — the shard-ownership doc (`{frontends, owners,
+  fd_epoch}`): clients read ``frontends`` once to route, workers read
+  it at epoch start to derive the interleave.
+* ``serve/fd/hb/<fid>`` — per-frontend heartbeat counters.  The
+  :class:`FrontDoor` supervisor declares a frontend dead when its beat
+  goes stale (or its thread dies), hands its shards to the lowest
+  surviving frontend, and surfaces a takeover event the elastic
+  monitor turns into a re-minted epoch (the PR-13 resize machinery) —
+  in-flight requests replay from the log with zero drops.
 
 ``serve/stop`` is the drain sentinel: the leader folds it into the
 step schedule, finishes everything in flight, and the world exits
@@ -31,25 +47,76 @@ import pickle
 import threading
 import time
 import uuid
-from typing import List, Optional, Sequence
+import zlib
+from typing import Dict, List, Optional, Sequence, Set
 
 from ..obs import trace as obs_trace
 from ..run.rendezvous import KVStoreClient
 from ..utils.logging import get_logger
+from .scheduler import SLO_CLASSES
 
 LOG = get_logger("serve.frontend")
 
 SCOPE = "serve"
 REQ_PREFIX = SCOPE + "/req/"
+LOG_PREFIX = SCOPE + "/log/"
+WATERMARK_PREFIX = SCOPE + "/log_watermark/"
+FRONTDOOR_KEY = "frontdoor"
+HEARTBEAT_PREFIX = "fd/hb/"
 
-__all__ = ["ServeClient", "IngestPump", "validate_request", "SCOPE"]
+__all__ = ["ServeClient", "IngestPump", "FrontDoor", "validate_request",
+           "Rejection", "RequestRejected", "shard_of", "SCOPE"]
+
+
+def shard_of(rid: str, frontends: int) -> int:
+    """The rid's front-door shard: ``crc32(rid) % F``.  Pure and
+    PYTHONHASHSEED-proof (never builtin ``hash()``), so the client, the
+    pumps, and every serving rank derive the same route."""
+    if frontends <= 1:
+        return 0
+    return zlib.crc32(rid.encode("utf-8")) % frontends
+
+
+class Rejection(str):
+    """A machine-readable reject verdict: a plain ``str`` (the human
+    message — drop-in for every call site that formatted the old bare
+    string) carrying a stable ``code`` for programmatic handling."""
+
+    code: str
+
+    def __new__(cls, code: str, message: str) -> "Rejection":
+        obj = super().__new__(cls, message)
+        obj.code = code
+        return obj
+
+    def __getnewargs__(self):
+        return (self.code, str(self))
+
+    @property
+    def message(self) -> str:
+        return str(self)
+
+
+class RequestRejected(RuntimeError):
+    """Raised by :meth:`ServeClient.result` when the server refused the
+    request; ``code`` is the machine-readable reason
+    (:func:`validate_request`), ``message`` the human one."""
+
+    def __init__(self, rid: str, code: str, message: str):
+        super().__init__(f"request {rid} rejected [{code}]: {message}")
+        self.rid = rid
+        self.code = code
+        self.message = message
 
 
 def validate_request(doc: dict, serve_len: int,
-                     vocab_size: Optional[int] = None) -> Optional[str]:
-    """Reject reason for an ingest-log entry, or None when servable.
+                     vocab_size: Optional[int] = None
+                     ) -> Optional[Rejection]:
+    """Reject verdict for an ingest-log entry, or None when servable.
     Pure — every rank applies it to the same log entry and reaches the
     same verdict (a rank-divergent reject would desync the schedule).
+    Returns a :class:`Rejection` (a str subclass), so existing
+    formatting keeps working while clients get a stable ``code``.
 
     ``serve_len`` is the engine's serving context cap
     (``min(cache_len, cfg.max_len)``): bounding against the raw cache
@@ -60,45 +127,85 @@ def validate_request(doc: dict, serve_len: int,
     garbage where this module's contract is a loud reject."""
     prompt = doc.get("prompt")
     if not isinstance(prompt, (list, tuple)) or not prompt:
-        return "empty or malformed prompt"
+        return Rejection("bad_prompt", "empty or malformed prompt")
     if not all(isinstance(t, int) and t >= 0 for t in prompt):
-        return "prompt tokens must be non-negative ints"
+        return Rejection("bad_token",
+                         "prompt tokens must be non-negative ints")
     if vocab_size is not None and any(t >= vocab_size for t in prompt):
-        return f"prompt token out of vocab (>= {vocab_size})"
+        return Rejection(
+            "oob_token", f"prompt token out of vocab (>= {vocab_size})"
+        )
     mnt = doc.get("max_new_tokens", 0)
     if not isinstance(mnt, int) or mnt < 1:
-        return "max_new_tokens must be >= 1"
+        return Rejection("bad_budget", "max_new_tokens must be >= 1")
     if len(prompt) + mnt > serve_len:
-        return (
+        return Rejection(
+            "ctx_exceeded",
             f"prompt ({len(prompt)}) + max_new_tokens ({mnt}) exceeds "
-            f"the {serve_len}-token serving context"
+            f"the {serve_len}-token serving context",
         )
     temp = doc.get("temperature", 0.0)
     if not isinstance(temp, (int, float)) or temp < 0:
-        return "temperature must be a number >= 0"
+        return Rejection("bad_temperature",
+                         "temperature must be a number >= 0")
     top_k = doc.get("top_k", 0)
     if not isinstance(top_k, int) or top_k < 0:
-        return "top_k must be an int >= 0"
+        return Rejection("bad_top_k", "top_k must be an int >= 0")
+    tenant = doc.get("tenant", "default")
+    if not isinstance(tenant, str) or not tenant or len(tenant) > 64 \
+            or "/" in tenant:
+        return Rejection(
+            "bad_tenant",
+            "tenant must be a non-empty str (<= 64 chars, no '/')",
+        )
+    slo = doc.get("slo", "standard")
+    if slo not in SLO_CLASSES:
+        return Rejection(
+            "bad_slo", f"slo must be one of {'/'.join(SLO_CLASSES)}"
+        )
     return None
 
 
 class ServeClient:
-    """Client half of the front end: submit prompts, stream tokens.
+    """Client half of the front door: submit prompts, stream tokens.
 
     Talks the signed KV protocol (the secret travels via
     ``HVDTPU_SECRET`` or the constructor), so any process holding the
     per-job secret can drive a serving job — the CI gates, bench.py's
     open-loop generator, and operator tooling all use this class.
+    Routing is client-side and coordination-free: one read of the
+    ``serve/frontdoor`` doc pins ``F``, then every submission routes by
+    ``crc32(rid) % F``.
     """
 
     def __init__(self, addr: str, secret: Optional[str] = None):
         self._kv = KVStoreClient(addr, secret)
+        self._frontends: Optional[int] = None
+
+    def frontends(self) -> int:
+        """Shard count ``F`` from the front-door doc (cached — the
+        count is fixed for the job's lifetime; only shard OWNERSHIP
+        moves on takeover, which routing is blind to by design)."""
+        if self._frontends is None:
+            raw = self._kv.get(SCOPE, FRONTDOOR_KEY)
+            if raw is None:
+                self._frontends = 1
+            else:
+                try:
+                    self._frontends = max(
+                        int(pickle.loads(raw).get("frontends", 1)), 1
+                    )
+                except Exception:
+                    self._frontends = 1
+        return self._frontends
 
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: int = 16,
                eos_id: Optional[int] = None,
                temperature: float = 0.0,
                top_k: int = 0,
+               tenant: str = "default",
+               slo: str = "standard",
                rid: Optional[str] = None) -> str:
         """Enqueue one generation request; returns its request id.
 
@@ -106,7 +213,14 @@ class ServeClient:
         truncates the candidate set); the stream is still deterministic
         — tokens are keyed on (rid, emission index, serve seed), so a
         resubmission with the SAME rid reproduces the same text and
-        elastic replay continues it bit-exactly (serve/sampling.py)."""
+        elastic replay continues it bit-exactly (serve/sampling.py).
+
+        ``tenant``/``slo`` feed the tenant-aware admission policy
+        (serve/scheduler.py TenantQoS): the tenant names the token
+        budget bucket, the slo class ("interactive" | "standard" |
+        "batch") the admission weight.  Both are validated server-side
+        (machine-readable reject on a bad value) and ignored when the
+        fleet runs without a QoS policy."""
         rid = rid or uuid.uuid4().hex[:16]
         doc = {
             "rid": rid,
@@ -115,12 +229,15 @@ class ServeClient:
             "eos_id": None if eos_id is None else int(eos_id),
             "temperature": float(temperature),
             "top_k": int(top_k),
+            "tenant": str(tenant),
+            "slo": str(slo),
             # Client-clock submit stamp: the trace waterfall's first
             # span (submit -> ingest) is measured against this; the
             # rid doubles as the request's trace id.
             "submit_t": time.time(),
         }
-        self._kv.put(SCOPE, f"req/{rid}", pickle.dumps(doc))
+        shard = shard_of(rid, self.frontends())
+        self._kv.put(SCOPE, f"req/{shard}/{rid}", pickle.dumps(doc))
         return rid
 
     def poll(self, rid: str) -> Optional[dict]:
@@ -129,18 +246,31 @@ class ServeClient:
         raw = self._kv.get(SCOPE, f"out/{rid}")
         return None if raw is None else pickle.loads(raw)
 
-    def result(self, rid: str, timeout: float = 120.0) -> dict:
-        """Block until the request finishes; raises RuntimeError when
-        the server rejected it (the reject reason is in the doc)."""
+    def result(self, rid: str, timeout: float = 120.0, *,
+               poll_floor: float = 0.02,
+               poll_cap: float = 0.5) -> dict:
+        """Block until the request finishes; raises
+        :class:`RequestRejected` when the server refused it (the
+        machine-readable code rides the exception) and TimeoutError on
+        the deadline.
+
+        Polling backs off exponentially from ``poll_floor`` to
+        ``poll_cap`` — the same fix ``KVStoreClient.wait`` got in PR 3,
+        so thousands of blocked clients cannot saturate a frontend
+        shard — and RESETS to the floor whenever the stream makes
+        progress (first doc, more tokens): an actively streaming
+        request is tracked closely, a queued one is polled gently."""
         deadline = time.monotonic() + timeout
         t_fetch0 = time.time()
-        delay = 0.02
+        delay = poll_floor
+        progress = -1
         while time.monotonic() < deadline:
             doc = self.poll(rid)
             if doc is not None and doc.get("done"):
                 if doc.get("error"):
-                    raise RuntimeError(
-                        f"request {rid} rejected: {doc['error']}"
+                    raise RequestRejected(
+                        rid, doc.get("error_code") or "rejected",
+                        doc["error"],
                     )
                 # Result-fetch span on the caller's clock (the bench /
                 # CI client runs in the launcher process, so this lands
@@ -150,8 +280,12 @@ class ServeClient:
                                        time.time(),
                                        tokens=len(doc.get("tokens", [])))
                 return doc
+            seen = -1 if doc is None else len(doc.get("tokens", ()))
+            if seen > progress:
+                progress = seen
+                delay = poll_floor
             time.sleep(delay)
-            delay = min(delay * 2, 0.25)
+            delay = min(delay * 2, poll_cap)
         raise TimeoutError(f"request {rid} not finished within {timeout}s")
 
     def stop(self) -> None:
@@ -160,36 +294,65 @@ class ServeClient:
         self._kv.put(SCOPE, "stop", b"1")
 
 
+class _FrontendKilled(Exception):
+    """Internal: an injected frontend death (FrontDoor.kill or the
+    ``frontend_beat:action=frontend_exit`` chaos point) — the pump
+    thread dies abruptly, mid-traffic, without draining."""
+
+
 class IngestPump:
-    """Launcher-resident ingest thread: scans ``serve/req/*`` on the
-    in-process store (the listing the HTTP surface deliberately lacks)
-    and appends each submission to the totally ordered ``serve/log/<n>``
-    the serving leader drains.
+    """One launcher-resident frontend pump: scans its owned request
+    shards (``serve/req/<s>/*`` — the listing the HTTP surface
+    deliberately lacks) and appends each submission to the per-shard
+    ingest log ``serve/log/<s>/<n>`` the serving leaders drain.
 
     Ordering within one scan round is by request id — arrival order
     inside a round is not observable from a dict snapshot, and a
     deterministic tiebreak beats a racy one.  Arrival wall time is
     stamped here (the launcher's clock), which is what ttft is measured
     against.
-    """
+
+    Standalone construction (``IngestPump(server)``) is the F=1 front
+    door minus supervision: one pump owning shard 0 and the GC duties —
+    the shape every pre-16 call site expects.  Under a
+    :class:`FrontDoor` each pump owns its own shard set (``gc=False``;
+    the door's GC pump sweeps), heartbeats every round, and can ADOPT a
+    dead sibling's shards mid-stream: adoption recovers the shard's
+    next sequence number from the surviving log keys and dedupes
+    against already-logged rids, so the crash window between a dead
+    pump's log-append and req-discard can never double-ingest."""
 
     def __init__(self, server, interval: float = 0.02,
-                 out_ttl_secs: Optional[float] = None):
+                 out_ttl_secs: Optional[float] = None, *,
+                 fid: int = 0, frontends: int = 1,
+                 shards: Optional[Sequence[int]] = None,
+                 gc: bool = True):
         from ..utils import env as envmod  # noqa: PLC0415
 
         self._server = server
         self._kv = KVStoreClient(f"127.0.0.1:{server.port}",
                                  server.secret)
+        self.fid = int(fid)
+        self.frontends = max(int(frontends), 1)
         self.interval = max(float(interval), 0.005)
         # Finished-output retention: a result doc whose log index fell
-        # below the leader's compaction watermark is kept this long for
+        # below its shard's compaction watermark is kept this long for
         # late client polls, then GC'd (see _gc_finished_outputs).
         self.out_ttl_secs = (
             float(out_ttl_secs) if out_ttl_secs is not None
             else envmod.env_float(envmod.SERVE_OUT_TTL,
                                   envmod.DEFAULT_SERVE_OUT_TTL)
         )
-        self._next = 0
+        self._lock = threading.Lock()
+        self._shards: List[int] = (
+            sorted(int(s) for s in shards) if shards is not None
+            else [self.fid]
+        )
+        self._next: Dict[int, int] = {}        # shard -> next log index
+        self._known: Dict[int, Set[str]] = {}  # shard -> logged rids
+        self.ingested_by_shard: Dict[int, int] = {}
+        self.beats = 0
+        self._gc_enabled = bool(gc)
         self._done_seen: dict = {}  # out key -> monotonic first-seen-done
         # The finished-output GC unpickles every live out doc, so it
         # runs on its own ~1s cadence, not the 20ms ingest tick (TTL
@@ -198,22 +361,93 @@ class IngestPump:
         self._gc_every = min(1.0, max(self.out_ttl_secs / 4, 0.01))
         self._next_gc = 0.0
         self._stop = threading.Event()
+        self._stopped = False   # deliberate stop() vs abrupt death
+        self._killed = False
         self._thread: Optional[threading.Thread] = None
 
     @property
     def ingested(self) -> int:
-        return self._next
+        return sum(self.ingested_by_shard.values())
+
+    @property
+    def shards(self) -> List[int]:
+        with self._lock:
+            return list(self._shards)
+
+    def adopt(self, shards: Sequence[int]) -> None:
+        """Take ownership of a dead sibling's shards (thread-safe; the
+        pump picks them up at its next round)."""
+        with self._lock:
+            for s in shards:
+                if int(s) not in self._shards:
+                    self._shards.append(int(s))
+            self._shards.sort()
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------------------ ingest
+
+    def _adopt_state(self, shard: int) -> None:
+        """Recover a shard's append cursor + dedup set from the store:
+        next index = max surviving log key + 1 (floored at the shard's
+        watermark), known rids = the uncompacted entries'.  Run on
+        first ownership AND on takeover — a fresh shard trivially
+        yields (watermark, empty)."""
+        nxt = 0
+        raw = self._server.scan(WATERMARK_PREFIX + str(shard))
+        try:
+            nxt = int(raw[WATERMARK_PREFIX + str(shard)].decode())
+        except (KeyError, ValueError):
+            pass
+        known: Set[str] = set()
+        for key, blob in self._server.scan(
+                f"{LOG_PREFIX}{shard}/").items():
+            try:
+                m = int(key.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            nxt = max(nxt, m + 1)
+            try:
+                known.add(pickle.loads(blob)["rid"])
+            except Exception:
+                continue
+        self._next[shard] = nxt
+        self._known[shard] = known
 
     def round(self) -> int:
-        """Move every pending submission into the log; returns how many.
-        Also garbage-collects dead-epoch serving scopes (see
-        :meth:`_gc_stale_epochs`) and compacted finished outputs (see
-        :meth:`_gc_finished_outputs`) — the pump is the one serving
-        component with in-process listing access to the store."""
-        self._gc_stale_epochs()
-        self._gc_finished_outputs()
-        pending = self._server.scan(REQ_PREFIX)
+        """Move every pending submission on the owned shards into their
+        logs; returns how many.  Also publishes this frontend's
+        heartbeat and (when this pump owns the GC duty) collects
+        dead-epoch serving scopes and compacted finished outputs."""
+        from ..testing.faults import maybe_fail  # noqa: PLC0415
+
+        # Deterministic chaos: the frontend analog of worker_exit —
+        # an advisory action the supervisor must notice via the stale
+        # heartbeat, not a cooperative shutdown.  step = THIS pump's
+        # 1-based beat counter (the shared per-point counter would
+        # interleave nondeterministically across F pumps).
+        if maybe_fail("frontend_beat", step=self.beats + 1,
+                      rank=self.fid) == "frontend_exit":
+            raise _FrontendKilled(f"frontend {self.fid}")
+        if self._gc_enabled:
+            self._gc_stale_epochs()
+            self._gc_finished_outputs()
         moved = 0
+        for shard in self.shards:
+            if shard not in self._next:
+                self._adopt_state(shard)
+            moved += self._pump_shard(shard)
+        self.beats += 1
+        if self.fid >= 0:
+            self._kv.put(SCOPE, f"{HEARTBEAT_PREFIX}{self.fid}",
+                         str(self.beats).encode())
+        return moved
+
+    def _pump_shard(self, shard: int) -> int:
+        pending = self._server.scan(f"{REQ_PREFIX}{shard}/")
+        moved = 0
+        known = self._known.setdefault(shard, set())
         for key in sorted(pending):
             try:
                 doc = pickle.loads(pending[key])
@@ -222,11 +456,31 @@ class IngestPump:
                 LOG.warning("dropping malformed submission %s", key)
                 self._server.discard([key])
                 continue
+            if rid in known:
+                # Already logged by the dead previous owner (it crashed
+                # between log-append and req-discard): finish its
+                # discard, never double-append.
+                self._server.discard([key])
+                continue
+            n = self._next.setdefault(shard, 0)
             doc["arrival"] = time.time()
-            doc["n"] = self._next
-            self._kv.put(SCOPE, f"log/{self._next}", pickle.dumps(doc))
-            self._next += 1
+            doc["shard"] = shard
+            doc["n"] = n
+            # The total order every consumer derives: per-shard
+            # sequence interleaved over the shard count.
+            doc["gkey"] = n * self.frontends + shard
+            self._kv.put(SCOPE, f"log/{shard}/{n}", pickle.dumps(doc))
+            self._next[shard] = n + 1
+            known.add(rid)
+            if len(known) > 4096:
+                # Bound the dedup set: re-derive it from the store (the
+                # compacted prefix left the replay set, so its rids can
+                # leave the dedup set too).
+                self._adopt_state(shard)
             moved += 1
+            self.ingested_by_shard[shard] = (
+                self.ingested_by_shard.get(shard, 0) + 1
+            )
             self._server.discard([key])
             # Launcher-side spans: submit -> ingest (client clock to
             # launcher clock — one host in practice) and the log
@@ -236,11 +490,13 @@ class IngestPump:
                 submit_t = float(doc.get("submit_t") or doc["arrival"])
                 obs_trace.add_span(rid, "ingest",
                                    min(submit_t, doc["arrival"]),
-                                   doc["arrival"], n=doc["n"])
+                                   doc["arrival"], n=doc["gkey"])
                 obs_trace.add_span(rid, "log_append", doc["arrival"],
-                                   time.time(), n=doc["n"])
-            LOG.debug("ingested request %s as log/%d", rid, doc["n"])
+                                   time.time(), n=doc["gkey"])
+            LOG.debug("ingested request %s as log/%d/%d", rid, shard, n)
         return moved
+
+    # ---------------------------------------------------------------- gc
 
     def _gc_stale_epochs(self) -> None:
         """Drop schedule/recovery keys from epochs older than the
@@ -270,9 +526,18 @@ class IngestPump:
             self._server.discard(doomed)
             LOG.debug("GC'd %d stale-epoch serving keys", len(doomed))
 
+    def _watermarks(self) -> Dict[int, int]:
+        marks: Dict[int, int] = {}
+        for key, blob in self._server.scan(WATERMARK_PREFIX).items():
+            try:
+                marks[int(key.rsplit("/", 1)[1])] = int(blob.decode())
+            except ValueError:
+                continue
+        return marks
+
     def _gc_finished_outputs(self) -> None:
         """Drop result docs of requests the leader's compaction
-        watermark already retired (their log keys are gone — recovery
+        watermarks already retired (their log keys are gone — recovery
         replay will never need them) once they have been done for
         ``out_ttl_secs``.  This is the second half of request-log
         compaction: without it ``serve/out/*`` still grows with total
@@ -283,21 +548,19 @@ class IngestPump:
         if time.monotonic() < self._next_gc:
             return
         self._next_gc = time.monotonic() + self._gc_every
-        raw = self._server.scan(SCOPE + "/log_watermark")
-        try:
-            watermark = int(
-                raw[SCOPE + "/log_watermark"].decode())
-        except (KeyError, ValueError):
+        marks = self._watermarks()
+        if not marks:
             return  # no compaction yet
-        # Orphan sweep: the leader publishes the watermark BEFORE
-        # deleting the retired log keys, so a crash between the two
-        # leaves below-watermark entries nobody will ever read (the
+        # Orphan sweep: the leader publishes each shard's watermark
+        # BEFORE deleting the retired log keys, so a crash between the
+        # two leaves below-watermark entries nobody will ever read (the
         # recovery scan starts at the watermark).  The pump is the one
         # component that can list them.
         orphans = []
-        for key in self._server.scan(SCOPE + "/log/"):
+        for key in self._server.scan(LOG_PREFIX):
             try:
-                if int(key.rsplit("/", 1)[1]) < watermark:
+                _, shard_s, n_s = key.rsplit("/", 2)
+                if int(n_s) < marks.get(int(shard_s), 0):
                     orphans.append(key)
             except ValueError:
                 continue
@@ -314,7 +577,9 @@ class IngestPump:
             except Exception:
                 continue
             n = doc.get("n")
-            if not doc.get("done") or n is None or int(n) >= watermark:
+            shard = int(doc.get("shard") or 0)
+            if not doc.get("done") or n is None \
+                    or int(n) >= marks.get(shard, 0):
                 continue
             first = self._done_seen.setdefault(key, now)
             if now - first >= self.out_ttl_secs:
@@ -329,9 +594,12 @@ class IngestPump:
             if key not in live:
                 self._done_seen.pop(key, None)
 
+    # --------------------------------------------------------- lifecycle
+
     def start(self) -> None:
         self._thread = threading.Thread(
-            target=self._loop, name="hvdtpu_serve_ingest", daemon=True
+            target=self._loop,
+            name=f"hvdtpu_serve_ingest_{self.fid}", daemon=True
         )
         self._thread.start()
 
@@ -339,15 +607,268 @@ class IngestPump:
         while not self._stop.wait(self.interval):
             try:
                 self.round()
+            except _FrontendKilled as exc:
+                LOG.warning("frontend pump died abruptly: %s", exc)
+                return  # no drain — the supervisor must take over
             except Exception as exc:  # pragma: no cover - defensive
                 LOG.warning("ingest round failed: %s", exc)
+
+    def kill(self) -> None:
+        """Abrupt, mid-stream death (chaos hook): the thread exits
+        without the final drain and WITHOUT marking a deliberate stop,
+        so the FrontDoor supervisor sees exactly what a crashed
+        frontend looks like."""
+        self._killed = True
+        self._stop.set()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if self._killed:
+            return
+        try:
+            self.round()  # drain what arrived before the stop
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+
+class FrontDoor:
+    """The sharded, supervised front door: ``F`` frontend pumps (one
+    per rid-hash shard), a GC pump, and a heartbeat supervisor that
+    survives any one frontend's death.
+
+    Lifecycle of a frontend death (:meth:`kill`, a crash, or the
+    ``frontend_beat:action=frontend_exit`` chaos point):
+
+    1. the supervisor notices the dead pump (thread down or heartbeat
+       counter stale past ``heartbeat_timeout``);
+    2. its shards are ADOPTED by the lowest surviving frontend
+       (deterministic), which recovers each shard's append cursor from
+       the surviving log keys and dedupes already-logged rids — no
+       drop, no double-ingest; with no survivor (F=1) a replacement
+       pump is spawned in place;
+    3. the ownership doc (``serve/frontdoor``) is re-published under a
+       bumped ``fd_epoch`` and a takeover event is queued;
+    4. the elastic monitor polls :meth:`poll_takeover` and re-mints the
+       serving world's rendezvous epoch — exactly the PR-13 resize
+       machinery — so every in-flight request replays from the durable
+       log, bitwise on course.
+
+    Clients never re-route: the rid hash names the SHARD, and shards
+    are immortal — only their owning pump changes."""
+
+    def __init__(self, server, frontends: int = 1,
+                 interval: float = 0.02,
+                 out_ttl_secs: Optional[float] = None,
+                 heartbeat_timeout: float = 2.0):
+        self._server = server
+        self._kv = KVStoreClient(f"127.0.0.1:{server.port}",
+                                 server.secret)
+        self.frontends = max(int(frontends), 1)
+        self.interval = max(float(interval), 0.005)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self._pumps: Dict[int, IngestPump] = {
+            fid: IngestPump(server, interval, out_ttl_secs, fid=fid,
+                            frontends=self.frontends, gc=False)
+            for fid in range(self.frontends)
+        }
+        # GC rides its own pump (no shards, no heartbeat): the duty
+        # must survive any frontend's death, so it cannot live on one.
+        self._gc_pump = IngestPump(server, max(interval * 5, 0.05),
+                                   out_ttl_secs, fid=-1,
+                                   frontends=self.frontends,
+                                   shards=(), gc=True)
+        self.owners: Dict[int, int] = {s: s
+                                       for s in range(self.frontends)}
+        self.fd_epoch = 0
+        self.takeovers = 0
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._beat_seen: Dict[int, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._publish_doc()
+        self._publish_gauges()
+
+    # ------------------------------------------------------------- state
+
+    def _publish_doc(self) -> None:
+        self._kv.put(SCOPE, FRONTDOOR_KEY, pickle.dumps({
+            "frontends": self.frontends,
+            "owners": dict(self.owners),
+            "fd_epoch": self.fd_epoch,
+        }))
+
+    def _publish_gauges(self) -> None:
+        from ..obs import get_registry  # noqa: PLC0415
+
+        reg = get_registry()
+        reg.gauge("serve.frontend.count").set(self.frontends)
+        reg.gauge("serve.frontend.alive").set(
+            sum(1 for p in self._pumps.values()
+                if p.alive() or p._thread is None and not p._killed)
+        )
+
+    @property
+    def ingested(self) -> int:
+        return (sum(p.ingested for p in self._pumps.values())
+                + self._gc_pump.ingested)
+
+    def stats(self) -> dict:
+        """Front-door provenance for bench records and tests:
+        per-shard ingest counters, ownership, takeover history."""
+        by_shard: Dict[int, int] = {}
+        for p in self._pumps.values():
+            for s, c in p.ingested_by_shard.items():
+                by_shard[s] = by_shard.get(s, 0) + c
+        return {
+            "frontends": self.frontends,
+            "owners": {int(k): int(v) for k, v in self.owners.items()},
+            "fd_epoch": self.fd_epoch,
+            "takeovers": self.takeovers,
+            "ingested_by_shard": {int(s): by_shard[s]
+                                  for s in sorted(by_shard)},
+        }
+
+    def prometheus(self) -> str:
+        """Launcher-local ``serve.frontend.*`` series for the live
+        plane's /metrics exposition (the same add_render lane the
+        autoscale controller uses — these series exist only on the
+        launcher, so worker snapshots never carry them)."""
+        s = self.stats()
+        lines = [
+            f"hvdtpu_serve_frontend_count {s['frontends']}",
+            f"hvdtpu_serve_frontend_takeovers {s['takeovers']}",
+            f"hvdtpu_serve_frontend_fd_epoch {s['fd_epoch']}",
+        ]
+        for fid in sorted(self._pumps):
+            up = 1 if self._pumps[fid].alive() else 0
+            lines.append(
+                f'hvdtpu_serve_frontend_up{{fid="{fid}"}} {up}')
+        for shard, count in s["ingested_by_shard"].items():
+            owner = s["owners"].get(shard, -1)
+            lines.append(
+                f'hvdtpu_serve_frontend_ingested'
+                f'{{shard="{shard}",owner="{owner}"}} {count}')
+        return "\n".join(lines) + "\n"
+
+    def poll_takeover(self) -> List[dict]:
+        """Drain queued takeover events (``{"fid", "owner", "shards"}``)
+        — the elastic monitor consumes these and re-mints the serving
+        epoch, one mint per event."""
+        with self._lock:
+            events, self._events = self._events, []
+            return events
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        for pump in self._pumps.values():
+            pump.start()
+        self._gc_pump.start()
+        self._thread = threading.Thread(
+            target=self._supervise, name="hvdtpu_serve_frontdoor",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def kill(self, fid: int) -> None:
+        """Chaos hook: abruptly kill frontend ``fid`` mid-stream (no
+        drain, no handoff) — the supervisor must detect it and the
+        surviving frontends must strand nothing."""
+        self._pumps[int(fid)].kill()
+
+    def _supervise(self) -> None:
+        tick = max(self.interval, 0.02)
+        while not self._stop.wait(tick):
+            try:
+                self._check_pumps()
+            except Exception as exc:  # pragma: no cover - defensive
+                LOG.warning("frontdoor supervisor tick failed: %s", exc)
+
+    def _check_pumps(self) -> None:
+        now = time.monotonic()
+        dead: List[int] = []
+        for fid, pump in sorted(self._pumps.items()):
+            if pump._stopped:
+                continue
+            if not pump.alive():
+                dead.append(fid)
+                continue
+            seen = self._beat_seen.get(fid)
+            if seen is None or seen[0] != pump.beats:
+                self._beat_seen[fid] = (pump.beats, now)
+            elif now - seen[1] > self.heartbeat_timeout:
+                LOG.warning(
+                    "frontend %d heartbeat stale > %.1fs; declaring "
+                    "it dead", fid, self.heartbeat_timeout,
+                )
+                pump.kill()
+                dead.append(fid)
+        for fid in dead:
+            self._takeover(fid)
+        if dead:
+            self._publish_gauges()
+
+    def _takeover(self, fid: int) -> None:
+        from ..obs import get_registry  # noqa: PLC0415
+
+        pump = self._pumps[fid]
+        shards = pump.shards
+        self._beat_seen.pop(fid, None)
+        survivors = [f for f, p in sorted(self._pumps.items())
+                     if f != fid and p.alive() and not p._stopped]
+        if survivors:
+            owner = survivors[0]
+            self._pumps[owner].adopt(shards)
+            # Retire the dead pump: its shards are re-owned, so the
+            # supervisor must not re-fire this takeover every tick.
+            pump._stopped = True
+        else:
+            # No survivor (F=1, or everyone died at once): spawn a
+            # replacement pump in place — the supervisor is the actor
+            # of last resort.
+            owner = fid
+            fresh = IngestPump(
+                self._server, self.interval, pump.out_ttl_secs,
+                fid=fid, frontends=self.frontends, shards=shards,
+                gc=False,
+            )
+            # The replacement inherits the corpse's ingest accounting:
+            # counters survive a respawn the way a rank's completed
+            # work survives an epoch — stats()/bench records must not
+            # read a death as traffic vanishing.
+            fresh.ingested_by_shard = dict(pump.ingested_by_shard)
+            self._pumps[fid] = fresh
+            fresh.start()
+        with self._lock:
+            for s in shards:
+                self.owners[s] = owner
+            self.fd_epoch += 1
+            self.takeovers += 1
+            self._events.append({"fid": fid, "owner": owner,
+                                 "shards": list(shards)})
+        self._publish_doc()
+        reg = get_registry()
+        reg.counter("serve.frontend.takeovers").inc()
+        LOG.warning("frontend %d dead; shards %s taken over by "
+                    "frontend %d (fd_epoch %d)", fid, shards, owner,
+                    self.fd_epoch)
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        for pump in self._pumps.values():
+            try:
+                pump.stop()
+            except Exception:  # pragma: no cover - defensive
+                pass
         try:
-            self.round()  # drain what arrived before the stop
+            self._gc_pump.stop()
         except Exception:  # pragma: no cover - defensive
             pass

@@ -32,10 +32,19 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
 
 __all__ = ["Request", "ActiveSlot", "Admission", "Eviction",
-           "SlotScheduler"]
+           "SlotScheduler", "TenantQoS", "SLO_CLASSES"]
+
+# The SLO vocabulary and its default admission weights: an
+# ``interactive`` head outranks a ``standard`` head outranks a
+# ``batch`` head, 8:4:1.  Pure data — the frontend validates the class
+# names (validate_request), the scheduler only weighs them.
+SLO_CLASSES: Tuple[str, ...] = ("interactive", "standard", "batch")
+_DEFAULT_WEIGHTS: Dict[str, int] = {
+    "interactive": 8, "standard": 4, "batch": 1,
+}
 
 
 @dataclass(frozen=True)
@@ -56,6 +65,12 @@ class Request:
     arrival: float = 0.0
     temperature: float = 0.0
     top_k: int = 0
+    # Multi-tenant QoS (pure data like temperature/top_k): ``tenant``
+    # names the budget bucket, ``slo`` the admission weight class.
+    # With qos=None the scheduler never reads either — the
+    # single-tenant path stays byte-identical FCFS.
+    tenant: str = "default"
+    slo: str = "standard"
 
     def __post_init__(self):
         if not self.prompt:
@@ -68,6 +83,17 @@ class Request:
             raise ValueError(
                 f"request {self.rid!r}: temperature must be >= 0"
             )
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise ValueError(
+                f"request {self.rid!r}: tenant must be a non-empty str"
+            )
+
+    @property
+    def cost(self) -> int:
+        """Admission cost in tokens — the same worst case the paged
+        pool commits (prompt + full budget), so one number drives both
+        capacity and tenant budgets."""
+        return len(self.prompt) + self.max_new_tokens
 
 
 @dataclass
@@ -115,6 +141,68 @@ class Eviction:
     resumed: int = 0  # replayed-prefix length (see ActiveSlot.resumed)
 
 
+class TenantQoS:
+    """Deterministic weighted-fair admission policy (ISSUE 16).
+
+    Pure configuration + arithmetic — every rank constructs an
+    identical instance from the job spec and the scheduler derives the
+    identical pick from it, so the HVD001/HVD012 determinism contract
+    extends through multi-tenant admission unchanged.  Three rules,
+    applied to the per-tenant FIFO heads of the queue:
+
+    1. **Budgets** — with ``budget_tokens`` set, a tenant whose spend
+       this window (admitted ``prompt + max_new_tokens``) would exceed
+       the budget is *throttled*: skipped, counted, resumed at the
+       next window.  Windows are serving-step-indexed
+       (``step // window_steps``), never wall clock — every rank
+       refills at the same broadcast step.
+    2. **SLO preemption** — among un-throttled heads, the highest
+       ``weights[slo]`` wins: an interactive head admits before a
+       batch head that arrived earlier.
+    3. **Weighted fairness** — within one weight class, the tenant
+       with the lowest *virtual time* wins; each admission advances
+       the winner's clock by ``cost / weight``, so long-run admitted
+       tokens converge to the weight ratio.  Ties break on arrival
+       (queue) order.
+
+    Honest limit: a tenant arriving late starts at virtual time 0 and
+    briefly wins its weight class until its clock catches up — the
+    window is bounded by one backlog's worth of cost, and the trade
+    (no global clock to maintain) keeps the policy a pure fold over
+    the admission sequence.
+    """
+
+    def __init__(self, weights: Optional[Dict[str, int]] = None,
+                 budget_tokens: Optional[int] = None,
+                 window_steps: int = 64):
+        self.weights = dict(_DEFAULT_WEIGHTS)
+        if weights:
+            self.weights.update({str(k): int(v)
+                                 for k, v in sorted(weights.items())})
+        if any(w < 1 for w in self.weights.values()):
+            raise ValueError("slo weights must be >= 1")
+        self.budget_tokens = (None if budget_tokens is None
+                              else int(budget_tokens))
+        if self.budget_tokens is not None and self.budget_tokens < 1:
+            raise ValueError("budget_tokens must be >= 1")
+        self.window_steps = max(int(window_steps), 1)
+
+    @classmethod
+    def from_spec(cls, cfg: Optional[dict]) -> Optional["TenantQoS"]:
+        """Build from the job spec's ``tenants`` dict (None/{} = off).
+        The spec travels to every rank identically (pickled func /
+        forwarded env), which is what makes the policy rank-identical
+        by construction."""
+        if not cfg:
+            return None
+        return cls(weights=cfg.get("weights"),
+                   budget_tokens=cfg.get("budget_tokens"),
+                   window_steps=int(cfg.get("window_steps") or 64))
+
+    def weight_of(self, slo: str) -> int:
+        return self.weights.get(slo, 1)
+
+
 class SlotScheduler:
     """The per-rank scheduling state machine.
 
@@ -131,12 +219,23 @@ class SlotScheduler:
     slot order.
     """
 
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int,
+                 qos: Optional[TenantQoS] = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         self.num_slots = num_slots
         self.queue: Deque[Tuple[Request, Tuple[int, ...]]] = deque()
         self.active: Dict[int, ActiveSlot] = {}
+        # Tenant-aware admission (TenantQoS); None keeps the original
+        # FCFS path byte-identical.  All the per-tenant state below is
+        # a pure fold over (enqueue order, admit(step) calls) — no
+        # clocks, no ranks, no unordered iteration (HVD012).
+        self.qos = qos
+        self.vtime: Dict[str, float] = {}     # weighted-fair clocks
+        self.spent: Dict[str, int] = {}       # window token spend
+        self.throttled: Dict[str, int] = {}   # cumulative throttles
+        self.admitted_tokens: Dict[str, int] = {}  # cumulative cost
+        self._window = -1
 
     # ------------------------------------------------------------ intake
 
@@ -172,21 +271,100 @@ class SlotScheduler:
         elastic replays.  The gate MUST be a deterministic function of
         the schedule so far (the engine's page accounting is), or ranks
         diverge — the HVD001 invariant extends through this callback.
+
+        With a :class:`TenantQoS` policy the pick is the qos-chosen
+        head (budget -> slo weight -> virtual time -> arrival) and
+        admission is head-strict on THAT head: when the chosen head
+        does not fit, admission stops — skipping past it would
+        re-introduce exactly the capacity-timing dependence and
+        big-request starvation strict FCFS exists to prevent.
         """
         out: List[Admission] = []
+        if self.qos is None:
+            for slot in self.free_slots():
+                if not self.queue:
+                    break
+                req, resume = self.queue[0]
+                if can_admit is not None and not can_admit(req, resume):
+                    break
+                self.queue.popleft()
+                self.active[slot] = ActiveSlot(req=req, slot=slot,
+                                               emitted=list(resume),
+                                               admitted_step=step,
+                                               resumed=len(resume))
+                out.append(Admission(slot=slot, req=req, resume=resume))
+            return out
+        self._maybe_refill(step)
+        throttled_this_call: Set[str] = set()
         for slot in self.free_slots():
             if not self.queue:
                 break
-            req, resume = self.queue[0]
+            pick = self._pick(throttled_this_call)
+            if pick is None:
+                break  # every queued tenant is over budget this window
+            req, resume = self.queue[pick]
             if can_admit is not None and not can_admit(req, resume):
                 break
-            self.queue.popleft()
+            del self.queue[pick]
+            w = self.qos.weight_of(req.slo)
+            self.vtime[req.tenant] = (
+                self.vtime.get(req.tenant, 0.0) + req.cost / w
+            )
+            self.spent[req.tenant] = (
+                self.spent.get(req.tenant, 0) + req.cost
+            )
+            self.admitted_tokens[req.tenant] = (
+                self.admitted_tokens.get(req.tenant, 0) + req.cost
+            )
             self.active[slot] = ActiveSlot(req=req, slot=slot,
                                            emitted=list(resume),
                                            admitted_step=step,
                                            resumed=len(resume))
             out.append(Admission(slot=slot, req=req, resume=resume))
         return out
+
+    def _maybe_refill(self, step: int) -> None:
+        """Step-indexed budget window: every rank calls admit() with
+        the same broadcast step, so every rank refills at the same
+        instant — the no-clocks budget refill."""
+        if self.qos is None or self.qos.budget_tokens is None:
+            return
+        win = step // self.qos.window_steps
+        if win != self._window:
+            self._window = win
+            self.spent = {}
+
+    def _pick(self, throttled_this_call: Set[str]) -> Optional[int]:
+        """Queue index of the next admission under the QoS rules, or
+        None when every queued tenant is throttled.  One forward scan:
+        each tenant's FIRST queued request is its head (per-tenant
+        FIFO), heads compete on (budget, slo weight, virtual time,
+        arrival order) — every input a pure function of the schedule
+        so far."""
+        assert self.qos is not None
+        budget = self.qos.budget_tokens
+        heads: Dict[str, int] = {}
+        for idx, (req, _) in enumerate(self.queue):
+            if req.tenant not in heads:
+                heads[req.tenant] = idx
+        best: Optional[Tuple[int, float, int]] = None
+        best_idx: Optional[int] = None
+        for tenant in sorted(heads):
+            idx = heads[tenant]
+            req = self.queue[idx][0]
+            if budget is not None and \
+                    self.spent.get(tenant, 0) + req.cost > budget:
+                if tenant not in throttled_this_call:
+                    throttled_this_call.add(tenant)
+                    self.throttled[tenant] = (
+                        self.throttled.get(tenant, 0) + 1
+                    )
+                continue
+            key = (-self.qos.weight_of(req.slo),
+                   self.vtime.get(tenant, 0.0), idx)
+            if best is None or key < best:
+                best, best_idx = key, idx
+        return best_idx
 
     # ---------------------------------------------------------- progress
 
@@ -239,6 +417,15 @@ class SlotScheduler:
     def idle(self) -> bool:
         return not self.queue and not self.active
 
+    def tenant_depths(self) -> Dict[str, int]:
+        """Queued requests per tenant (sorted tenant order) — the
+        ``serve.tenant.queued`` gauges.  Observability only; admission
+        never calls it."""
+        depths: Dict[str, int] = {}
+        for req, _ in self.queue:
+            depths[req.tenant] = depths.get(req.tenant, 0) + 1
+        return {t: depths[t] for t in sorted(depths)}
+
     def snapshot(self) -> List[dict]:
         """In-flight then queued requests as plain dicts (ascending
         slot order, then queue order) — introspection/debugging view.
@@ -253,6 +440,8 @@ class SlotScheduler:
                 "max_new_tokens": act.req.max_new_tokens,
                 "eos_id": act.req.eos_id,
                 "arrival": act.req.arrival,
+                "tenant": act.req.tenant,
+                "slo": act.req.slo,
                 "emitted": list(act.emitted),
                 "resumed": act.resumed,
             }
@@ -264,6 +453,8 @@ class SlotScheduler:
                 "max_new_tokens": req.max_new_tokens,
                 "eos_id": req.eos_id,
                 "arrival": req.arrival,
+                "tenant": req.tenant,
+                "slo": req.slo,
                 "emitted": list(resume),
             }
             for req, resume in self.queue

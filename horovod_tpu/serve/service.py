@@ -58,10 +58,11 @@ from ..obs import progress as obs_progress
 from ..obs import trace as obs_trace
 from ..testing.faults import maybe_fail
 from ..utils.logging import get_logger
-from .frontend import SCOPE, IngestPump, ServeClient, validate_request
+from .frontend import (SCOPE, FrontDoor, Rejection, ServeClient,
+                       validate_request)
 from .hotswap import VERSION_KEY, SwapManager
 from .paged import page_reject_reason
-from .scheduler import Request, SlotScheduler
+from .scheduler import Request, SlotScheduler, TenantQoS
 
 LOG = get_logger("serve")
 
@@ -143,6 +144,16 @@ DEFAULT_SPEC: Dict[str, Any] = {
     "stream_every": 4,       # publish token streams every N tokens
     "weights_dir": None,     # weight hot-swap source (None = off)
     "swap_poll_steps": 16,   # leader manifest-poll cadence (steps)
+    "frontends": 1,          # front-door shard count F: F ingest pumps
+                             # each owning the rid-hash partition
+                             # crc32(rid) % F (ServeJob / the launcher
+                             # publish the authoritative count in the
+                             # serve/frontdoor doc — workers read THAT)
+    "tenants": None,         # tenant-aware admission (TenantQoS.from_
+                             # spec): {"weights": {slo: w},
+                             # "budget_tokens": B, "window_steps": W};
+                             # None = plain FCFS, byte-identical to
+                             # the pre-QoS scheduler
 }
 
 
@@ -193,39 +204,72 @@ def _fetch(ctx, scope: str, key: str, what: str) -> bytes:
         time.sleep(0.005)
 
 
-def _build_recovery(kv, group: int = 0, groups: int = 1) -> dict:
-    """Replay the durable request record: the ingest log from the
-    finished watermark up, joined with each request's streamed tokens.
-    Only the (group) leader runs this — peers adopt its published doc,
-    so a log entry racing in mid-scan can never split the world's view.
-    In a width-sharded fleet each group's doc carries only ITS log
-    partition (``n % groups == group``); ``others`` maps the remaining
-    in-flight indices to their rids so group 0's leader (the global
-    leader) can advance the compaction watermark across groups.
+def _frontdoor_shape(kv) -> int:
+    """The front-door shard count ``F`` from the ownership doc the
+    launcher published (``serve/frontdoor``): the interleave constant
+    every consumer derives the total order from.  Fixed for the job's
+    lifetime (only shard OWNERSHIP moves on frontend takeover), so one
+    read at epoch start is safe.  Absent doc = the pre-16 single pump
+    = 1."""
+    raw = kv.get(SCOPE, "frontdoor")
+    if raw is None:
+        return 1
+    try:
+        return max(int(pickle.loads(raw).get("frontends", 1)), 1)
+    except Exception:
+        return 1
 
-    The watermark (``serve/log_watermark``) is the compaction floor the
-    leader advances as requests finish: every entry below it is done
-    and its log key deleted, so neither this replay nor the ingest
-    store grows with total requests ever served — only with what is
-    actually in flight (ROADMAP 1d).  ``weight_version`` is the durable
-    flip record the whole fleet converges on (hotswap.py's
-    single-version argument rests on every rank adopting THIS value at
-    epoch start)."""
-    raw = kv.get(SCOPE, "log_watermark")
-    watermark = int(raw.decode()) if raw is not None else 0
+
+def _build_recovery(kv, group: int = 0, groups: int = 1,
+                    frontends: int = 1) -> dict:
+    """Replay the durable request record: every front-door shard's
+    ingest log from that shard's finished watermark up, joined with
+    each request's streamed tokens and merged in ``gkey`` order
+    (``gkey = n * F + shard`` — the same interleave every rank
+    derives).  Only the (group) leader runs this — peers adopt its
+    published doc, so a log entry racing in mid-scan can never split
+    the world's view.  In a width-sharded fleet each group's doc
+    carries only ITS log partition (``gkey % groups == group``);
+    ``others`` maps the remaining in-flight ``(shard, n)`` slots to
+    their rids so group 0's leader (the global leader) can advance the
+    compaction watermarks across groups.
+
+    The per-shard watermark (``serve/log_watermark/<s>``) is the
+    compaction floor the leader advances as requests finish: every
+    entry below it is done and its log key deleted, so neither this
+    replay nor the ingest store grows with total requests ever served —
+    only with what is actually in flight (ROADMAP 1d).
+    ``weight_version`` is the durable flip record the whole fleet
+    converges on (hotswap.py's single-version argument rests on every
+    rank adopting THIS value at epoch start)."""
+    frontends = max(int(frontends), 1)
+    watermark: Dict[int, int] = {}
+    log_next: Dict[int, int] = {}
     docs = []
-    n = watermark
-    while True:
-        raw = kv.get(SCOPE, f"log/{n}")
-        if raw is None:
-            break
-        docs.append(pickle.loads(raw))
-        n += 1
+    for shard in range(frontends):
+        raw = kv.get(SCOPE, f"log_watermark/{shard}")
+        wm = int(raw.decode()) if raw is not None else 0
+        watermark[shard] = wm
+        n = wm
+        while True:
+            raw = kv.get(SCOPE, f"log/{shard}/{n}")
+            if raw is None:
+                break
+            doc = pickle.loads(raw)
+            doc.setdefault("shard", shard)
+            doc.setdefault("n", n)
+            doc.setdefault("gkey", n * frontends + shard)
+            docs.append(doc)
+            n += 1
+        log_next[shard] = n
+    # The schedule replays in the SAME total order live ingest would
+    # have produced — the interleave, not per-shard concatenation.
+    docs.sort(key=lambda d: d["gkey"])
     inflight = []
-    done_ns: List[int] = []
-    others: Dict[int, str] = {}
-    for idx, doc in enumerate(docs):
-        doc_n = int(doc.get("n", watermark + idx))
+    done_slots: List[Tuple[int, int]] = []
+    others: Dict[Tuple[int, int], str] = {}
+    for doc in docs:
+        slot = (int(doc["shard"]), int(doc["n"]))
         out_raw = kv.get(SCOPE, f"out/{doc['rid']}")
         emitted: List[int] = []
         if out_raw is not None:
@@ -233,22 +277,23 @@ def _build_recovery(kv, group: int = 0, groups: int = 1) -> dict:
             if out.get("done"):
                 # Finished (or rejected) before the break: only its
                 # compaction bookkeeping survives into the new epoch.
-                done_ns.append(doc_n)
+                done_slots.append(slot)
                 continue
             emitted = list(out.get("tokens", []))
-        if doc_n % groups != group:
+        if int(doc["gkey"]) % groups != group:
             # Another group's request: irrelevant to this group's
             # schedule, but the global leader tracks it for compaction.
-            others[doc_n] = doc["rid"]
+            others[slot] = doc["rid"]
             continue
         entry = dict(doc)
         entry["emitted"] = emitted
         inflight.append(entry)
     raw = kv.get(SCOPE, VERSION_KEY)
     version = int(raw.decode()) if raw is not None else 0
-    return {"log_next": n, "inflight": inflight,
-            "watermark": watermark, "done_ns": done_ns,
-            "others": others, "weight_version": version}
+    return {"log_next": log_next, "inflight": inflight,
+            "watermark": watermark, "done_slots": done_slots,
+            "others": others, "weight_version": version,
+            "frontends": frontends}
 
 
 def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
@@ -256,6 +301,7 @@ def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
                  finished_step: Optional[int] = None,
                  reason: Optional[str] = None,
                  n: Optional[int] = None,
+                 shard: Optional[int] = None,
                  t_done: Optional[float] = None) -> None:
     doc = {
         "rid": rid,
@@ -264,6 +310,10 @@ def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
         "epoch": epoch,
         "admitted_step": admitted_step,
     }
+    if isinstance(error, Rejection):
+        # Machine-readable reject code rides the doc next to the human
+        # message; ServeClient.result re-raises it as RequestRejected.
+        doc["error_code"] = error.code
     if t_done is not None:
         # Leader-clock completion stamp: lets a measuring client
         # compute throughput from server-side stamps instead of its
@@ -277,9 +327,12 @@ def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
     if reason is not None:
         doc["reason"] = reason
     if n is not None:
-        # Log index: the ingest pump's finished-output GC keys its
-        # watermark comparison on this (frontend._gc_finished_outputs).
+        # Log slot (shard, per-shard index): the ingest pump's
+        # finished-output GC keys its per-shard watermark comparison on
+        # these (frontend._gc_finished_outputs).
         doc["n"] = int(n)
+    if shard is not None:
+        doc["shard"] = int(shard)
     kv.put(SCOPE, f"out/{rid}", pickle.dumps(doc))
 
 
@@ -352,67 +405,90 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
     # partition (n % groups) makes their replays disjoint.
     t_rec0 = time.time()
     if is_leader:
-        rec = _build_recovery(ctx.kv, group, groups)
+        rec = _build_recovery(ctx.kv, group, groups,
+                              _frontdoor_shape(ctx.kv))
         ctx.kv.put(scope, f"recovery/{group}", pickle.dumps(rec))
     else:
         rec = pickle.loads(_fetch(ctx, scope, f"recovery/{group}",
                                   f"recovery doc for epoch {epoch}"))
+    # The interleave constant travels in the recovery doc: every rank
+    # of the group derives the shard merge from the LEADER's read of
+    # the front-door doc, not its own racy one.
+    frontends = max(int(rec.get("frontends", 1)), 1)
+    reg.gauge("serve.frontends").set(frontends)
     # Every rank converges on the durable weight version BEFORE any
     # replay prefill — a replayed request's rebuilt cache must be
     # computed under the version the new epoch serves.
     if swap is not None:
         swap.reset_epoch()
         swap.ensure_version(engine, rec.get("weight_version", 0))
-    sched = SlotScheduler(spec["num_slots"])
+    # Tenant-aware admission (spec["tenants"], TenantQoS.from_spec):
+    # the policy object is a pure function of the spec, so every rank
+    # of every group builds the identical one — the HVD012 determinism
+    # contract extends from the scheduler through its policy.
+    sched = SlotScheduler(spec["num_slots"],
+                          qos=TenantQoS.from_spec(spec.get("tenants")))
     engine.reset()
-    log_next = rec["log_next"]
+    log_next: Dict[int, int] = {int(s): int(n) for s, n in
+                                rec["log_next"].items()}
     # Request-log compaction (global-leader-only writes, like every
-    # other durable-record write): log index of every in-flight
-    # request, the done set above the watermark, and the watermark
-    # itself.  ``other_rids`` maps the OTHER groups' in-flight indices
-    # to rids — the global leader cannot see their evictions directly,
-    # so it advances past them by polling their published done docs
-    # (one O(1) KV get per head-of-watermark candidate per step).
-    n_of: Dict[str, int] = {}
-    done_ns = set(rec.get("done_ns", []))
-    other_rids: Dict[int, str] = {int(k): v for k, v in
-                                  rec.get("others", {}).items()}
-    watermark = rec.get("watermark", 0)
+    # other durable-record write): the (shard, n) log slot of every
+    # in-flight request, the done set above the per-shard watermarks,
+    # and the watermarks themselves.  ``other_rids`` maps the OTHER
+    # groups' in-flight slots to rids — the global leader cannot see
+    # their evictions directly, so it advances past them by polling
+    # their published done docs (one O(1) KV get per head-of-watermark
+    # candidate per shard per step).
+    n_of: Dict[str, Tuple[int, int]] = {}
+    done_slots = {(int(s), int(n))
+                  for s, n in rec.get("done_slots", [])}
+    other_rids: Dict[Tuple[int, int], str] = {
+        (int(k[0]), int(k[1])): v
+        for k, v in rec.get("others", {}).items()
+    }
+    watermark: Dict[int, int] = {int(s): int(w) for s, w in
+                                 rec.get("watermark", {}).items()}
 
     def _advance_watermark() -> None:
-        """Global-leader bookkeeping: fold finished log indices into
-        the watermark, push the new floor durably, THEN delete the
-        compacted log keys (a crash between the two leaves orphan
-        entries below the floor — harmless — never a floor above
-        surviving entries).  Indices owned by other groups advance
-        when their done doc is visible."""
-        nonlocal watermark
-        old = watermark
-        while True:
-            if watermark in done_ns:
-                done_ns.discard(watermark)
-                other_rids.pop(watermark, None)
-                watermark += 1
-                continue
-            rid = other_rids.get(watermark)
-            if rid is not None:
-                raw = ctx.kv.get(SCOPE, f"out/{rid}")
-                if raw is not None and pickle.loads(raw).get("done"):
-                    other_rids.pop(watermark)
-                    watermark += 1
+        """Global-leader bookkeeping, now per front-door shard: fold
+        finished log slots into each shard's watermark, push the new
+        floor durably, THEN delete the compacted log keys (a crash
+        between the two leaves orphan entries below the floor —
+        harmless, the pump's GC sweeps them — never a floor above
+        surviving entries).  Slots owned by other groups advance when
+        their done doc is visible."""
+        for shard in sorted(watermark):
+            old = watermark[shard]
+            mark = old
+            while True:
+                slot = (shard, mark)
+                if slot in done_slots:
+                    done_slots.discard(slot)
+                    other_rids.pop(slot, None)
+                    mark += 1
                     continue
-            break
-        if watermark > old:
-            ctx.kv.put(SCOPE, "log_watermark",
-                       str(watermark).encode())
-            for i in range(old, watermark):
-                ctx.kv.delete(SCOPE, f"log/{i}")
-            reg.gauge("serve.log_watermark").set(watermark)
+                rid = other_rids.get(slot)
+                if rid is not None:
+                    raw = ctx.kv.get(SCOPE, f"out/{rid}")
+                    if raw is not None and \
+                            pickle.loads(raw).get("done"):
+                        other_rids.pop(slot)
+                        mark += 1
+                        continue
+                break
+            if mark > old:
+                watermark[shard] = mark
+                ctx.kv.put(SCOPE, f"log_watermark/{shard}",
+                           str(mark).encode())
+                for i in range(old, mark):
+                    ctx.kv.delete(SCOPE, f"log/{shard}/{i}")
+        # One compaction gauge across shards: total retired entries.
+        reg.gauge("serve.log_watermark").set(sum(watermark.values()))
 
     def _mark_done(rid: str) -> None:
-        n = n_of.pop(rid, None)
-        if n is not None:
-            done_ns.add(n)
+        slot = n_of.pop(rid, None)
+        if slot is not None:
+            done_slots.add(slot)
         if is_global:
             _advance_watermark()
 
@@ -439,7 +515,17 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             arrival=entry.get("arrival", 0.0),
             temperature=float(entry.get("temperature") or 0.0),
             top_k=int(entry.get("top_k") or 0),
+            tenant=str(entry.get("tenant") or "default"),
+            slo=str(entry.get("slo") or "standard"),
         )
+
+    def _entry_slot(entry) -> Optional[Tuple[int, int]]:
+        """The entry's durable log slot ``(shard, n)`` — the compaction
+        bookkeeping key (legacy docs without a shard stamp are shard
+        0's, the only shard a pre-16 store ever had)."""
+        if entry.get("n") is None:
+            return None
+        return (int(entry.get("shard") or 0), int(entry["n"]))
 
     # Admission capacity in FREE PAGES (paged mode): each round's gate
     # accumulates its own acceptances, so two same-round admissions are
@@ -459,13 +545,14 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             if is_leader:
                 _publish_out(ctx.kv, entry["rid"], tokens=(), done=True,
                              epoch=epoch, admitted_step=0, error=reason,
-                             n=entry.get("n"))
-                if entry.get("n") is not None:
-                    n_of[entry["rid"]] = int(entry["n"])
+                             n=entry.get("n"),
+                             shard=entry.get("shard"))
+                if _entry_slot(entry) is not None:
+                    n_of[entry["rid"]] = _entry_slot(entry)
                     _mark_done(entry["rid"])
             continue
-        if is_leader and entry.get("n") is not None:
-            n_of[entry["rid"]] = int(entry["n"])
+        if is_leader and _entry_slot(entry) is not None:
+            n_of[entry["rid"]] = _entry_slot(entry)
         sched.enqueue(_entry_request(entry),
                       resume=entry.get("emitted", ()))
         if entry.get("emitted"):
@@ -487,6 +574,11 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
 
     step = 0
     rate_win = RateWindow()
+    # Registry counters persist across epochs while sched state does
+    # not: these epoch-local cursors turn the scheduler's cumulative
+    # per-tenant numbers into counter increments exactly once.
+    tenant_prev_throttled: Dict[str, int] = {}
+    tenant_prev_admitted: Dict[str, int] = {}
     # rid-keyed decode-window starts for the per-N-token decode spans:
     # (wall t, tokens emitted at window start).
     dspan: Dict[int, Tuple[float, int]] = {}
@@ -527,43 +619,54 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
         # keeps its partition n % groups == group; its peers follow) --
         if is_leader:
             new_entries = []
-            # Log probe: one KV get per step minimum.  When the local
-            # queue already holds waiting work, new arrivals cannot
-            # change THIS step's admissions (FCFS — they join behind
+            # Log probe: one KV get per shard per step minimum.  When
+            # the local queue already holds waiting work, new arrivals
+            # cannot change THIS step's admissions (they join behind
             # the queue), so probe every 4th step; total order is the
-            # log's either way.  An empty queue probes every step:
-            # that is the latency-sensitive case.
+            # gkey interleave's either way.  An empty queue probes
+            # every step: that is the latency-sensitive case.
             probe = sched.queue_depth == 0 or step % 4 == 0
-            while probe:
-                raw = ctx.kv.get(SCOPE, f"log/{log_next}")
-                if raw is None:
-                    if groups > 1 and not is_global:
-                        # The GLOBAL leader compacts log keys the
-                        # moment the contiguous prefix is done — keys
-                        # THIS group's lagging cursor may not have
-                        # scanned yet.  A gap at log_next therefore
-                        # means either "end of log" or "compacted
-                        # under me": re-read the watermark and jump
-                        # over the deleted range, or this group's
-                        # cursor polls a deleted key forever and its
-                        # partition starves.
-                        raw_wm = ctx.kv.get(SCOPE, "log_watermark")
-                        wm = (int(raw_wm.decode())
-                              if raw_wm is not None else 0)
-                        if wm > log_next:
-                            log_next = wm
-                            continue
-                    break
-                doc = pickle.loads(raw)
-                doc_n = int(doc.get("n", log_next))
-                if doc_n % groups == group:
-                    new_entries.append(doc)
-                elif is_global:
-                    # Another group's request: remember its rid so the
-                    # compaction watermark can advance past it once its
-                    # done doc lands.
-                    other_rids[doc_n] = doc["rid"]
-                log_next += 1
+            for shard in (sorted(log_next) if probe else ()):
+                while True:
+                    cursor = log_next[shard]
+                    raw = ctx.kv.get(SCOPE, f"log/{shard}/{cursor}")
+                    if raw is None:
+                        if groups > 1 and not is_global:
+                            # The GLOBAL leader compacts log keys the
+                            # moment a shard's contiguous prefix is
+                            # done — keys THIS group's lagging cursor
+                            # may not have scanned yet.  A gap at the
+                            # cursor therefore means either "end of
+                            # shard log" or "compacted under me":
+                            # re-read the shard's watermark and jump
+                            # over the deleted range, or this group's
+                            # cursor polls a deleted key forever and
+                            # its partition starves.
+                            raw_wm = ctx.kv.get(
+                                SCOPE, f"log_watermark/{shard}")
+                            wm = (int(raw_wm.decode())
+                                  if raw_wm is not None else 0)
+                            if wm > cursor:
+                                log_next[shard] = wm
+                                continue
+                        break
+                    doc = pickle.loads(raw)
+                    doc.setdefault("shard", shard)
+                    doc.setdefault("n", cursor)
+                    doc.setdefault("gkey",
+                                   cursor * frontends + shard)
+                    if int(doc["gkey"]) % groups == group:
+                        new_entries.append(doc)
+                    elif is_global:
+                        # Another group's request: remember its rid so
+                        # the compaction watermark can advance past it
+                        # once its done doc lands.
+                        other_rids[(shard, cursor)] = doc["rid"]
+                    log_next[shard] = cursor + 1
+            # Shard scans are sequential; the schedule's enqueue order
+            # is the gkey interleave, identical on every rank and
+            # every replay.
+            new_entries.sort(key=lambda d: d["gkey"])
             if not stop_latched and (not was_busy or step % 8 == 0):
                 stop_latched = ctx.kv.get(SCOPE, "stop") is not None
             sdoc = {"new": new_entries, "stop": stop_latched}
@@ -603,13 +706,14 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                     _publish_out(ctx.kv, entry["rid"], tokens=(),
                                  done=True, epoch=epoch,
                                  admitted_step=0, error=reason,
-                                 n=entry.get("n"))
-                    if entry.get("n") is not None:
-                        n_of[entry["rid"]] = int(entry["n"])
+                                 n=entry.get("n"),
+                                 shard=entry.get("shard"))
+                    if _entry_slot(entry) is not None:
+                        n_of[entry["rid"]] = _entry_slot(entry)
                         _mark_done(entry["rid"])
                 continue
-            if is_leader and entry.get("n") is not None:
-                n_of[entry["rid"]] = int(entry["n"])
+            if is_leader and _entry_slot(entry) is not None:
+                n_of[entry["rid"]] = _entry_slot(entry)
             sched.enqueue(_entry_request(entry))
 
         # -- admissions: queued -> free slots (and, in paged mode,
@@ -777,11 +881,15 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                                  admitted_step=act.admitted_step)
         for ev in evictions:
             if is_leader:
+                slot_ref = n_of.get(ev.rid)
                 _publish_out(ctx.kv, ev.rid, tokens=ev.tokens,
                              done=True, epoch=epoch,
                              admitted_step=ev.admitted_step,
                              finished_step=step, reason=ev.reason,
-                             n=n_of.get(ev.rid), t_done=time.time())
+                             n=None if slot_ref is None else slot_ref[1],
+                             shard=(None if slot_ref is None
+                                    else slot_ref[0]),
+                             t_done=time.time())
                 # Done doc durably published -> this log index can
                 # leave the replay set; the watermark advances and the
                 # compacted log keys are deleted.
@@ -824,6 +932,37 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                                active=len(active))
         reg.gauge("serve.queue_depth").set(sched.queue_depth)
         reg.gauge("serve.active_slots").set(sched.active_slots)
+        if sched.qos is not None and busy:
+            # Per-tenant plane (tagged series): queue depth now, plus
+            # throttle/admission counters advanced by the scheduler's
+            # cumulative state (epoch-local) — deltas land in both the
+            # registry (for /metrics + --stats-summary) and totals
+            # (for the drain summary, which must span epochs).
+            for tenant, depth in sched.tenant_depths().items():
+                reg.gauge("serve.tenant.queued",
+                          tenant=tenant).set(depth)
+            for tenant in sorted(sched.throttled):
+                delta = sched.throttled[tenant] \
+                    - tenant_prev_throttled.get(tenant, 0)
+                if delta:
+                    tenant_prev_throttled[tenant] = \
+                        sched.throttled[tenant]
+                    reg.counter("serve.tenant.throttled",
+                                tenant=tenant).inc(delta)
+                    totals["tenant_throttled"][tenant] = \
+                        totals["tenant_throttled"].get(tenant, 0) \
+                        + delta
+            for tenant in sorted(sched.admitted_tokens):
+                delta = sched.admitted_tokens[tenant] \
+                    - tenant_prev_admitted.get(tenant, 0)
+                if delta:
+                    tenant_prev_admitted[tenant] = \
+                        sched.admitted_tokens[tenant]
+                    reg.counter("serve.tenant.admitted_tokens",
+                                tenant=tenant).inc(delta)
+                    totals["tenant_admitted_tokens"][tenant] = \
+                        totals["tenant_admitted_tokens"].get(
+                            tenant, 0) + delta
         # KV occupancy: what the fixed-row pool reserves for the busy
         # slots vs the positions they actually wrote — the waste paged
         # attention (ROADMAP 1) will reclaim.  Rides the loop's
@@ -881,7 +1020,25 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 "admitted_while_busy": int(
                     reg.counter("serve.admitted_while_busy").value
                 ),
+                "frontends": frontends,
             }
+            if sched.qos is not None:
+                # Per-tenant accounting across every epoch this rank
+                # lived through: what the noisy-tenant gate asserts
+                # the flooder was throttled by.
+                tenants = sorted(
+                    set(totals["tenant_throttled"])
+                    | set(totals["tenant_admitted_tokens"])
+                )
+                out["tenants"] = {
+                    t: {
+                        "throttled":
+                            totals["tenant_throttled"].get(t, 0),
+                        "admitted_tokens":
+                            totals["tenant_admitted_tokens"].get(t, 0),
+                    }
+                    for t in tenants
+                }
             if swap is not None:
                 # Every rank reports the version it drained on — the
                 # single-version chaos gate asserts these agree.
@@ -1006,7 +1163,8 @@ def serve_worker(spec: Optional[dict] = None):
               "kv_busy_steps": 0, "kv_waste_sum": 0.0,
               "kv_contig_waste_sum": 0.0,
               "kv_alloc_peak": 0, "done_rids": set(),
-              "admitted_rids": set()}
+              "admitted_rids": set(),
+              "tenant_throttled": {}, "tenant_admitted_tokens": {}}
     from ..exceptions import RankDroppedError  # noqa: PLC0415
 
     while True:
@@ -1093,13 +1251,25 @@ class ServeJob:
         )
         self._server = KVStoreServer()
         self._server.start()
-        self._pump = IngestPump(self._server)
+        # The sharded front door: F ingest pumps (spec["frontends"])
+        # plus the heartbeat supervisor that survives any one pump's
+        # death by handing its shards to the lowest survivor.
+        self._pump = FrontDoor(
+            self._server,
+            frontends=int(self.spec.get("frontends") or 1),
+        )
         self.addr = f"127.0.0.1:{self._server.port}"
         self.client = ServeClient(self.addr, self._server.secret)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._results: Optional[Dict[int, Any]] = None
         self._job = None
+
+    @property
+    def front_door(self) -> FrontDoor:
+        """The sharded ingest plane (chaos hooks ``kill(fid)`` /
+        ``poll_takeover()`` and the per-shard ``stats()`` live here)."""
+        return self._pump
 
     @property
     def port(self) -> int:
@@ -1126,6 +1296,7 @@ class ServeJob:
                 job = launch_elastic_job(
                     [sys.executable, "-m", "horovod_tpu.elastic.worker"],
                     self.np, kv_server=self._server, env=self._env,
+                    front_door=self._pump,
                     **self._launch_kw,
                 )
                 results: Dict[int, Any] = {}

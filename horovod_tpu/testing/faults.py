@@ -72,8 +72,13 @@ Grammar::
   ``obs.memplane.alloc_guard``) to raise a backend-shaped
   RESOURCE_EXHAUSTED — the deterministic out-of-device-memory input
   the OOM black box (``mem.oom`` flight-recorder event + post-mortem
-  memory verdict) is chaos-tested against.  ``worker_exit``/``task_fn``
-  points default to ``exit``.
+  memory verdict) is chaos-tested against; ``frontend_exit`` instructs
+  a front-door ingest pump (serve/frontend.py, point ``frontend_beat``
+  — fired at the top of each pump round, with the pump's frontend id
+  as the rank and its beat counter as the step) to die abruptly
+  mid-stream without draining — the deterministic frontend death the
+  heartbeat-takeover chaos gate is tested against.
+  ``worker_exit``/``task_fn`` points default to ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
 * ``name`` — only fire when the call site passes a matching ``name=``
@@ -103,6 +108,7 @@ _ADVISORY_POINTS = {
     "swap_abort": ("swap_commit",),
     "scale_fail": ("scale_admit",),
     "oom": ("mem_alloc",),
+    "frontend_exit": ("frontend_beat",),
 }
 
 
@@ -186,7 +192,7 @@ def parse_spec(raw: str) -> List[FaultSpec]:
                 if value not in ("raise", "exit", "abort", "hang", "delay",
                                  "corrupt_write", "drop_replica",
                                  "trace_drop", "swap_abort",
-                                 "scale_fail", "oom"):
+                                 "scale_fail", "oom", "frontend_exit"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -313,7 +319,8 @@ def maybe_fail(
             detail=f"{spec.action}:{spec.describe()}",
         )
         if spec.action in ("corrupt_write", "drop_replica", "trace_drop",
-                           "swap_abort", "scale_fail", "oom"):
+                           "swap_abort", "scale_fail", "oom",
+                           "frontend_exit"):
             # Advisory actions: the call site owns the I/O, so the
             # registry can only instruct it — corrupt the payload it is
             # about to write, or skip the push entirely.

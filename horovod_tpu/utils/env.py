@@ -148,6 +148,14 @@ SERVE_WEIGHTS_DIR = "HVDTPU_SERVE_WEIGHTS_DIR"
 SERVE_SWAP_POLL_STEPS = "HVDTPU_SERVE_SWAP_POLL_STEPS"
 SERVE_OUT_TTL = "HVDTPU_SERVE_OUT_TTL_SECS"
 DEFAULT_SERVE_OUT_TTL = 300.0
+# Sharded front door + tenant QoS (ISSUE 16): FRONTENDS is the
+# launcher-side shard count F (F ingest pumps, rid-hash routed;
+# workers learn it from the serve/frontdoor doc, not this env);
+# TENANT_BUDGET arms tenant-aware weighted-fair admission with this
+# many tokens per tenant per budget window (fleet-wide — every rank
+# must derive the identical admission policy).
+SERVE_FRONTENDS = "HVDTPU_SERVE_FRONTENDS"
+SERVE_TENANT_BUDGET = "HVDTPU_SERVE_TENANT_BUDGET"
 # Autoscale (serve/autoscale.py): launcher-local knobs; carried as env
 # so config files can set them and operators can see them in ps.  The
 # envelope ceiling MAX_WORKERS also sizes the launcher's slot

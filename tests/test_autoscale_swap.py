@@ -355,11 +355,11 @@ def test_publish_weights_rejects_version_zero(tmp_path):
 def test_pump_gcs_compacted_finished_outputs(kv_pair):
     server, kv = kv_pair
     pump = IngestPump(server, out_ttl_secs=0.05)
-    kv.put(SCOPE, "log_watermark", b"2")
+    kv.put(SCOPE, "log_watermark/0", b"2")
     # below-watermark log orphans (leader crashed between publishing
-    # the watermark and deleting) are swept by the pump
-    kv.put(SCOPE, "log/0", pickle.dumps({"rid": "a", "n": 0}))
-    kv.put(SCOPE, "log/2", pickle.dumps({"rid": "c", "n": 2}))
+    # the shard's watermark and deleting) are swept by the pump
+    kv.put(SCOPE, "log/0/0", pickle.dumps({"rid": "a", "n": 0}))
+    kv.put(SCOPE, "log/0/2", pickle.dumps({"rid": "c", "n": 2}))
     kv.put(SCOPE, "out/a", pickle.dumps(
         {"rid": "a", "done": True, "n": 0, "tokens": [1]}))
     kv.put(SCOPE, "out/b", pickle.dumps(
@@ -367,8 +367,8 @@ def test_pump_gcs_compacted_finished_outputs(kv_pair):
     kv.put(SCOPE, "out/c", pickle.dumps(
         {"rid": "c", "done": False, "n": 1, "tokens": []}))   # inflight
     pump._gc_finished_outputs()                # first sight: starts ttl
-    assert kv.get(SCOPE, "log/0") is None      # orphan swept
-    assert kv.get(SCOPE, "log/2") is not None  # at/above the watermark
+    assert kv.get(SCOPE, "log/0/0") is None    # orphan swept
+    assert kv.get(SCOPE, "log/0/2") is not None  # at/above the watermark
     assert kv.get(SCOPE, "out/a") is not None
     time.sleep(0.1)
     pump._gc_finished_outputs()
@@ -518,8 +518,8 @@ def test_log_compaction_bounds_store_and_replay(tmp_path):
             job.client.result(r, timeout=180)
         # leader publishes the watermark + deletes synchronously with
         # the done docs, so results back means compaction happened
-        raw = job._server.scan(SCOPE + "/log_watermark")
-        mark = int(raw[SCOPE + "/log_watermark"].decode())
+        raw = job._server.scan(SCOPE + "/log_watermark/")
+        mark = int(raw[SCOPE + "/log_watermark/0"].decode())
         assert mark == 6
         assert job._server.scan(SCOPE + "/log/") == {}
         job.stop()

@@ -166,16 +166,21 @@ def test_validate_request_decision_table():
     ok = {"prompt": [1, 2], "max_new_tokens": 4}
     assert validate_request(ok, serve_len=16) is None
     assert validate_request(ok, serve_len=16, vocab_size=64) is None
-    assert "prompt" in validate_request(
-        {"prompt": [], "max_new_tokens": 4}, 16)
-    assert "ints" in validate_request(
-        {"prompt": [1, -2], "max_new_tokens": 4}, 16)
-    assert "vocab" in validate_request(
+    # Every verdict is a str (the human message) AND carries the
+    # machine-readable code ServeClient.result surfaces (ISSUE 16);
+    # tests/test_frontdoor.py has the full code table.
+    v = validate_request({"prompt": [], "max_new_tokens": 4}, 16)
+    assert "prompt" in v and v.code == "bad_prompt"
+    v = validate_request({"prompt": [1, -2], "max_new_tokens": 4}, 16)
+    assert "ints" in v and v.code == "bad_token"
+    v = validate_request(
         {"prompt": [1, 64], "max_new_tokens": 4}, 16, vocab_size=64)
-    assert "max_new_tokens" in validate_request(
-        {"prompt": [1], "max_new_tokens": 0}, 16)
-    assert "exceeds" in validate_request(
+    assert "vocab" in v and v.code == "oob_token"
+    v = validate_request({"prompt": [1], "max_new_tokens": 0}, 16)
+    assert "max_new_tokens" in v and v.code == "bad_budget"
+    v = validate_request(
         {"prompt": [1] * 10, "max_new_tokens": 8}, 16)
+    assert "exceeds" in v and v.code == "ctx_exceeded"
 
 
 def test_engine_serve_len_caps_oversized_cache():
