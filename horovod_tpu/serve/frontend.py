@@ -110,7 +110,8 @@ class RequestRejected(RuntimeError):
 
 
 def validate_request(doc: dict, serve_len: int,
-                     vocab_size: Optional[int] = None
+                     vocab_size: Optional[int] = None,
+                     budget_tokens: Optional[int] = None
                      ) -> Optional[Rejection]:
     """Reject verdict for an ingest-log entry, or None when servable.
     Pure — every rank applies it to the same log entry and reaches the
@@ -124,7 +125,15 @@ def validate_request(doc: dict, serve_len: int,
     prefill bucket trips the model's own max_len guard.  ``vocab_size``
     rejects out-of-vocab ids — the embedding gather would otherwise
     silently CLAMP them (JAX's default), returning deterministic
-    garbage where this module's contract is a loud reject."""
+    garbage where this module's contract is a loud reject.
+    ``budget_tokens`` is the TenantQoS per-window token budget when a
+    QoS policy is armed: a request whose cost (prompt +
+    max_new_tokens) exceeds the whole budget would be throttled in
+    EVERY window forever — with per-tenant-FIFO heads that bricks the
+    tenant behind it, and its never-done log slot stalls the shard's
+    compaction watermark permanently.  Rejecting it loudly at
+    validation time publishes a done doc, so the client learns
+    immediately and compaction advances."""
     prompt = doc.get("prompt")
     if not isinstance(prompt, (list, tuple)) or not prompt:
         return Rejection("bad_prompt", "empty or malformed prompt")
@@ -143,6 +152,13 @@ def validate_request(doc: dict, serve_len: int,
             "ctx_exceeded",
             f"prompt ({len(prompt)}) + max_new_tokens ({mnt}) exceeds "
             f"the {serve_len}-token serving context",
+        )
+    if budget_tokens is not None and len(prompt) + mnt > budget_tokens:
+        return Rejection(
+            "budget_exceeded",
+            f"prompt ({len(prompt)}) + max_new_tokens ({mnt}) exceeds "
+            f"the {budget_tokens}-token per-window tenant budget; the "
+            f"request could never be admitted",
         )
     temp = doc.get("temperature", 0.0)
     if not isinstance(temp, (int, float)) or temp < 0:
@@ -183,20 +199,24 @@ class ServeClient:
         self._frontends: Optional[int] = None
 
     def frontends(self) -> int:
-        """Shard count ``F`` from the front-door doc (cached — the
-        count is fixed for the job's lifetime; only shard OWNERSHIP
-        moves on takeover, which routing is blind to by design)."""
+        """Shard count ``F`` from the front-door doc (cached once
+        READ — the count is fixed for the job's lifetime; only shard
+        OWNERSHIP moves on takeover, which routing is blind to by
+        design).  An absent or unreadable doc falls back to 1 WITHOUT
+        caching: a client constructed before the FrontDoor publishes
+        (or during a transient KV error) must not pin every later
+        submission to shard 0 for its lifetime — the next call
+        re-reads."""
         if self._frontends is None:
             raw = self._kv.get(SCOPE, FRONTDOOR_KEY)
             if raw is None:
-                self._frontends = 1
-            else:
-                try:
-                    self._frontends = max(
-                        int(pickle.loads(raw).get("frontends", 1)), 1
-                    )
-                except Exception:
-                    self._frontends = 1
+                return 1
+            try:
+                self._frontends = max(
+                    int(pickle.loads(raw).get("frontends", 1)), 1
+                )
+            except Exception:
+                return 1
         return self._frontends
 
     def submit(self, prompt: Sequence[int], *,
@@ -300,6 +320,61 @@ class _FrontendKilled(Exception):
     thread dies abruptly, mid-traffic, without draining."""
 
 
+class _ShardFence:
+    """In-process fencing for front-door shard ownership.
+
+    The stale-heartbeat supervisor can declare a pump dead that is
+    merely SLOW — stalled mid-round on the GIL or a store scan, which
+    is exactly what made its beat stale.  Without a fence that zombie
+    finishes its in-flight round concurrently with the adopter: both
+    scan the same ``serve/req/<shard>/`` keys and can append the same
+    rid twice, or write the same ``log/<shard>/<n>`` key with
+    different rids.  Two guarantees close that race:
+
+    * **per-shard locks** — at most one pump is ever inside a shard's
+      scan-and-append round, so the adopter can never interleave
+      appends with the pump it replaced; the adopter recovers the
+      shard's cursor and dedup set AFTER first acquiring the lock, so
+      it sees every append the previous owner got in;
+    * **an owner map** — a pump re-checks ownership under the lock at
+      round start and again before every append, so a zombie that lost
+      its shard to a takeover aborts instead of writing.
+
+    All pumps are launcher-resident threads of ONE FrontDoor, which is
+    what makes an in-process fence sufficient: there is no
+    cross-process writer to fence against."""
+
+    def __init__(self, owners: Dict[int, int]):
+        self._meta = threading.Lock()
+        self._owners: Dict[int, int] = {int(s): int(f)
+                                        for s, f in owners.items()}
+        self._locks: Dict[int, threading.Lock] = {}
+
+    def lock_of(self, shard: int) -> threading.Lock:
+        with self._meta:
+            return self._locks.setdefault(int(shard), threading.Lock())
+
+    def owner_of(self, shard: int) -> Optional[int]:
+        with self._meta:
+            return self._owners.get(int(shard))
+
+    def transfer(self, shard: int, fid: int,
+                 timeout: float = 1.0) -> None:
+        """Move a shard to ``fid``.  Acquiring the shard lock first
+        puts the flip BETWEEN rounds of the previous owner (the common
+        case: the stall just ended); when the owner stays wedged past
+        ``timeout`` the flip happens anyway and the per-append owner
+        check fences its leftover writes instead."""
+        lock = self.lock_of(shard)
+        got = lock.acquire(timeout=timeout)
+        try:
+            with self._meta:
+                self._owners[int(shard)] = int(fid)
+        finally:
+            if got:
+                lock.release()
+
+
 class IngestPump:
     """One launcher-resident frontend pump: scans its owned request
     shards (``serve/req/<s>/*`` — the listing the HTTP surface
@@ -326,10 +401,15 @@ class IngestPump:
                  out_ttl_secs: Optional[float] = None, *,
                  fid: int = 0, frontends: int = 1,
                  shards: Optional[Sequence[int]] = None,
-                 gc: bool = True):
+                 gc: bool = True,
+                 fence: Optional[_ShardFence] = None):
         from ..utils import env as envmod  # noqa: PLC0415
 
         self._server = server
+        # Shard-ownership fence (FrontDoor-managed pumps only): a
+        # standalone pump has no sibling to race, so None skips the
+        # locking entirely.
+        self._fence = fence
         self._kv = KVStoreClient(f"127.0.0.1:{server.port}",
                                  server.secret)
         self.fid = int(fid)
@@ -426,18 +506,44 @@ class IngestPump:
         # an advisory action the supervisor must notice via the stale
         # heartbeat, not a cooperative shutdown.  step = THIS pump's
         # 1-based beat counter (the shared per-point counter would
-        # interleave nondeterministically across F pumps).
-        if maybe_fail("frontend_beat", step=self.beats + 1,
-                      rank=self.fid) == "frontend_exit":
+        # interleave nondeterministically across F pumps).  The GC
+        # pump (fid < 0) is exempt: it publishes no heartbeat, so an
+        # unfiltered frontend_exit spec would kill it silently and GC
+        # would stop for the rest of the job — chaos targets the
+        # FRONTEND pumps, whose death the supervisor can detect.
+        if self.fid >= 0 and maybe_fail(
+                "frontend_beat", step=self.beats + 1,
+                rank=self.fid) == "frontend_exit":
             raise _FrontendKilled(f"frontend {self.fid}")
         if self._gc_enabled:
             self._gc_stale_epochs()
             self._gc_finished_outputs()
         moved = 0
         for shard in self.shards:
-            if shard not in self._next:
-                self._adopt_state(shard)
-            moved += self._pump_shard(shard)
+            if self._fence is None:
+                if shard not in self._next:
+                    self._adopt_state(shard)
+                moved += self._pump_shard(shard)
+                continue
+            # Fenced path (FrontDoor pumps): the shard lock serializes
+            # this round against a live-but-slow previous owner, and
+            # the ownership check under it aborts a pump that lost the
+            # shard to a takeover — the zero-drop/zero-dup claim must
+            # hold even when the stale heartbeat was a false positive.
+            lock = self._fence.lock_of(shard)
+            if not lock.acquire(blocking=False):
+                # The previous owner is still mid-round (stalled): skip
+                # this tick rather than wedge behind it; the shard is
+                # retried next round.
+                continue
+            try:
+                if self._fence.owner_of(shard) != self.fid:
+                    continue  # lost the shard; never append
+                if shard not in self._next:
+                    self._adopt_state(shard)
+                moved += self._pump_shard(shard)
+            finally:
+                lock.release()
         self.beats += 1
         if self.fid >= 0:
             self._kv.put(SCOPE, f"{HEARTBEAT_PREFIX}{self.fid}",
@@ -449,6 +555,15 @@ class IngestPump:
         moved = 0
         known = self._known.setdefault(shard, set())
         for key in sorted(pending):
+            if self._fence is not None \
+                    and self._fence.owner_of(shard) != self.fid:
+                # Fenced off mid-round: the takeover declared this pump
+                # dead while it was wedged past the transfer timeout.
+                # Stop appending immediately — the adopter re-derives
+                # the cursor and dedup set under the shard lock after
+                # this round releases it, so everything appended so far
+                # is seen and nothing is appended twice.
+                break
             try:
                 doc = pickle.loads(pending[key])
                 rid = doc["rid"]
@@ -644,12 +759,16 @@ class FrontDoor:
     ``frontend_beat:action=frontend_exit`` chaos point):
 
     1. the supervisor notices the dead pump (thread down or heartbeat
-       counter stale past ``heartbeat_timeout``);
+       counter stale past ``heartbeat_timeout``; on the stale path it
+       also joins the thread briefly — a stale beat may mean SLOW, not
+       dead);
     2. its shards are ADOPTED by the lowest surviving frontend
-       (deterministic), which recovers each shard's append cursor from
-       the surviving log keys and dedupes already-logged rids — no
-       drop, no double-ingest; with no survivor (F=1) a replacement
-       pump is spawned in place;
+       (deterministic) — ownership flips through the
+       :class:`_ShardFence` first, so even a live-but-slow "corpse"
+       cannot append concurrently with its adopter — which recovers
+       each shard's append cursor from the surviving log keys and
+       dedupes already-logged rids — no drop, no double-ingest; with
+       no survivor (F=1) a replacement pump is spawned in place;
     3. the ownership doc (``serve/frontdoor``) is re-published under a
        bumped ``fd_epoch`` and a takeover event is queued;
     4. the elastic monitor polls :meth:`poll_takeover` and re-mints the
@@ -670,19 +789,28 @@ class FrontDoor:
         self.frontends = max(int(frontends), 1)
         self.interval = max(float(interval), 0.005)
         self.heartbeat_timeout = float(heartbeat_timeout)
+        self.owners: Dict[int, int] = {s: s
+                                       for s in range(self.frontends)}
+        # The ownership fence every pump writes through: a takeover
+        # flips it BEFORE the adopter picks the shards up, so a
+        # false-positive death (live-but-slow pump) can never append
+        # concurrently with its adopter (_ShardFence).
+        self._fence = _ShardFence(self.owners)
         self._pumps: Dict[int, IngestPump] = {
             fid: IngestPump(server, interval, out_ttl_secs, fid=fid,
-                            frontends=self.frontends, gc=False)
+                            frontends=self.frontends, gc=False,
+                            fence=self._fence)
             for fid in range(self.frontends)
         }
         # GC rides its own pump (no shards, no heartbeat): the duty
         # must survive any frontend's death, so it cannot live on one.
+        # It is exempt from the frontend_exit chaos point (round()) and
+        # supervised by thread liveness instead (_check_pumps respawns
+        # it) — "GC must survive any frontend's death" includes its own.
         self._gc_pump = IngestPump(server, max(interval * 5, 0.05),
                                    out_ttl_secs, fid=-1,
                                    frontends=self.frontends,
                                    shards=(), gc=True)
-        self.owners: Dict[int, int] = {s: s
-                                       for s in range(self.frontends)}
         self.fd_epoch = 0
         self.takeovers = 0
         self._events: List[dict] = []
@@ -807,11 +935,38 @@ class FrontDoor:
                     "it dead", fid, self.heartbeat_timeout,
                 )
                 pump.kill()
+                # Bounded join: kill() only raises the stop flag, so a
+                # LIVE-but-slow pump may still be mid-round.  Most
+                # stalls end quickly once noticed — joining here makes
+                # the takeover race-free in the common case; a pump
+                # still wedged past the bound is fenced off by
+                # _ShardFence instead (ownership flips before the
+                # adopter appends, and the zombie's leftover writes
+                # abort on the owner check).
+                if pump._thread is not None:
+                    pump._thread.join(timeout=0.5)
                 dead.append(fid)
         for fid in dead:
             self._takeover(fid)
         if dead:
             self._publish_gauges()
+        # The GC pump has no heartbeat (fid=-1 publishes none), so it
+        # is supervised by thread liveness: if it dies — it is exempt
+        # from the chaos point, but defense-in-depth against a real
+        # crash — respawn it, or stale-epoch and finished-output GC
+        # silently stops for the rest of the job.
+        gc = self._gc_pump
+        if gc._thread is not None and not gc._stopped and not gc.alive():
+            LOG.warning("GC pump died; respawning it")
+            fresh = IngestPump(self._server, gc.interval,
+                               gc.out_ttl_secs, fid=-1,
+                               frontends=self.frontends, shards=(),
+                               gc=True)
+            # Carry the done-TTL tracking over so already-finished
+            # outputs keep their original GC deadline.
+            fresh._done_seen = dict(gc._done_seen)
+            self._gc_pump = fresh
+            fresh.start()
 
     def _takeover(self, fid: int) -> None:
         from ..obs import get_registry  # noqa: PLC0415
@@ -823,6 +978,13 @@ class FrontDoor:
                      if f != fid and p.alive() and not p._stopped]
         if survivors:
             owner = survivors[0]
+            # Fence FIRST, adopt second: each shard's ownership flips
+            # under its lock (waiting out an in-flight round, bounded)
+            # before the survivor can append to it, so a
+            # false-positive death — the pump was alive but slow —
+            # cannot double-ingest against its adopter.
+            for s in shards:
+                self._fence.transfer(s, owner)
             self._pumps[owner].adopt(shards)
             # Retire the dead pump: its shards are re-owned, so the
             # supervisor must not re-fire this takeover every tick.
@@ -830,12 +992,14 @@ class FrontDoor:
         else:
             # No survivor (F=1, or everyone died at once): spawn a
             # replacement pump in place — the supervisor is the actor
-            # of last resort.
+            # of last resort.  Ownership stays with this fid; the
+            # per-shard fence locks still serialize the replacement
+            # against the corpse's possible in-flight last round.
             owner = fid
             fresh = IngestPump(
                 self._server, self.interval, pump.out_ttl_secs,
                 fid=fid, frontends=self.frontends, shards=shards,
-                gc=False,
+                gc=False, fence=self._fence,
             )
             # The replacement inherits the corpse's ingest accounting:
             # counters survive a respawn the way a rank's completed

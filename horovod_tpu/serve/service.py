@@ -262,8 +262,14 @@ def _build_recovery(kv, group: int = 0, groups: int = 1,
             docs.append(doc)
             n += 1
         log_next[shard] = n
-    # The schedule replays in the SAME total order live ingest would
-    # have produced — the interleave, not per-shard concatenation.
+    # Replay order is the gkey interleave — per-shard sequence fanned
+    # over F — NOT necessarily the live arrival order: live enqueue
+    # interleaves arrivals across probe steps, so a quiet shard's
+    # low-n entry can sort ahead of busy-shard entries that were
+    # enqueued before it live.  What recovery requires is only that
+    # every rank derives the SAME order (all ranks adopt the leader's
+    # doc, and per-rid token streams are order-independent); the
+    # fairness skew is bounded by one in-flight backlog.
     docs.sort(key=lambda d: d["gkey"])
     inflight = []
     done_slots: List[Tuple[int, int]] = []
@@ -493,13 +499,20 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             _advance_watermark()
 
     def _reject_reason(entry) -> Optional[str]:
-        """Full per-entry verdict: the frontend validation plus the
-        page-feasibility check (a request whose worst case exceeds the
-        WHOLE page pool can never be admitted — rejecting it loudly
-        beats a permanently head-blocked FCFS queue).  Pure, so every
-        rank and every group reaches the same verdict."""
-        reason = validate_request(entry, engine.serve_len,
-                                  engine.cfg.vocab_size)
+        """Full per-entry verdict: the frontend validation (including
+        the tenant-budget feasibility check — a cost that exceeds the
+        whole per-window budget would be throttled forever, bricking
+        its tenant and freezing the shard's compaction watermark) plus
+        the page-feasibility check (a request whose worst case exceeds
+        the WHOLE page pool can never be admitted — rejecting it
+        loudly beats a permanently head-blocked FCFS queue).  Pure —
+        the qos policy is built from the spec every rank shares — so
+        every rank and every group reaches the same verdict."""
+        reason = validate_request(
+            entry, engine.serve_len, engine.cfg.vocab_size,
+            budget_tokens=(None if sched.qos is None
+                           else sched.qos.budget_tokens),
+        )
         if reason is None and engine.paged is not None:
             reason = page_reject_reason(
                 len(entry["prompt"]), entry["max_new_tokens"],

@@ -86,6 +86,21 @@ def test_rejection_code_decision_table():
     assert validate_request(ok, serve_len=16, vocab_size=64) is None
 
 
+def test_validate_request_rejects_cost_over_tenant_budget():
+    """A request whose cost exceeds the WHOLE per-window budget could
+    never be admitted: left in the queue it would brick its tenant's
+    FIFO head in every window and stall the shard's compaction
+    watermark forever — so validation rejects it up front."""
+    doc = {"prompt": [1, 2, 3], "max_new_tokens": 8}  # cost 11
+    v = validate_request(doc, serve_len=16, vocab_size=64,
+                         budget_tokens=10)
+    assert isinstance(v, Rejection) and v.code == "budget_exceeded"
+    # Exactly at the budget is admissible; no policy means no check.
+    assert validate_request(doc, serve_len=16, vocab_size=64,
+                            budget_tokens=11) is None
+    assert validate_request(doc, serve_len=16, vocab_size=64) is None
+
+
 def test_rejection_is_a_str_and_pickles():
     r = Rejection("ctx_exceeded", "prompt too long")
     assert isinstance(r, str) and "too long" in r
@@ -395,6 +410,123 @@ def test_frontend_exit_chaos_point_kills_pump_abruptly(
     finally:
         monkeypatch.delenv("HVDTPU_FAULT_SPEC")
         faults.reset()
+
+
+def test_shard_fence_blocks_pump_that_lost_ownership(kv_server):
+    """The false-positive-death race: a live-but-SLOW pump whose stale
+    heartbeat triggered a takeover must not append concurrently with
+    its adopter.  Driven synchronously (no threads): after the fence
+    flips shard 0 to the survivor, the old owner's round is a no-op
+    and the adopter continues the cursor with no gap, no drop, and no
+    double-ingest."""
+    from horovod_tpu.run.rendezvous import KVStoreClient
+    from horovod_tpu.serve.frontend import _ShardFence
+
+    kv = KVStoreClient(f"127.0.0.1:{kv_server.port}", kv_server.secret)
+    fence = _ShardFence({0: 0, 1: 1})
+    p0 = IngestPump(kv_server, fid=0, frontends=2, gc=False,
+                    fence=fence)
+    p1 = IngestPump(kv_server, fid=1, frontends=2, gc=False,
+                    fence=fence)
+    kv.put(SCOPE, "req/0/x", pickle.dumps(
+        {"rid": "x", "prompt": [1], "max_new_tokens": 1}))
+    assert p0.round() == 1
+    # Takeover while p0 is "slow": ownership fences over, p1 adopts.
+    fence.transfer(0, 1)
+    p1.adopt([0])
+    kv.put(SCOPE, "req/0/y", pickle.dumps(
+        {"rid": "y", "prompt": [2], "max_new_tokens": 1}))
+    # The zombie lost the shard: its round must append NOTHING and
+    # leave the pending submission for the adopter.
+    assert p0.round() == 0
+    assert kv.get(SCOPE, "req/0/y") is not None
+    assert p1.round() == 1
+    log0 = kv_server.scan(SCOPE + "/log/0/")
+    assert {k: pickle.loads(b)["rid"] for k, b in log0.items()} == {
+        SCOPE + "/log/0/0": "x", SCOPE + "/log/0/1": "y"}
+
+
+def test_shard_fence_lock_defers_round_until_released(kv_server):
+    """A shard whose lock is held (a sibling mid-round) is skipped —
+    never raced, never wedged behind — and picked up the next round."""
+    from horovod_tpu.run.rendezvous import KVStoreClient
+    from horovod_tpu.serve.frontend import _ShardFence
+
+    kv = KVStoreClient(f"127.0.0.1:{kv_server.port}", kv_server.secret)
+    fence = _ShardFence({0: 0})
+    pump = IngestPump(kv_server, fid=0, frontends=1, gc=False,
+                      fence=fence)
+    kv.put(SCOPE, "req/0/z", pickle.dumps(
+        {"rid": "z", "prompt": [3], "max_new_tokens": 1}))
+    lock = fence.lock_of(0)
+    lock.acquire()
+    try:
+        assert pump.round() == 0
+        assert kv.get(SCOPE, "req/0/z") is not None
+    finally:
+        lock.release()
+    assert pump.round() == 1
+    assert kv.get(SCOPE, "req/0/z") is None
+
+
+def test_unfiltered_frontend_exit_spares_gc_pump(kv_server,
+                                                 monkeypatch):
+    """A frontend_exit fault spec WITHOUT a rank filter must only ever
+    kill frontend pumps: the GC pump (fid=-1) publishes no heartbeat,
+    so killing it would silently stop stale-epoch and finished-output
+    GC for the rest of the job."""
+    from horovod_tpu.testing import faults
+
+    monkeypatch.setenv("HVDTPU_FAULT_SPEC",
+                       "frontend_beat:action=frontend_exit:step=3")
+    faults.reset()
+    try:
+        door = FrontDoor(kv_server, frontends=1, interval=0.01,
+                         heartbeat_timeout=0.3)
+        door.start()
+        try:
+            assert _wait(lambda: door.takeovers == 1, timeout=8.0)
+            assert door._gc_pump.alive()
+        finally:
+            door.stop()
+    finally:
+        monkeypatch.delenv("HVDTPU_FAULT_SPEC")
+        faults.reset()
+
+
+def test_gc_pump_respawned_by_supervisor(kv_server):
+    """The GC duty must survive its own pump's death too: the
+    supervisor watches the GC pump by thread liveness (it has no
+    heartbeat) and respawns it in place — without counting a takeover
+    or re-minting the fd epoch (no shards moved)."""
+    door = FrontDoor(kv_server, frontends=1, interval=0.01,
+                     heartbeat_timeout=0.3)
+    door.start()
+    try:
+        original = door._gc_pump
+        original.kill()
+        assert _wait(lambda: door._gc_pump is not original
+                     and door._gc_pump.alive())
+        assert door.takeovers == 0 and door.fd_epoch == 0
+    finally:
+        door.stop()
+
+
+def test_client_frontends_fallback_is_not_cached(kv_server):
+    from horovod_tpu.run.rendezvous import KVStoreClient
+
+    client = ServeClient(f"127.0.0.1:{kv_server.port}",
+                         kv_server.secret)
+    # No frontdoor doc yet: fall back to F=1 WITHOUT pinning it.
+    assert client.frontends() == 1
+    kv = KVStoreClient(f"127.0.0.1:{kv_server.port}", kv_server.secret)
+    kv.put(SCOPE, "frontdoor", pickle.dumps(
+        {"frontends": 4, "owners": {s: s for s in range(4)},
+         "fd_epoch": 0}))
+    # Doc published after the first read: the client picks up F=4 —
+    # a client constructed before the FrontDoor must not route every
+    # submission to shard 0 for its lifetime.
+    assert client.frontends() == 4
 
 
 def test_build_recovery_merges_shards_in_gkey_order(kv_server):
