@@ -123,6 +123,36 @@ def _auto_record(why: str, *, rc: int, phase: str, parsed: dict = None):
         return None  # a record write must never mask the real exit
 
 
+def backend_provenance(probe: bool = False) -> dict:
+    """The backend-provenance stamp every record carries: platform,
+    device kind, and the JAX_PLATFORMS env — so scripts/perf_gate.py
+    can tell "ran on CPU" from "tunnel flaked" without parsing ``why``
+    strings.  ``probe=False`` (the degraded/death paths) never IMPORTS
+    jax: in the r05 outage mode ``import jax`` itself hangs, and a
+    record writer that hangs is worse than a record without a device
+    kind — it reads jax state only when the module is already
+    resident."""
+    prov = {
+        "platform": None,
+        "device_kind": None,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+    }
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None and probe:
+        try:
+            import jax as jax_mod  # noqa: PLC0415
+        except Exception:
+            jax_mod = None
+    if jax_mod is not None:
+        try:
+            dev = jax_mod.devices()[0]
+            prov["platform"] = dev.platform
+            prov["device_kind"] = dev.device_kind
+        except Exception:
+            pass
+    return prov
+
+
 def write_degraded_record(why: str, *, rc: int, phase: str,
                           record_dir: str = None, parsed: dict = None):
     """ALWAYS land a BENCH record: when the bench cannot produce a real
@@ -144,6 +174,11 @@ def write_degraded_record(why: str, *, rc: int, phase: str,
         "degraded": True,
         "failure_phase": phase,
         "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        # Who actually ran (or failed to): the sentinel's basis for
+        # separating CPU fallback from real-hardware failure.  Never
+        # probes — a degraded record may be written while jax is the
+        # very thing that is hanging.
+        "provenance": backend_provenance(probe=False),
     }
     # Degraded records carry the memory breakdown too (census says
     # "source: unavailable" when the failure predates jax init): the
@@ -972,6 +1007,7 @@ def _serve_bench(args) -> int:
         "value": main["tokens_per_sec"],
         "unit": "tokens/sec",
         "device": jax.devices()[0].device_kind,
+        "provenance": backend_provenance(probe=True),
         "serve": {k: v for k, v in main.items()},
     }
     if scaling is not None:
@@ -1479,6 +1515,7 @@ def main() -> int:
         ),
         "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
         "device": jax.devices()[0].device_kind,
+        "provenance": backend_provenance(probe=True),
         # Always present, estimate-flagged off-TPU: the record-embedded
         # view of the live perf.* gauges (obs/profile.py).
         "perf": profiler.summary(),

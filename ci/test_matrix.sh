@@ -335,6 +335,60 @@ PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
     python "$LIVE_TMP/scrape.py" "$LIVE_TMP"
 rm -rf "$LIVE_TMP"
 
+# Goodput gate (ISSUE 17): the per-rank goodput ledger, the tenant SLO
+# burn-rate plane, and the bench regression sentinel.  hvdtpu-lint
+# stays clean over the new surface, the decision-table suites run
+# (tiling invariant, two-window burn alerting, trajectory partition),
+# the sentinel audits the committed BENCH trajectory (the CPU-fallback
+# rounds r06-r12 must be labelled degraded and excluded from the
+# baselines, r01-r02 stay real, exit 0), and a seeded regressing
+# candidate must FAIL it — a sentinel that cannot fail is decorative.
+echo "== goodput gate: lint + decision-table suites =="
+python -m horovod_tpu.analysis horovod_tpu/obs/goodput.py \
+    horovod_tpu/obs/slo.py scripts/perf_gate.py \
+    --baseline horovod_tpu/analysis/baseline.json
+JAX_PLATFORMS=cpu \
+    timeout 300 python -m pytest tests/test_goodput.py \
+    tests/test_slo.py tests/test_perf_gate.py -x -q
+echo "== goodput gate: sentinel audits the committed BENCH trajectory =="
+GP_TMP=$(mktemp -d)
+python scripts/perf_gate.py --records-dir . | tee "$GP_TMP/audit.txt"
+python - "$GP_TMP" <<'EOF'
+import sys
+
+lines = open(f"{sys.argv[1]}/audit.txt").read().splitlines()
+
+def bucket(rec):
+    for line in lines:
+        if rec in line:
+            return line.split()[0]
+    return None
+
+for n in (1, 2):
+    assert bucket(f"BENCH_r{n:02d}.json") == "real", n
+for n in range(6, 13):
+    assert bucket(f"BENCH_r{n:02d}.json") == "degraded", n
+assert any(l.startswith("# baselines") for l in lines), "no baselines"
+print("goodput gate: trajectory partition OK")
+EOF
+echo "== goodput gate: seeded regression must fail the sentinel =="
+cat > "$GP_TMP/cand.json" <<'EOF'
+{"metric": "resnet50_bf16_images_per_sec_per_chip", "value": 1000.0,
+ "device": "TPU v5 lite",
+ "provenance": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                "jax_platforms": ""}}
+EOF
+if python scripts/perf_gate.py --records-dir . \
+        --candidate "$GP_TMP/cand.json" > "$GP_TMP/verdict.txt"; then
+    echo "goodput gate FAILED: seeded regression passed the sentinel" >&2
+    exit 1
+fi
+grep -q "REGRESSION" "$GP_TMP/verdict.txt" || {
+    echo "goodput gate FAILED: sentinel failed without a REGRESSION verdict" >&2
+    exit 1
+}
+rm -rf "$GP_TMP"
+
 # Post-mortem gate (ISSUE 4): a 2-proc job crashed with action=abort on
 # rank 1 must leave per-rank flight-recorder dumps and a launcher-written
 # postmortem.json that is schema-valid and blames the injected rank; the

@@ -27,9 +27,15 @@ _SCOPE = "elastic"
 def main() -> int:
     # Death-path hooks FIRST (main thread — signal handlers need it):
     # everything after this point leaves a black box if it dies.
-    from ..obs import flightrec
+    from ..obs import flightrec, goodput
 
     flightrec.install_death_hooks()
+    # The wall-clock goodput ledger rides the flight recorder's event
+    # tap from the very first phase event: every second of this
+    # incarnation is classified (init/compile/productive/recovery/...)
+    # and published as goodput.* gauges in the rank's metrics dump.
+    goodput.install(epoch=int(os.environ.get("HVDTPU_ELASTIC_EPOCH",
+                                             "0") or 0))
     ctx = _set_ambient()
     if not isinstance(ctx, ElasticContext):  # pragma: no cover - misuse
         raise RuntimeError(

@@ -39,6 +39,18 @@ quantitative):
   (compiled ``cost_analysis()`` with analytic fallbacks) over measured
   step time, published live as ``perf.mfu`` / ``perf.model_tflops`` /
   ``perf.step_ms`` gauges.
+* **goodput ledger** (obs/goodput.py) — the wall-clock axis: every
+  per-rank second classified (init / compile / productive_step /
+  collective_wait / checkpoint / recovery / idle / degraded) off the
+  events the flight recorder already emits, published as
+  ``goodput.*`` gauges with per-elastic-epoch lost-time attribution
+  (rendezvous / respawn / stall), plus the serving-side token-goodput
+  variant (``serve.goodput.*``).
+* **tenant SLO burn-rate plane** (obs/slo.py) — per-tenant /
+  per-SLO-class sliding-window ttft/tpot digests judged against
+  ``--slo-ttft-ms``-style targets, with two-window error-budget
+  burn-rate alerting (fast window pages on cliffs, slow window warns
+  on slow burns), published as ``serve.slo.*``.
 * **memory plane** (obs/memplane.py) — the byte axis: compiled
   per-program breakdowns (``memory_analysis()``, version-tolerant),
   an owner-tagged ``jax.live_arrays()`` census with backend
@@ -50,7 +62,9 @@ See docs/observability.md and docs/postmortem.md.
 """
 
 from . import flightrec  # noqa: F401
+from . import goodput  # noqa: F401
 from . import memplane  # noqa: F401
+from . import slo  # noqa: F401
 from . import profile  # noqa: F401
 from . import progress  # noqa: F401
 from . import straggler  # noqa: F401
@@ -83,8 +97,10 @@ __all__ = [
     "dump_flight_recorder",
     "install_death_hooks",
     "flightrec",
+    "goodput",
     "profile",
     "progress",
+    "slo",
     "straggler",
     "stream",
     "trace",

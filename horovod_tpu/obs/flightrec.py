@@ -74,10 +74,40 @@ __all__ = [
     "record_exception",
     "dump_flight_recorder",
     "resolve_dump_path",
+    "add_observer",
+    "remove_observer",
     "on_death",
     "flush",
     "install_death_hooks",
 ]
+
+# Event tap: consumers (the goodput ledger) that want every recorded
+# event as it happens, without polling snapshots.  Observers run OUTSIDE
+# the ring lock, exception-swallowed — a broken consumer must not cost
+# the black box an event or deadlock a dying rank.
+_observers: List[Callable[[str, str, int, float], None]] = []
+
+
+def add_observer(fn: Callable[[str, str, int, float], None]) -> None:
+    """Register ``fn(kind, name, cycle, t)`` to run after every recorded
+    event (module-level and recorder-method paths both).  Idempotent."""
+    with _recorder_lock:
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    with _recorder_lock:
+        if fn in _observers:
+            _observers.remove(fn)
+
+
+def _notify(kind: str, name: str, cycle: int, t: float) -> None:
+    for fn in list(_observers):
+        try:
+            fn(kind, name, cycle, t)
+        except Exception:
+            pass
 
 
 class FlightRecorder:
@@ -121,6 +151,7 @@ class FlightRecorder:
             slot[4] = cycle
             slot[5] = detail
             self._seq += 1
+        _notify(kind, name, cycle, t)
 
     def record_exception(self, exc: BaseException,
                          where: str = "") -> None:

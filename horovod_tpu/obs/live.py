@@ -113,6 +113,30 @@ _METRIC_HELP = {
                            "(sum of per-slot positions)",
     "serve.kv.waste_ratio": "1 - live/allocated KV bytes: the tail "
                             "paged attention would reclaim",
+    "goodput.fraction": "Share of this rank's wall-clock spent in "
+                        "productive steps (obs/goodput.py ledger)",
+    "goodput.secs": "Wall-clock seconds per goodput class (init / "
+                    "compile / productive_step / collective_wait / "
+                    "checkpoint / recovery / idle / degraded)",
+    "goodput.lost_secs": "Seconds lost to elastic events, attributed "
+                         "by cause (rendezvous / respawn / stall)",
+    "serve.goodput.token_fraction": "Decode tokens emitted over slot "
+                                    "capacity (tokens / steps x slots)",
+    "serve.goodput.tokens_per_slot_sec": "Decode tokens per slot per "
+                                         "wall-clock second",
+    "serve.slo.p50_ms": "Per-tenant/SLO-class sliding-window latency "
+                        "median (metric label: ttft or tpot)",
+    "serve.slo.p99_ms": "Per-tenant/SLO-class sliding-window latency "
+                        "p99 (metric label: ttft or tpot)",
+    "serve.slo.burn": "Error-budget burn rate over the labelled "
+                      "window (fast=cliffs, slow=slow burns); 1.0 "
+                      "spends the budget exactly at the objective",
+    "serve.slo.alert": "1 while the labelled window's burn rate is "
+                       "over its alerting threshold",
+    "serve.slo.breaches": "Requests over their SLO ceiling, by "
+                          "tenant/class/metric",
+    "serve.slo.alerts": "Burn-rate alert rising edges, by "
+                        "tenant/class/metric",
 }
 
 
@@ -247,6 +271,12 @@ class LiveAggregator:
         serve = self._serve_part(views)
         if serve:
             parts.append(serve)
+        slo = self._slo_part(views)
+        if slo:
+            parts.append(slo)
+        goodput = self._goodput_part(views)
+        if goodput:
+            parts.append(goodput)
         autoscale = self._autoscale_part(views)
         if autoscale:
             parts.append(autoscale)
@@ -429,6 +459,73 @@ class LiveAggregator:
         return token
 
     @staticmethod
+    def _slo_part(views) -> Optional[str]:
+        """One digest token for the tenant SLO burn-rate plane
+        (obs/slo.py): ``slo OK burn 0.4x`` while the budget holds,
+        ``slo ALERT acme/interactive ttft fast 12.3x`` the moment a
+        window's burn rate crosses its threshold — the alert an
+        operator must see without opening /metrics.  Absent on jobs
+        that never digested SLO traffic, so untagged fleets stay
+        quiet."""
+        firing: List[str] = []
+        worst_burn = None
+        saw_series = False
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                if name == "serve.slo.burn":
+                    saw_series = True
+                    v = float(m["value"])
+                    worst_burn = v if worst_burn is None \
+                        else max(worst_burn, v)
+                elif name == "serve.slo.alert" and float(m["value"]):
+                    tags = m.get("tags") or {}
+                    firing.append(
+                        f"{tags.get('tenant', '?')}/"
+                        f"{tags.get('slo', '?')} "
+                        f"{tags.get('metric', '?')} "
+                        f"{tags.get('window', '?')}"
+                    )
+        if not saw_series:
+            return None
+        if firing:
+            return "slo ALERT " + ", ".join(sorted(set(firing))) + (
+                f" (worst burn {worst_burn:.1f}x)"
+                if worst_burn is not None else ""
+            )
+        return f"slo OK burn {worst_burn or 0.0:.1f}x"
+
+    @staticmethod
+    def _goodput_part(views) -> Optional[str]:
+        """One digest token for the goodput ledger (obs/goodput.py):
+        the fleet's worst productive fraction (the fleet is only as
+        good as its least-productive rank) plus that rank's dominant
+        non-productive class — absent on jobs that never armed the
+        ledger."""
+        worst = None
+        worst_view = None
+        for view in views.values():
+            for m in view.metrics.values():
+                if m.get("name") == "goodput.fraction":
+                    v = float(m["value"])
+                    if worst is None or v < worst:
+                        worst, worst_view = v, view
+        if worst is None:
+            return None
+        token = f"goodput {worst:.0%}"
+        if worst_view is not None:
+            sinks = {
+                (m.get("tags") or {}).get("class", "?"): float(m["value"])
+                for m in worst_view.metrics.values()
+                if m.get("name") == "goodput.secs"
+                and (m.get("tags") or {}).get("class") != "productive_step"
+            }
+            if sinks and max(sinks.values()) > 0:
+                top = max(sinks, key=lambda c: sinks[c])
+                token += f" (top sink {top} {sinks[top]:.3g}s)"
+        return token
+
+    @staticmethod
     def _frontdoor_part() -> Optional[str]:
         """One digest token for the sharded front door (``frontdoor
         2/2 up``, ``1/2 up 1 takeover`` after a kill): frontend count,
@@ -581,7 +678,7 @@ class LiveAggregator:
     def history_row(self, expected_ranks: Optional[int] = None) -> dict:
         with self._lock:
             views = self.merged()
-            return {
+            row = {
                 "t": time.time(),
                 "round": self.rounds,
                 "ranks_reporting": len(views),
@@ -591,6 +688,24 @@ class LiveAggregator:
                 "epochs": {str(r): v.epoch for r, v in views.items()},
                 "straggler": self.straggler(),
             }
+            # SLO burn-rate plane (obs/slo.py): windows currently over
+            # threshold + cumulative rising edges, so the history file
+            # answers "when did the alert fire" after the job is gone.
+            firing = 0
+            alerts = 0.0
+            saw_slo = False
+            for view in views.values():
+                for m in view.metrics.values():
+                    name = m.get("name")
+                    if name == "serve.slo.alert":
+                        saw_slo = True
+                        firing += 1 if float(m["value"]) else 0
+                    elif name == "serve.slo.alerts":
+                        saw_slo = True
+                        alerts += float(m["value"])
+            if saw_slo:
+                row["slo"] = {"firing": firing, "alerts": int(alerts)}
+            return row
 
     # ------------------------------------------------------- prometheus
 

@@ -24,6 +24,8 @@ __all__ = [
     "autoscale_section",
     "perf_section",
     "mem_section",
+    "goodput_section",
+    "slo_section",
     "summarize",
 ]
 
@@ -32,18 +34,42 @@ def _dump_glob(raw: str) -> str:
     return pathspec.glob_pattern(raw, "metrics")
 
 
-def collect_dumps(raw: str) -> Dict[str, dict]:
+class DumpSet(Dict[str, dict]):
+    """collect_dumps result: a plain ``{label -> dump doc}`` mapping
+    plus ``.warnings`` — one line per dump that was found on disk but
+    skipped (truncated mid-write, corrupt JSON, wrong schema).  A
+    half-written dump must not sink the summary, but it must not
+    vanish silently either: a missing column that LOOKS like "rank
+    never dumped" when the file is sitting right there is exactly the
+    kind of misdirection a post-mortem can't afford."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.warnings: List[str] = []
+
+
+def collect_dumps(raw: str) -> DumpSet:
     """Read every per-rank dump derived from the ``HVDTPU_METRICS_DUMP``
     value; returns {column label -> dump document}.  Elastic epoch tags
-    become part of the label so incarnations stay distinguishable."""
-    out: Dict[str, dict] = {}
+    become part of the label so incarnations stay distinguishable.
+    Unreadable/corrupt dumps are skipped but named in ``.warnings`` so
+    the table header can say which columns are missing and why."""
+    out = DumpSet()
     for path in sorted(glob.glob(_dump_glob(raw))):
         try:
             with open(path) as f:
                 doc = json.load(f)
-        except (OSError, ValueError):
-            continue  # a half-written dump must not sink the summary
+        except (OSError, ValueError) as exc:
+            out.warnings.append(
+                f"skipped corrupt metrics dump {os.path.basename(path)}"
+                f" ({type(exc).__name__}: truncated or unreadable)"
+            )
+            continue
         if not isinstance(doc, dict) or "metrics" not in doc:
+            out.warnings.append(
+                f"skipped metrics dump {os.path.basename(path)} "
+                f"(valid JSON but not a metrics dump document)"
+            )
             continue
         label = str(doc.get("rank", "?"))
         epoch = pathspec.epoch_of_path(path)
@@ -75,9 +101,15 @@ def _metric_label(metric: dict) -> str:
 
 
 def format_summary_table(dumps: Dict[str, dict]) -> str:
-    """Metrics as rows, ranks as columns, plain monospace table."""
+    """Metrics as rows, ranks as columns, plain monospace table.
+    collect_dumps warnings (corrupt/truncated dumps that were skipped)
+    lead the header so a missing column reads as "dump was corrupt",
+    never as "rank never dumped"."""
+    warn_lines = [
+        f"WARNING: {w}" for w in getattr(dumps, "warnings", [])
+    ]
     if not dumps:
-        return "(no metrics dumps found)"
+        return "\n".join(warn_lines + ["(no metrics dumps found)"])
 
     columns = sorted(dumps, key=_rank_sort_key)
     rows: Dict[str, Dict[str, str]] = {}
@@ -95,7 +127,7 @@ def format_summary_table(dumps: Dict[str, dict]) -> str:
         f"  {f'rank {c}':>{col_w[c]}}" for c in columns
     )
     sep = "-" * len(header)
-    lines = [header, sep]
+    lines = warn_lines + [header, sep]
     for r in sorted(rows):
         lines.append(
             r.ljust(name_w)
@@ -307,6 +339,109 @@ def serve_section(dumps: Dict[str, dict]) -> Optional[str]:
                 )
             rows.append(f"rank {label} tenants: " + ", ".join(bits))
     return "\n".join(rows) if rows else None
+
+
+def goodput_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job goodput ledger verdict (obs/goodput.py gauges):
+    per-rank productive fraction with the wall-clock class breakdown
+    (init/compile/productive/collective_wait/checkpoint/recovery/...)
+    and, when any time was lost to elastic events, the per-cause
+    attribution (rendezvous / respawn / stall).  Serving ranks add the
+    token-goodput line.  None when no rank armed the ledger."""
+    rows = []
+    for label in sorted(dumps, key=_rank_sort_key):
+        frac = None
+        secs: Dict[str, float] = {}
+        lost: Dict[str, float] = {}
+        tok_frac = tok_rate = None
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if name == "goodput.fraction":
+                frac = float(m["value"])
+            elif name == "goodput.secs":
+                cls = (m.get("tags") or {}).get("class", "?")
+                secs[cls] = float(m["value"])
+            elif name == "goodput.lost_secs":
+                cause = (m.get("tags") or {}).get("cause", "?")
+                lost[cause] = float(m["value"])
+            elif name == "serve.goodput.token_fraction":
+                tok_frac = float(m["value"])
+            elif name == "serve.goodput.tokens_per_slot_sec":
+                tok_rate = float(m["value"])
+        if frac is None and tok_frac is None:
+            continue
+        bits = []
+        if frac is not None:
+            bits.append(f"goodput {frac:.1%}")
+            breakdown = " ".join(
+                f"{cls}={secs[cls]:.3g}s"
+                for cls in sorted(secs, key=lambda c: -secs[c])
+                if secs[cls]
+            )
+            if breakdown:
+                bits.append(breakdown)
+            if any(lost.values()):
+                bits.append("lost " + " ".join(
+                    f"{cause}={lost[cause]:.3g}s"
+                    for cause in sorted(lost, key=lambda c: -lost[c])
+                    if lost[cause]
+                ))
+        if tok_frac is not None:
+            tok = f"token goodput {tok_frac:.1%} of slot capacity"
+            if tok_rate is not None:
+                tok += f" ({tok_rate:.3g} tok/slot-s)"
+            bits.append(tok)
+        rows.append(f"rank {label}: " + ", ".join(bits))
+    return "\n".join(rows) if rows else None
+
+
+def slo_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job SLO burn-rate verdict (obs/slo.py gauges): per
+    (tenant, slo class, metric) series the latency digest, breach
+    count, fast/slow-window burn rates, and whether an alert ever fired
+    — the number the capacity conversation actually needs.  None when
+    no rank digested SLO traffic."""
+    # (tenant, slo, metric) -> merged view across ranks: digests are
+    # per-rank so we show the worst rank's percentiles, and sum the
+    # breach/alert counters (they are disjoint per rank).
+    series: Dict[tuple, Dict[str, float]] = {}
+    for label in sorted(dumps, key=_rank_sort_key):
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if not name or not name.startswith("serve.slo."):
+                continue
+            tags = m.get("tags") or {}
+            key = (tags.get("tenant", "?"), tags.get("slo", "?"),
+                   tags.get("metric", "?"))
+            bucket = series.setdefault(key, {})
+            short = name[len("serve.slo."):]
+            if short in ("p50_ms", "p99_ms"):
+                bucket[short] = max(bucket.get(short, 0.0),
+                                    float(m["value"]))
+            elif short == "burn":
+                win = tags.get("window", "?")
+                bucket[f"burn_{win}"] = max(
+                    bucket.get(f"burn_{win}", 0.0), float(m["value"]))
+            elif short in ("breaches", "alerts"):
+                bucket[short] = bucket.get(short, 0.0) + float(m["value"])
+    if not series:
+        return None
+    rows = []
+    for (tenant, slo, metric) in sorted(series):
+        b = series[(tenant, slo, metric)]
+        row = (f"{tenant}/{slo} {metric}: "
+               f"p50 {b.get('p50_ms', 0):.3g}ms "
+               f"p99 {b.get('p99_ms', 0):.3g}ms")
+        if b.get("breaches"):
+            row += f", breaches {int(b['breaches'])}"
+        if "burn_fast" in b or "burn_slow" in b:
+            row += (f", burn fast {b.get('burn_fast', 0.0):.2f}x"
+                    f" slow {b.get('burn_slow', 0.0):.2f}x")
+        if b.get("alerts"):
+            row += (f", ALERTS FIRED {int(b['alerts'])}"
+                    f" (see docs/troubleshooting.md burn-rate runbook)")
+        rows.append(row)
+    return "\n".join(rows)
 
 
 def autoscale_section(dumps: Dict[str, dict]) -> Optional[str]:

@@ -105,6 +105,10 @@ _ARG_ENV_MAP = {
         envmod.SERVE_TENANT_BUDGET,
         "serve.tenant-budget",
     ),
+    "slo_ttft_ms": (envmod.SERVE_SLO_TTFT_MS, "serve.slo-ttft-ms"),
+    "slo_tpot_ms": (envmod.SERVE_SLO_TPOT_MS, "serve.slo-tpot-ms"),
+    "slo_objective": (envmod.SERVE_SLO_OBJECTIVE, "serve.slo-objective"),
+    "slo_class": (envmod.SERVE_SLO_CLASS, "serve.slo-class"),
     "serve_autoscale": (envmod.SERVE_AUTOSCALE, "serve.autoscale"),
     "max_workers": (envmod.MAX_WORKERS, "serve.max-workers"),
     "scale_up_queue": (envmod.SCALE_UP_QUEUE, "serve.scale-up-queue"),

@@ -334,6 +334,36 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "throttling, identically derived on every rank.",
     )
     serve.add_argument(
+        "--slo-ttft-ms", type=float, action=_StoreOverrideAction,
+        dest="slo_ttft_ms", default=None,
+        help="Time-to-first-token SLO ceiling in ms for --slo-class "
+             "requests (HVDTPU_SERVE_SLO_TTFT_MS, unset = no ttft "
+             "objective).  Breaches spend the error budget the "
+             "two-window burn-rate alerts (obs/slo.py) page on.",
+    )
+    serve.add_argument(
+        "--slo-tpot-ms", type=float, action=_StoreOverrideAction,
+        dest="slo_tpot_ms", default=None,
+        help="Per-output-token SLO ceiling in ms for --slo-class "
+             "requests (HVDTPU_SERVE_SLO_TPOT_MS, unset = no tpot "
+             "objective).",
+    )
+    serve.add_argument(
+        "--slo-objective", type=float, action=_StoreOverrideAction,
+        dest="slo_objective", default=None,
+        help="Fraction of requests that must meet the SLO ceilings "
+             "(HVDTPU_SERVE_SLO_OBJECTIVE, default 0.99 — a 1%% error "
+             "budget the burn-rate alerts spend against).",
+    )
+    serve.add_argument(
+        "--slo-class", action=_StoreOverrideAction,
+        dest="slo_class", default=None,
+        help="Which SLO class the ceilings apply to "
+             "(HVDTPU_SERVE_SLO_CLASS, default interactive).  Traffic "
+             "in classes without a target is digested but never "
+             "alerts.",
+    )
+    serve.add_argument(
         "--serve-autoscale", action=_StoreTrueOverrideAction,
         dest="serve_autoscale", default=None,
         help="Load-driven autoscaling: the launcher watches the "
@@ -1945,6 +1975,8 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
 
     dumps = obs_summary.collect_dumps(raw)
     if not dumps:
+        for warn in getattr(dumps, "warnings", []):
+            print(f"hvdrun: --stats-summary: {warn}", file=sys.stderr)
         print("hvdrun: --stats-summary: no metrics dumps found "
               f"under {raw!r}", file=sys.stderr)
         return
@@ -1966,6 +1998,14 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if serve is not None:
         print("\n== serving plane ==")
         print(serve)
+    slo = obs_summary.slo_section(dumps)
+    if slo is not None:
+        print("\n== tenant SLO / burn rate ==")
+        print(slo)
+    goodput = obs_summary.goodput_section(dumps)
+    if goodput is not None:
+        print("\n== goodput ledger ==")
+        print(goodput)
     autoscale = obs_summary.autoscale_section(dumps)
     if autoscale is not None:
         print("\n== autoscale / weight hot-swap ==")

@@ -63,6 +63,21 @@ def parse_spec(argv=None) -> dict:
     p.add_argument("--swap-poll-steps", type=int, default=None,
                    help="serving steps between hot-swap manifest "
                         "polls (default 16)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="time-to-first-token objective ceiling in ms "
+                        "for --slo-class requests (unset = no ttft "
+                        "objective)")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   help="per-output-token objective ceiling in ms for "
+                        "--slo-class requests (unset = no tpot "
+                        "objective)")
+    p.add_argument("--slo-objective", type=float, default=None,
+                   help="fraction of requests that must meet the "
+                        "ceilings (default 0.99: a 1%% error budget "
+                        "the burn-rate alerts spend against)")
+    p.add_argument("--slo-class", default=None,
+                   help="which SLO class the ceilings apply to "
+                        "(default interactive)")
     args = p.parse_args(argv)
 
     import os  # noqa: PLC0415
@@ -103,6 +118,26 @@ def parse_spec(argv=None) -> dict:
     tenant_budget = pick(None, envmod.SERVE_TENANT_BUDGET, int, 0)
     if tenant_budget:
         spec["tenants"] = {"budget_tokens": tenant_budget}
+    # SLO objectives (obs/slo.py): fleet-wide like the QoS policy —
+    # every rank must judge the identical targets, so they ride the
+    # launcher-forwarded env with flag overrides.  Classes without a
+    # target never alert (untagged traffic trips nothing).
+    slo_ttft = pick(args.slo_ttft_ms, envmod.SERVE_SLO_TTFT_MS,
+                    float, 0.0)
+    slo_tpot = pick(args.slo_tpot_ms, envmod.SERVE_SLO_TPOT_MS,
+                    float, 0.0)
+    if slo_ttft or slo_tpot:
+        target = {
+            "objective": pick(args.slo_objective,
+                              envmod.SERVE_SLO_OBJECTIVE, float, 0.99),
+        }
+        if slo_ttft:
+            target["ttft_ms"] = slo_ttft
+        if slo_tpot:
+            target["tpot_ms"] = slo_tpot
+        cls = pick(args.slo_class, envmod.SERVE_SLO_CLASS, str,
+                   "interactive")
+        spec["slo"] = {cls: target}
     return spec
 
 

@@ -53,8 +53,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..elastic.exceptions import HorovodShutdownError
 from ..obs import get_registry
 from ..obs import flightrec as obs_flightrec
+from ..obs import goodput as obs_goodput
 from ..obs import memplane
 from ..obs import progress as obs_progress
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..testing.faults import maybe_fail
 from ..utils.logging import get_logger
@@ -343,7 +345,9 @@ def _publish_out(kv, rid: str, *, tokens, done: bool, epoch: int,
 
 
 def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
-                 profiler=None, swap: Optional[SwapManager] = None):
+                 profiler=None, swap: Optional[SwapManager] = None,
+                 slo_plane: Optional[obs_slo.SLOPlane] = None,
+                 tok_goodput: Optional[obs_goodput.TokenGoodput] = None):
     """One rendezvous epoch of the serving loop.  Returns the per-rank
     summary dict on a clean drain (``serve/stop``), raises
     HorovodShutdownError on a world break (the caller re-enters).
@@ -796,6 +800,12 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 # this histogram's sample agree by construction.
                 ttft_ms = max(t_a1 - adm.req.arrival, 0.0) * 1000.0
                 reg.histogram("serve.ttft_ms").observe(ttft_ms)
+                if slo_plane is not None:
+                    # The SLO accountant sees the SAME sample with its
+                    # tenant tag: objectives are judged per
+                    # (tenant, class), never on the fleet aggregate.
+                    slo_plane.observe_ttft(adm.req.tenant, adm.req.slo,
+                                           ttft_ms, t_a1)
             if req_traced:
                 # The four spans tile [arrival, first token] exactly:
                 # queue_wait ends where this step began, the broadcast
@@ -848,6 +858,10 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
             for slot in active:
                 sched.record(slot, toks[slot])
                 reg.histogram("serve.tpot_ms").observe(step_ms)
+                if slo_plane is not None:
+                    req = sched.active[slot].req
+                    slo_plane.observe_tpot(req.tenant, req.slo,
+                                           step_ms, t_d1)
             rate_win.observe(t_d1, len(active))
             if profiler is not None:
                 profiler.observe(t_d1 - t_d0)
@@ -1017,9 +1031,22 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
         # cannot disagree about throughput.
         reg.gauge("serve.tokens_per_sec").set(rate_win.rate(t_step1))
         reg.counter("serve.steps").inc()
-        totals["tokens"] += len(active) + sum(
+        step_tokens = len(active) + sum(
             1 for a in admissions if not a.resume
         )
+        totals["tokens"] += step_tokens
+        if tok_goodput is not None:
+            # Token goodput: tokens actually decoded over slot-step
+            # capacity — idle steps count zero tokens on a full pool,
+            # which is exactly the wasted capacity the fraction must
+            # show.  Published beside the KV-occupancy gauges above.
+            tok_goodput.observe_step(step_tokens)
+            tok_goodput.publish(reg, t_step1)
+        if slo_plane is not None:
+            # Burn-rate accounting every step: the two-window alerts
+            # land in serve.slo.* (live stream + digest + summary) the
+            # same step they start firing.
+            slo_plane.publish(reg, t_step1)
         obs_progress.tick()
 
         if sdoc["stop"] and sched.idle():
@@ -1052,6 +1079,24 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                     }
                     for t in tenants
                 }
+            if slo_plane is not None and slo_plane.observed:
+                # The SLO verdict travels with the drain summary: what
+                # bench records and --stats-summary judge the latency
+                # objectives by.
+                out["slo"] = slo_plane.summary(time.time())
+            if tok_goodput is not None:
+                t_now = time.time()
+                out["goodput"] = {
+                    "token_fraction": round(tok_goodput.fraction(), 6),
+                    "tokens_per_slot_sec": round(
+                        tok_goodput.per_slot_second(t_now), 4),
+                }
+                ledger = obs_goodput.get_ledger()
+                if ledger is not None:
+                    # The wall-clock ledger's story for this rank:
+                    # fractions per class + the per-epoch lost-time
+                    # attribution.
+                    out["goodput"]["wall"] = ledger.summary(t_now)
             if swap is not None:
                 # Every rank reports the version it drained on — the
                 # single-version chaos gate asserts these agree.
@@ -1178,12 +1223,22 @@ def serve_worker(spec: Optional[dict] = None):
               "kv_alloc_peak": 0, "done_rids": set(),
               "admitted_rids": set(),
               "tenant_throttled": {}, "tenant_admitted_tokens": {}}
+    # Goodput + SLO planes (ISSUE 17), built ONCE per process so their
+    # sliding windows and lost-time books span world re-formations:
+    # the wall-clock ledger (fed by the flight-recorder tap — the
+    # rendezvous/phase events this loop already records become
+    # transitions), the token-goodput accountant over the slot pool,
+    # and the per-tenant burn-rate plane from the spec's objectives.
+    obs_goodput.install()
+    tok_goodput = obs_goodput.TokenGoodput(spec["num_slots"],
+                                           time.time())
+    slo_plane = obs_slo.SLOPlane(obs_slo.targets_from_spec(spec))
     from ..exceptions import RankDroppedError  # noqa: PLC0415
 
     while True:
         try:
             return _serve_epoch(ctx, engine, spec, totals, profiler,
-                                swap)
+                                swap, slo_plane, tok_goodput)
         except RankDroppedError:
             # Deliberate scale-down (or a shrink past this rank): the
             # launcher re-minted a world without us.  That is a clean

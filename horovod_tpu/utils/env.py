@@ -156,6 +156,15 @@ DEFAULT_SERVE_OUT_TTL = 300.0
 # must derive the identical admission policy).
 SERVE_FRONTENDS = "HVDTPU_SERVE_FRONTENDS"
 SERVE_TENANT_BUDGET = "HVDTPU_SERVE_TENANT_BUDGET"
+# SLO objectives (obs/slo.py, ISSUE 17): latency targets for one SLO
+# class (SLO_CLASS, default "interactive") — TTFT/TPOT ceilings in ms
+# and the objective fraction (default 0.99 = 1% error budget).  Fleet-
+# wide like the QoS policy: every rank judges the same objectives, so
+# they travel the launcher-forwarded env.
+SERVE_SLO_CLASS = "HVDTPU_SERVE_SLO_CLASS"
+SERVE_SLO_TTFT_MS = "HVDTPU_SERVE_SLO_TTFT_MS"
+SERVE_SLO_TPOT_MS = "HVDTPU_SERVE_SLO_TPOT_MS"
+SERVE_SLO_OBJECTIVE = "HVDTPU_SERVE_SLO_OBJECTIVE"
 # Autoscale (serve/autoscale.py): launcher-local knobs; carried as env
 # so config files can set them and operators can see them in ps.  The
 # envelope ceiling MAX_WORKERS also sizes the launcher's slot

@@ -137,3 +137,52 @@ class _FakeThread:
 
     def start(self):
         pass
+
+
+def test_backend_provenance_no_probe_never_imports_jax(monkeypatch):
+    """Degraded give-up paths may fire while ``import jax`` is the very
+    thing that hangs: probe=False must only read sys.modules, never
+    import."""
+    import builtins
+    import sys as _sys
+
+    monkeypatch.setitem(_sys.modules, "jax", None)
+    monkeypatch.delitem(_sys.modules, "jax")
+    real_import = builtins.__import__
+
+    def guard(name, *a, **k):
+        if name == "jax" or name.startswith("jax."):
+            raise AssertionError("probe=False imported jax")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", guard)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    prov = bench.backend_provenance(probe=False)
+    assert prov == {"platform": None, "device_kind": None,
+                    "jax_platforms": "cpu"}
+
+
+def test_backend_provenance_probe_reports_device(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    prov = bench.backend_provenance(probe=True)
+    assert prov["platform"] == "cpu"
+    assert prov["device_kind"]
+    assert prov["jax_platforms"] == "cpu"
+
+
+def test_degraded_record_carries_provenance_stamp(tmp_path, monkeypatch):
+    """Satellite acceptance: every degraded BENCH record embeds the
+    backend-provenance stamp, so the perf gate can separate 'ran on
+    CPU' from 'tunnel flaked' without guessing."""
+    import json
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    path = bench.write_degraded_record(
+        "watchdog fired", rc=86, phase="measure",
+        record_dir=str(tmp_path),
+    )
+    doc = json.load(open(path))
+    assert doc["degraded"] is True
+    prov = doc["provenance"]
+    assert set(prov) == {"platform", "device_kind", "jax_platforms"}
+    assert prov["jax_platforms"] == "cpu"

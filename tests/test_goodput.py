@@ -1,0 +1,280 @@
+"""Goodput ledger (obs/goodput.py): decision-table transitions on a
+fake clock, the tiling invariant (fractions sum to 1.0 with no gap and
+no overlap), per-epoch lost-time attribution across elastic respawn,
+the flightrec event-vocabulary mapping, the post-hoc event-fold
+reconstruction, the serving token-goodput variant, and the live wiring
+(flight-recorder tap + registry collector surviving reset_registry)."""
+
+from __future__ import annotations
+
+import pytest
+
+import horovod_tpu.obs as obs
+from horovod_tpu.obs import flightrec
+from horovod_tpu.obs import goodput
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    obs.reset_registry()
+    goodput.uninstall()
+    yield
+    goodput.uninstall()
+    obs.reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# ledger decision table (fake clock throughout — no call reads a clock)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_decision_table_tiles_the_wall_clock():
+    led = goodput.GoodputLedger(start=0.0)
+    led.enter("compile", 10.0)
+    led.enter("productive_step", 30.0)
+    led.enter("collective_wait", 40.0)
+    led.resume(42.0)
+    led.enter("checkpoint", 50.0)
+    led.resume(53.0)
+    led.epoch_start(1, 60.0, cause="rendezvous")
+    led.enter("productive_step", 65.0)
+
+    secs = led.secs(100.0)
+    assert secs["init"] == pytest.approx(10.0)
+    assert secs["compile"] == pytest.approx(20.0)
+    assert secs["collective_wait"] == pytest.approx(2.0)
+    assert secs["checkpoint"] == pytest.approx(3.0)
+    assert secs["recovery"] == pytest.approx(5.0)
+    # productive: (40-30) + (50-42) + (60-53) + (100-65)
+    assert secs["productive_step"] == pytest.approx(60.0)
+    assert sum(secs.values()) == pytest.approx(100.0)
+
+    fr = led.fractions(100.0)
+    assert sum(fr.values()) == pytest.approx(1.0, abs=1e-6)
+    assert fr["productive_step"] == pytest.approx(0.6)
+
+
+def test_resume_returns_to_the_interrupted_class():
+    # a checkpoint taken during COMPILE must resume compile, not
+    # productive time
+    led = goodput.GoodputLedger(start=0.0, cls="compile")
+    led.enter("checkpoint", 5.0)
+    led.resume(7.0)
+    assert led.current == "compile"
+    # nested excursion: ckpt during a collective wait resumes the wait's
+    # own resume target (the pre-excursion class), never the excursion
+    led.enter("productive_step", 10.0)
+    led.enter("collective_wait", 12.0)
+    led.enter("checkpoint", 13.0)
+    led.resume(14.0)
+    assert led.current == "productive_step"
+
+
+def test_lost_time_charged_to_the_epoch_that_paid_for_it():
+    """Acceptance decision table: an elastic respawn's recovery seconds
+    land under the NEW epoch, keyed by cause."""
+    led = goodput.GoodputLedger(start=0.0)
+    led.enter("productive_step", 2.0)
+    led.epoch_start(1, 10.0, cause="rendezvous")   # epoch 1 begins
+    led.enter("productive_step", 16.0)             # 6s rendezvous
+    led.epoch_start(2, 20.0, cause="respawn")      # epoch 2 begins
+    led.enter("productive_step", 29.0)             # 9s respawn
+
+    lost = led.lost(40.0)
+    assert lost == {1: {"rendezvous": pytest.approx(6.0)},
+                    2: {"respawn": pytest.approx(9.0)}}
+    by_epoch = led.by_epoch(40.0)
+    assert by_epoch[1]["recovery"] == pytest.approx(6.0)
+    assert by_epoch[2]["recovery"] == pytest.approx(9.0)
+    # epoch 0 never saw recovery
+    assert "recovery" not in by_epoch[0]
+    # and the tiling invariant still holds across all three epochs
+    assert sum(led.secs(40.0).values()) == pytest.approx(40.0)
+    assert sum(led.fractions(40.0).values()) == pytest.approx(1.0,
+                                                              abs=1e-6)
+
+
+def test_backwards_clock_clamps_to_zero_length():
+    led = goodput.GoodputLedger(start=100.0)
+    led.enter("productive_step", 90.0)  # wall clock stepped back
+    secs = led.secs(110.0)
+    assert secs["init"] == 0.0
+    assert secs["productive_step"] == pytest.approx(10.0)
+    assert all(s >= 0.0 for s in secs.values())
+
+
+def test_empty_ledger_fractions_are_zero_not_nan():
+    led = goodput.GoodputLedger(start=5.0)
+    assert led.fractions(5.0) == {c: 0.0 for c in goodput.CLASSES}
+
+
+def test_unknown_class_rejected():
+    led = goodput.GoodputLedger(start=0.0)
+    with pytest.raises(ValueError):
+        led.enter("napping", 1.0)
+    with pytest.raises(ValueError):
+        goodput.GoodputLedger(start=0.0, cls="napping")
+
+
+# ---------------------------------------------------------------------------
+# event vocabulary -> transitions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind,name,expect", [
+    ("phase", "init", ("init", None)),
+    ("phase", "compile", ("compile", None)),
+    ("phase", "steady", ("productive_step", None)),
+    ("phase", "mystery", None),
+    ("rendezvous", "epoch2", ("recovery", "rendezvous")),
+    ("ckpt.begin", "", ("checkpoint", None)),
+    ("ckpt.commit", "", ("resume", None)),
+    ("ckpt.error", "", ("resume", None)),
+    ("ckpt.restore_disk", "", ("recovery", "respawn")),
+    ("init", "serve_replay", ("recovery", "respawn")),
+    ("init", "basics", None),
+    ("stall", "", ("recovery", "stall")),
+    ("signal", "SIGTERM", ("degraded", None)),
+    ("exception", "ValueError", ("degraded", None)),
+    ("enqueue", "ALLREDUCE", None),
+    ("complete", "ALLREDUCE", None),
+])
+def test_classify_event_table(kind, name, expect):
+    assert goodput.classify_event(kind, name) == expect
+
+
+def test_ledger_from_events_chaos_run_with_respawn():
+    """The acceptance chaos shape, post-hoc: init -> compile -> steady,
+    a checkpoint excursion, a rendezvous into epoch 1 (elastic
+    respawn), steady again — fractions must sum to 1.0 (±1e-6) and the
+    lost time must land under epoch 1."""
+    events = [
+        {"t": 0.0, "kind": "phase", "name": "init"},
+        {"t": 4.0, "kind": "phase", "name": "compile"},
+        {"t": 14.0, "kind": "phase", "name": "steady"},
+        {"t": 20.0, "kind": "ckpt.begin", "name": "v1"},
+        {"t": 22.0, "kind": "ckpt.commit", "name": "v1"},
+        {"t": 30.0, "kind": "rendezvous", "name": "epoch1", "cycle": 1},
+        {"t": 36.0, "kind": "phase", "name": "steady"},
+        {"t": 40.0, "kind": "enqueue", "name": "ALLREDUCE"},  # ignored
+    ]
+    led = goodput.ledger_from_events(events, start=0.0, end=50.0)
+    secs = led.secs()
+    assert secs["init"] == pytest.approx(4.0)
+    assert secs["compile"] == pytest.approx(10.0)
+    assert secs["checkpoint"] == pytest.approx(2.0)
+    assert secs["recovery"] == pytest.approx(6.0)
+    assert secs["productive_step"] == pytest.approx(28.0)
+    assert sum(led.fractions().values()) == pytest.approx(1.0, abs=1e-6)
+    assert led.lost() == {1: {"rendezvous": pytest.approx(6.0)}}
+    assert led.epoch == 1
+
+
+def test_ledger_from_events_unstamped_rendezvous_increments_epoch():
+    events = [
+        {"t": 0.0, "kind": "phase", "name": "steady"},
+        {"t": 5.0, "kind": "rendezvous", "name": "epochX"},  # no cycle
+        {"t": 8.0, "kind": "phase", "name": "steady"},
+    ]
+    led = goodput.ledger_from_events(events, start=0.0, end=10.0)
+    assert led.epoch == 1
+    assert led.lost() == {1: {"rendezvous": pytest.approx(3.0)}}
+
+
+def test_summary_document_shape():
+    led = goodput.GoodputLedger(start=0.0)
+    led.enter("productive_step", 5.0)
+    led.epoch_start(1, 8.0, cause="stall")
+    led.enter("productive_step", 9.0)
+    doc = led.summary(10.0)
+    # productive: (8-5) closed + (10-9) open = 4 of 10 total
+    assert doc["fraction"] == pytest.approx(0.4)
+    assert doc["secs"]["init"] == pytest.approx(5.0)
+    assert "idle" not in doc["secs"]  # zero classes are elided
+    assert doc["lost"] == {"1": {"stall": 1.0}}
+
+
+# ---------------------------------------------------------------------------
+# publishing
+# ---------------------------------------------------------------------------
+
+
+def test_publish_gauges_land_in_registry():
+    led = goodput.GoodputLedger(start=0.0)
+    led.enter("productive_step", 4.0)
+    led.epoch_start(1, 8.0)
+    led.enter("productive_step", 9.0)
+    reg = obs.get_registry()
+    led.publish(reg, 10.0)
+    snap = {(m["name"], tuple(sorted((m.get("tags") or {}).items()))): m
+            for m in reg.snapshot()}
+    # productive: (8-4) closed + (10-9) open = 5 of 10 total
+    assert snap[("goodput.fraction", ())]["value"] == pytest.approx(0.5)
+    assert snap[("goodput.secs", (("class", "init"),))]["value"] \
+        == pytest.approx(4.0)
+    assert snap[("goodput.lost_secs", (("cause", "rendezvous"),))][
+        "value"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# serving token goodput
+# ---------------------------------------------------------------------------
+
+
+def test_token_goodput_fraction_and_rate():
+    tg = goodput.TokenGoodput(slots=4, start=100.0)
+    assert tg.fraction() == 0.0
+    for _ in range(3):
+        tg.observe_step(3)
+    tg.observe_step(0)  # idle step still burns capacity
+    assert tg.fraction() == pytest.approx(9 / 16)
+    assert tg.per_slot_second(102.0) == pytest.approx(9 / (2.0 * 4))
+    reg = obs.get_registry()
+    tg.publish(reg, 102.0)
+    snap = {m["name"]: m for m in reg.snapshot()}
+    assert snap["serve.goodput.token_fraction"]["value"] \
+        == pytest.approx(9 / 16, abs=1e-6)
+    assert snap["serve.goodput.tokens_per_slot_sec"]["value"] \
+        == pytest.approx(1.125, abs=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# live wiring: flightrec tap + collector
+# ---------------------------------------------------------------------------
+
+
+def test_install_tap_feeds_ledger_from_flightrec_events():
+    led = goodput.install(now=0.0)
+    assert goodput.get_ledger() is led
+    flightrec.record("phase", name="steady")
+    assert led.current == "productive_step"
+    flightrec.record("rendezvous", name="epoch3", cycle=3)
+    assert led.current == "recovery"
+    assert led.epoch == 3
+    flightrec.record("enqueue", name="ALLREDUCE")  # no transition
+    assert led.current == "recovery"
+
+
+def test_collector_publishes_into_dump_snapshot():
+    goodput.install(now=0.0)
+    flightrec.record("phase", name="steady")
+    names = {m["name"] for m in obs.get_registry().snapshot()}
+    assert "goodput.fraction" in names
+    assert "goodput.secs" in names
+
+
+def test_collector_survives_registry_reset_and_reinstall():
+    goodput.install(now=0.0)
+    obs.reset_registry()  # fresh registry: the old hook is gone
+    goodput.install(now=1.0)  # re-arm registers on the NEW instance
+    names = {m["name"] for m in obs.get_registry().snapshot()}
+    assert "goodput.fraction" in names
+
+
+def test_uninstalled_tap_is_a_noop():
+    goodput.install(now=0.0)
+    goodput.uninstall()
+    flightrec.record("phase", name="steady")  # must not raise
+    assert goodput.get_ledger() is None
+    names = {m["name"] for m in obs.get_registry().snapshot()}
+    assert "goodput.fraction" not in names

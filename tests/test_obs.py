@@ -319,6 +319,34 @@ def test_timeline_truncated_file_still_loads(tmp_path):
     assert all(e.get("name") for e in events)
 
 
+def test_timeline_mid_write_repair_unterminated_trailing_line(tmp_path):
+    """A rank killed exactly mid-write leaves an UNTERMINATED trailing
+    line (no newline, not even a closed JSON string); load_events must
+    drop only that line and keep every complete event before it."""
+    path = str(tmp_path / "t.rank.0.json")
+    open(path, "w").write(
+        "[\n"
+        '{"ph": "B", "name": "a", "ts": 1, "pid": 0, "tid": 0},\n'
+        '{"ph": "E", "name": "a", "ts": 2, "pid": 0, "tid": 0},\n'
+        '{"ph": "B", "name": "b", "ts": 3, "pi'  # cut mid-key, no \n
+    )
+    events = timeline_merge.load_events(path)
+    assert [e["name"] for e in events] == ["a", "a"]
+    # the repaired events still merge into a valid Chrome trace
+    out = str(tmp_path / "merged.json")
+    n = timeline_merge.merge([path], out)
+    assert n >= 2
+    json.load(open(out))
+
+
+def test_timeline_repair_single_partial_line_yields_empty(tmp_path):
+    """Degenerate mid-write: the whole file is one unterminated line —
+    repair converges to an empty event list, never an exception."""
+    path = str(tmp_path / "t.rank.0.json")
+    open(path, "w").write('{"ph": "B", "na')
+    assert timeline_merge.load_events(path) == []
+
+
 def test_timeline_merge_lanes_and_validity(tmp_path):
     for rank in (0, 1):
         tl = Timeline(str(tmp_path / f"t.rank.{rank}.json"), rank=rank)
@@ -493,6 +521,74 @@ def test_summary_tolerates_garbage_and_epoch_tags(tmp_path):
     dumps = obs_summary.collect_dumps(str(tmp_path))
     assert set(dumps) == {"0", "2@e1"}
     assert obs_summary.summarize(str(tmp_path / "missing")) is None
+
+
+def test_summary_corrupt_dump_named_in_table_header(tmp_path):
+    """A truncated per-rank dump is skipped but NAMED: the table header
+    says which file was dropped and why, so a missing column reads as
+    'dump was corrupt', never as 'rank never dumped'."""
+    good = _write_dump(tmp_path, 0, {"x": 1})
+    # simulate the mid-write kill: cut the good dump's twin in half
+    text = open(good).read()
+    (tmp_path / "metrics.rank.7.json").write_text(text[: len(text) // 2])
+    # and a schema-invalid (valid-JSON) file alongside
+    (tmp_path / "metrics.rank.8.json").write_text('{"rank": "8"}')
+    dumps = obs_summary.collect_dumps(str(tmp_path))
+    assert set(dumps) == {"0"}
+    assert len(dumps.warnings) == 2
+    assert any("metrics.rank.7.json" in w for w in dumps.warnings)
+    assert any("metrics.rank.8.json" in w for w in dumps.warnings)
+    table = obs_summary.format_summary_table(dumps)
+    header = table.splitlines()[:3]
+    assert any("WARNING" in line and "metrics.rank.7.json" in line
+               for line in header)
+
+
+def test_summary_goodput_section(tmp_path):
+    from horovod_tpu.obs import goodput as obs_goodput
+
+    obs.reset_registry()
+    reg = obs.get_registry()
+    led = obs_goodput.GoodputLedger(start=0.0)
+    led.enter("productive_step", 3.0)
+    led.epoch_start(1, 8.0)
+    led.enter("productive_step", 9.0)
+    led.publish(reg, 10.0)
+    tg = obs_goodput.TokenGoodput(slots=4, start=0.0)
+    tg.observe_step(3)
+    tg.publish(reg, 1.0)
+    path = str(tmp_path / "metrics.rank.0.json")
+    reg.dump(path, rank="0")
+    dumps = obs_summary.collect_dumps(str(tmp_path))
+    section = obs_summary.goodput_section(dumps)
+    assert section is not None
+    # productive: (8-3) closed + (10-9) open = 6 of 10 total
+    assert "goodput 60.0%" in section
+    assert "recovery" in section and "lost rendezvous" in section
+    assert "token goodput 75.0%" in section
+    # training-only dumps produce no section
+    assert obs_summary.goodput_section({"0": {"metrics": []}}) is None
+
+
+def test_summary_slo_section(tmp_path):
+    from horovod_tpu.obs import slo as obs_slo
+
+    obs.reset_registry()
+    reg = obs.get_registry()
+    plane = obs_slo.SLOPlane(
+        {"interactive": obs_slo.SLOTarget(ttft_ms=500.0)})
+    for i in range(5):
+        plane.observe_ttft("acme", "interactive", 900.0, float(i))
+    plane.publish(reg, 5.0)
+    path = str(tmp_path / "metrics.rank.0.json")
+    reg.dump(path, rank="0")
+    dumps = obs_summary.collect_dumps(str(tmp_path))
+    section = obs_summary.slo_section(dumps)
+    assert section is not None
+    assert "acme/interactive ttft" in section
+    assert "breaches 5" in section
+    assert "ALERTS FIRED" in section
+    assert obs_summary.slo_section({"0": {"metrics": []}}) is None
 
 
 # ---------------------------------------------------------------------------

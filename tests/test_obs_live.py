@@ -125,6 +125,28 @@ def test_delta_tombstones_removed_instruments():
 # ---------------------------------------------------------------------------
 
 
+def test_stream_compact_quantile_roundtrip():
+    """The wire compaction renames histogram percentiles p50/p90/p99 to
+    q50/q90/q99 and back; one dropped or mis-mapped quantile here would
+    silently skew every live digest and /metrics summary."""
+    reg = obs.get_registry()
+    h = reg.histogram("lat.ms")
+    for v in (1.0, 5.0, 9.0, 40.0, 400.0):
+        h.observe(v)
+    (metric,) = [m for m in reg.snapshot() if m["name"] == "lat.ms"]
+    compact = obs_stream._compact(metric)
+    assert {"q50", "q90", "q99"} <= set(compact)
+    assert not {"p50", "p90", "p99"} & set(compact)
+    assert compact["q50"] == metric["p50"]
+    assert compact["q90"] == metric["p90"]
+    assert compact["q99"] == metric["p99"]
+    back = obs_stream.expand_metric(json.loads(json.dumps(compact)))
+    for field in ("p50", "p90", "p99", "count", "sum", "min", "max"):
+        assert back[field] == metric[field], field
+    assert not {"q50", "q90", "q99"} & set(back)
+    assert back["mean"] == pytest.approx(metric["mean"])
+
+
 def test_publisher_to_aggregator_end_to_end(kv_server, tmp_path):
     reg = obs.get_registry()
     _populate(reg)
@@ -383,6 +405,139 @@ def test_prometheus_exposition_is_valid_and_labelled():
     # label and must be renamed, not duplicated (scrapers reject dups)
     assert ('hvdtpu_engine_straggler_last_arrivals'
             '{rank="0",epoch="1",tag_rank="1"} 1.0') in text
+
+
+def _strict_parse_labels(line):
+    """Char-level strict parse of one sample line's label block (the
+    grammar real scrapers implement): label values may contain ONLY the
+    escapes ``\\\\``, ``\\"`` and ``\\n``; a raw quote or backslash is
+    a hard parse error.  Returns {label: unescaped value}."""
+    if "{" not in line:
+        return {}
+    block = line[line.index("{") + 1: line.rindex("}")]
+    labels = {}
+    i = 0
+    while i < len(block):
+        eq = block.index("=", i)
+        key = block[i:eq]
+        assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", key), \
+            f"bad label name {key!r} in {line!r}"
+        assert block[eq + 1] == '"', f"unquoted value in {line!r}"
+        j = eq + 2
+        out = []
+        while True:
+            assert j < len(block), f"unterminated value in {line!r}"
+            c = block[j]
+            if c == "\\":
+                esc = block[j + 1] if j + 1 < len(block) else ""
+                assert esc in ('\\', '"', 'n'), \
+                    f"illegal escape \\{esc} in {line!r}"
+                out.append({"\\": "\\", '"': '"', "n": "\n"}[esc])
+                j += 2
+            elif c == '"':
+                j += 1
+                break
+            else:
+                assert c != "\n", f"raw newline in value in {line!r}"
+                out.append(c)
+                j += 1
+        assert key not in labels, f"duplicate label {key} in {line!r}"
+        labels[key] = "".join(out)
+        i = j + 1 if j < len(block) and block[j] == "," else j
+    return labels
+
+
+def test_prometheus_hostile_label_values_roundtrip():
+    """Satellite acceptance: program names (and any instrument tag) can
+    carry quotes, backslashes and newlines — the exposition must escape
+    them so a strict parser recovers the ORIGINAL value, and the rest
+    of the line must stay well-formed."""
+    hostile = 'jit_train"step\\fused\nphase2'
+    reg = obs.get_registry()
+    reg.gauge("mem.compiled.total_bytes", program=hostile).set(123.0)
+    reg.gauge("perf.step_ms").set(5.0)
+    agg = obs_live.LiveAggregator()
+    agg.ingest(_payload(
+        0, 0, 0,
+        obs_stream.encode_delta({}, obs_stream.snapshot_map(reg.snapshot())),
+    ))
+    text = agg.prometheus()
+    assert text.endswith("\n")
+    # no raw newline may survive inside any sample line: the hostile
+    # value must occupy ONE line
+    sample_lines = [l for l in text.splitlines()
+                    if l.startswith("hvdtpu_mem_compiled_total_bytes")]
+    assert len(sample_lines) == 1
+    labels = _strict_parse_labels(sample_lines[0])
+    assert labels["program"] == hostile
+    assert labels["rank"] == "0"
+    # and every line in the whole exposition strict-parses
+    for line in text.rstrip("\n").splitlines():
+        if not line.startswith("#"):
+            _strict_parse_labels(line)
+            assert re.search(r" (NaN|[-+]?[0-9.eE+-]+)$", line), line
+
+
+def test_prometheus_escape_function_table():
+    esc = obs_live.prometheus_escape
+    assert esc('plain') == 'plain'
+    assert esc('a"b') == 'a\\"b'
+    assert esc('a\\b') == 'a\\\\b'
+    assert esc('a\nb') == 'a\\nb'
+    # backslash-first ordering: escaping must not double-process
+    assert esc('\\n') == '\\\\n'
+
+
+def test_digest_and_history_surface_slo_alert():
+    """A firing burn-rate alert must be visible in the live digest line
+    and counted in live_history.jsonl rows; a healthy plane shows the
+    quiet token; jobs with no SLO traffic show nothing."""
+    fast = {"g": {"tenant": "acme", "slo": "interactive",
+                  "metric": "ttft", "window": "fast"}}
+    agg = obs_live.LiveAggregator()
+    agg.ingest(_payload(0, 0, 0, [
+        dict({"n": "serve.slo.burn", "k": "g", "v": 12.3}, **fast),
+        dict({"n": "serve.slo.alert", "k": "g", "v": 1.0}, **fast),
+        {"n": "serve.slo.alerts", "k": "c", "v": 1,
+         "g": {"tenant": "acme", "slo": "interactive", "metric": "ttft"}},
+    ]))
+    d = agg.digest(1)
+    assert "slo ALERT acme/interactive ttft fast" in d
+    assert "12.3x" in d
+    row = agg.history_row(1)
+    assert row["slo"] == {"firing": 1, "alerts": 1}
+    # healthy: burn present, alert gauge 0
+    agg2 = obs_live.LiveAggregator()
+    agg2.ingest(_payload(0, 0, 0, [
+        dict({"n": "serve.slo.burn", "k": "g", "v": 0.4}, **fast),
+        dict({"n": "serve.slo.alert", "k": "g", "v": 0.0}, **fast),
+    ]))
+    assert "slo OK burn 0.4x" in agg2.digest(1)
+    assert agg2.history_row(1)["slo"] == {"firing": 0, "alerts": 0}
+    # no SLO series at all: no token, no history key
+    agg3 = obs_live.LiveAggregator()
+    agg3.ingest(_payload(0, 0, 0, []))
+    assert "slo" not in agg3.digest(1)
+    assert "slo" not in agg3.history_row(1)
+
+
+def test_digest_goodput_token_names_worst_rank_sink():
+    agg = obs_live.LiveAggregator()
+    agg.ingest(_payload(0, 0, 0, [
+        {"n": "goodput.fraction", "k": "g", "v": 0.9},
+    ]))
+    agg.ingest(_payload(1, 0, 0, [
+        {"n": "goodput.fraction", "k": "g", "v": 0.6},
+        {"n": "goodput.secs", "k": "g", "v": 30.0,
+         "g": {"class": "recovery"}},
+        {"n": "goodput.secs", "k": "g", "v": 5.0,
+         "g": {"class": "compile"}},
+        {"n": "goodput.secs", "k": "g", "v": 60.0,
+         "g": {"class": "productive_step"}},
+    ]))
+    d = agg.digest(2)
+    assert "goodput 60%" in d  # the worst rank, not the average
+    assert "top sink recovery 30s" in d
 
 
 def test_metrics_endpoint_render_failure_is_5xx(kv_server):

@@ -1,0 +1,212 @@
+"""Tenant SLO burn-rate plane (obs/slo.py): target parsing from the
+serve spec, the two-window alerting decision table on a fake clock
+(acceptance: a forced ttft breach on an ``interactive`` tenant fires
+within two fast windows; untagged traffic trips nothing), rising-edge
+alert counting, the minimum-sample guard, registry publishing, and the
+drain summary document."""
+
+from __future__ import annotations
+
+import pytest
+
+import horovod_tpu.obs as obs
+from horovod_tpu.obs import slo
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    obs.reset_registry()
+    yield
+    obs.reset_registry()
+
+
+def _plane(**kw):
+    targets = {"interactive": slo.SLOTarget(ttft_ms=500.0, tpot_ms=80.0,
+                                            objective=0.99)}
+    return slo.SLOPlane(targets, **kw)
+
+
+# ---------------------------------------------------------------------------
+# targets
+# ---------------------------------------------------------------------------
+
+
+def test_targets_from_spec_parses_classes():
+    spec = {"slo": {
+        "interactive": {"ttft_ms": 500, "tpot_ms": 80,
+                        "objective": 0.99},
+        "standard": {"ttft_ms": 2000},
+        "batch": {},                 # no ceilings: dropped
+        "junk": "not a dict",        # tolerated
+    }}
+    targets = slo.targets_from_spec(spec)
+    assert set(targets) == {"interactive", "standard"}
+    assert targets["interactive"].threshold_ms("ttft") == 500.0
+    assert targets["interactive"].threshold_ms("tpot") == 80.0
+    assert targets["standard"].threshold_ms("tpot") is None
+    assert targets["standard"].objective == slo.DEFAULT_OBJECTIVE
+    assert targets["interactive"].budget == pytest.approx(0.01)
+
+
+def test_targets_from_spec_absent_is_empty():
+    assert slo.targets_from_spec({}) == {}
+    assert slo.targets_from_spec({"slo": None}) == {}
+    assert not slo.SLOPlane({}).armed
+
+
+def test_objective_must_be_a_fraction():
+    with pytest.raises(ValueError):
+        slo.SLOTarget(ttft_ms=500.0, objective=1.0)
+    with pytest.raises(ValueError):
+        slo.SLOTarget(ttft_ms=500.0, objective=0.0)
+
+
+# ---------------------------------------------------------------------------
+# alerting decision table
+# ---------------------------------------------------------------------------
+
+
+def test_forced_ttft_breach_fires_within_two_fast_windows():
+    """Acceptance: every interactive first token lands at 900ms against
+    a 500ms ceiling — the fast window must page before two fast windows
+    (120s) elapse.  Here it fires as soon as the minimum sample count
+    is in, well inside the first window."""
+    plane = _plane()
+    t = 0.0
+    fired_at = None
+    while t < 2 * plane.fast_window:
+        plane.observe_ttft("acme", "interactive", 900.0, t)
+        alerts = plane.evaluate(t)
+        if any(a["window"] == "fast" for a in alerts):
+            fired_at = t
+            break
+        t += 5.0
+    assert fired_at is not None and fired_at < 2 * plane.fast_window
+    fast = [a for a in plane.evaluate(fired_at)
+            if a["window"] == "fast"][0]
+    assert fast["tenant"] == "acme"
+    assert fast["slo"] == "interactive"
+    assert fast["metric"] == "ttft"
+    # all-breach traffic burns at 1/budget = 100x: far past threshold
+    assert fast["burn"] >= plane.thresholds["fast"]
+
+
+def test_untagged_traffic_trips_nothing():
+    """Traffic whose SLO class carries no target is digested but can
+    never alert — even at 100% breach-looking latencies."""
+    plane = _plane()
+    for i in range(50):
+        plane.observe_ttft("anon", "batch", 99999.0, float(i))
+        plane.observe_tpot("anon", "batch", 99999.0, float(i))
+    assert plane.evaluate(50.0) == []
+    assert plane.burn_rates(50.0) == {}
+    # but the digest still exists (percentiles are worth seeing)
+    doc = plane.summary(50.0)
+    assert doc["anon/batch"]["ttft"]["n"] == 50
+    assert "burn_fast" not in doc["anon/batch"]["ttft"]
+    assert doc["anon/batch"]["ttft"]["breaches"] == 0
+
+
+def test_healthy_traffic_never_fires():
+    plane = _plane()
+    for i in range(100):
+        plane.observe_ttft("acme", "interactive", 120.0, float(i))
+    assert plane.evaluate(100.0) == []
+    burns = plane.burn_rates(100.0)
+    assert burns[("acme", "interactive", "ttft")]["fast"] == 0.0
+
+
+def test_min_sample_guard_one_unlucky_request_pages_nobody():
+    plane = _plane()
+    plane.observe_ttft("acme", "interactive", 5000.0, 0.0)
+    plane.observe_ttft("acme", "interactive", 5000.0, 1.0)
+    assert plane.evaluate(1.0) == []  # 2 < MIN_WINDOW_SAMPLES
+    plane.observe_ttft("acme", "interactive", 5000.0, 2.0)
+    assert plane.evaluate(2.0) != []
+
+
+def test_slow_window_catches_a_slow_burn_the_fast_window_dismisses():
+    """4% breach rate = burn 4x on a 1% budget: past the slow threshold
+    (2) but under the fast one (8) — the slow window alone must warn."""
+    plane = _plane()
+    t = 0.0
+    for i in range(500):
+        ms = 900.0 if i % 25 == 0 else 100.0  # 4% over the ceiling
+        plane.observe_ttft("acme", "interactive", ms, t)
+        t += 1.0
+    wins = {a["window"] for a in plane.evaluate(t)}
+    assert wins == {"slow"}
+
+
+def test_rising_edge_alert_counting():
+    plane = _plane()
+    for i in range(5):
+        plane.observe_ttft("acme", "interactive", 900.0, float(i))
+    plane.evaluate(4.0)
+    plane.evaluate(5.0)   # still firing: not a second page
+    series = plane._series[("acme", "interactive", "ttft")]
+    assert series.alerts_total >= 1
+    first_total = series.alerts_total
+    # recover: the bad samples age out of both windows
+    quiet = 4.0 + plane.slow_window + 1.0
+    for i in range(5):
+        plane.observe_ttft("acme", "interactive", 100.0, quiet + i)
+    assert plane.evaluate(quiet + 5.0) == []
+    assert series.alerts_total == first_total
+    # breach again: a NEW rising edge
+    for i in range(5):
+        plane.observe_ttft("acme", "interactive", 900.0, quiet + 10 + i)
+    assert plane.evaluate(quiet + 15.0) != []
+    assert series.alerts_total > first_total
+
+
+def test_tpot_breaches_judged_against_their_own_ceiling():
+    plane = _plane()
+    for i in range(5):
+        plane.observe_tpot("acme", "interactive", 200.0, float(i))  # >80
+    alerts = plane.evaluate(5.0)
+    assert {a["metric"] for a in alerts} == {"tpot"}
+
+
+# ---------------------------------------------------------------------------
+# publishing + summary
+# ---------------------------------------------------------------------------
+
+
+def test_publish_lands_serve_slo_metrics():
+    plane = _plane()
+    for i in range(10):
+        plane.observe_ttft("acme", "interactive", 900.0, float(i))
+    reg = obs.get_registry()
+    plane.publish(reg, 10.0)
+    snap = {(m["name"], tuple(sorted((m.get("tags") or {}).items()))): m
+            for m in reg.snapshot()}
+    tags = (("metric", "ttft"), ("slo", "interactive"),
+            ("tenant", "acme"))
+    assert snap[("serve.slo.p99_ms", tags)]["value"] \
+        == pytest.approx(900.0)
+    fast_tags = tuple(sorted(tags + (("window", "fast"),)))
+    assert snap[("serve.slo.alert", fast_tags)]["value"] == 1.0
+    assert snap[("serve.slo.burn", fast_tags)]["value"] \
+        >= slo.DEFAULT_FAST_BURN
+    assert snap[("serve.slo.breaches", tags)]["value"] == 10
+    assert snap[("serve.slo.alerts", tags)]["value"] >= 1
+    # republish: counters must not double-count (delta vs counter value)
+    plane.publish(reg, 11.0)
+    snap = {(m["name"], tuple(sorted((m.get("tags") or {}).items()))): m
+            for m in reg.snapshot()}
+    assert snap[("serve.slo.breaches", tags)]["value"] == 10
+
+
+def test_summary_document_shape():
+    plane = _plane()
+    for i in range(4):
+        plane.observe_ttft("acme", "interactive", 900.0, float(i))
+    plane.evaluate(4.0)
+    doc = plane.summary(4.0)
+    entry = doc["acme/interactive"]["ttft"]
+    assert entry["n"] == 4
+    assert entry["breaches"] == 4
+    assert entry["burn_fast"] == pytest.approx(100.0)
+    assert entry["firing"] is True
+    assert entry["alerts"] >= 1
