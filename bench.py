@@ -1152,7 +1152,7 @@ def collect_engine_gauges() -> dict:
         from horovod_tpu.obs import get_registry
 
         wanted_prefixes = ("autotune.", "overlap.", "perf.", "mem.",
-                           "serve.kv.")
+                           "serve.kv.", "health.")
         wanted_names = {
             "engine.negotiation_skip_rate",
             "engine.cache_hit_rate",
@@ -1169,6 +1169,7 @@ def collect_engine_gauges() -> dict:
         }
         out = {}
         bucket_bytes = []
+        health_alerts = 0.0
         for m in get_registry().snapshot():
             name = m.get("name", "")
             if m.get("tags"):
@@ -1180,9 +1181,22 @@ def collect_engine_gauges() -> dict:
                     tag = m["tags"].get("bucket")
                     if tag is not None and str(tag).isdigit():
                         bucket_bytes.append((int(tag), m.get("value")))
+                elif name == "health.alerts":
+                    # Rising-edge alert counters are per-class; the
+                    # BENCH record wants the one number "did the
+                    # numerics plane object during this measurement".
+                    health_alerts += float(m.get("value") or 0)
+                continue
+            if name == "health.grad_norm_hist":
+                # Histogram: the record carries its p50 (the satellite
+                # the hardware campaign attaches numerics evidence by).
+                if m.get("p50") is not None:
+                    out["health.grad_norm_p50"] = m["p50"]
                 continue
             if name in wanted_names or name.startswith(wanted_prefixes):
                 out[name] = m.get("value")
+        if health_alerts:
+            out["health.alerts_total"] = health_alerts
         if bucket_bytes:
             out["overlap_bucket_bytes"] = [
                 v for _, v in sorted(bucket_bytes)
@@ -1532,6 +1546,26 @@ def main() -> int:
         from horovod_tpu.obs import memplane  # noqa: PLC0415
 
         out["memory"] = memplane.memory_record()
+    except Exception:
+        pass
+    try:
+        # Numerics evidence in every BENCH record (obs/health.py):
+        # materialize the headline health gauges from what the timed
+        # loop actually measured (its final loss), so every record
+        # carries health.loss / health.nonfinite / divergence-check
+        # counts even when --health never armed.  Grad-norm series
+        # appear only when the measured step itself carried the health
+        # bundle — the record does not re-run the step to invent them.
+        from horovod_tpu.obs import get_registry  # noqa: PLC0415
+
+        _reg = get_registry()
+        _reg.gauge("health.loss").set(final_loss)
+        _reg.gauge("health.nonfinite").set(
+            0 if np.isfinite(final_loss) else 1)
+        # inc(0) materializes the counter at its current value (0 on
+        # un-armed runs) without claiming a check happened.
+        _reg.counter("health.divergence.checks").inc(0)
+        _reg.counter("health.nonfinite_total").inc(0)
     except Exception:
         pass
     gauges = collect_engine_gauges()

@@ -143,7 +143,8 @@ if [ "${1:-full}" = "quick" ]; then
         -x -q
     echo "== quick tier: observability plane =="
     python -m pytest tests/test_obs.py tests/test_obs_live.py \
-        tests/test_postmortem.py tests/test_trace.py -x -q
+        tests/test_postmortem.py tests/test_trace.py \
+        tests/test_health.py -x -q
     echo "== quick tier: unit + multiprocess suite minus -m full =="
     # test_elastic.py / test_obs*.py and the injection case already ran
     # above — don't pay for the multiprocess chaos cases twice per commit.
@@ -154,6 +155,7 @@ if [ "${1:-full}" = "quick" ]; then
         --ignore=tests/test_obs_live.py \
         --ignore=tests/test_postmortem.py \
         --ignore=tests/test_trace.py \
+        --ignore=tests/test_health.py \
         --deselect "tests/test_checkpoint.py::test_injected_ckpt_failure_raises_on_all_ranks"
     exit 0
 fi
@@ -1183,6 +1185,104 @@ PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
     timeout 400 python -m pytest \
     "tests/test_memplane.py::test_oom_chaos_postmortem_names_rank_and_owner" \
     -x -q
+
+# Health gate (ISSUE 18): the training-health plane must (a) pass its
+# unit suite, (b) leave the compiled step HLO byte-identical when
+# --health is off, and (c) survive the SDC chaos proof — a seeded
+# single-bit exponent flip on rank 1's copy of the 6th reduced gradient
+# (training step 2, leaf w2 — bucket 0 in the reverse-topological
+# layout) must be localized by the divergence
+# sentinel to that exact rank + bucket + leaf within one check interval,
+# halt every rank, and be named in the postmortem verdict.  A clean run
+# of the same worker must alert nothing and write no postmortem.
+echo "== health gate: unit suite =="
+JAX_PLATFORMS=cpu \
+    timeout 300 python -m pytest tests/test_health.py -x -q
+echo "== health gate: --health off leaves compiled HLO unchanged =="
+JAX_PLATFORMS=cpu \
+    timeout 300 python -m pytest \
+    "tests/test_health.py::test_health_off_leaves_compiled_hlo_byte_identical" \
+    -x -q
+echo "== health gate: SDC chaos -> sentinel names rank 1 + leaf w2 =="
+HL_TMP=$(mktemp -d)
+cat > "$HL_TMP/worker.py" <<'EOF'
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.obs import divergence
+from horovod_tpu.obs.health import HealthConfig
+from horovod_tpu.optim.overlap import build_layout
+
+hvd.init()
+params = {"w0": np.zeros(4, np.float32),
+          "w1": np.zeros(4, np.float32),
+          "w2": np.zeros(4, np.float32)}
+names = sorted(params)                 # tree_flatten order: w0, w1, w2
+leaves = [params[n] for n in names]
+layout = build_layout(params, 16)      # 16B buckets: one leaf per bucket
+cfg = HealthConfig.from_env()
+sentinel = divergence.DivergenceSentinel(
+    layout, rank=hvd.rank(), check_steps=cfg.check_steps,
+    action=cfg.divergence_action, leaf_names=names)
+for step in range(1, 9):
+    # grad_ready collective seq: step 1 -> 1,2,3; step 2 -> 4,5,6, so
+    # the seeded seq-6 flip lands on rank 1's copy of w2 — bucket 0,
+    # since build_layout packs in reverse flatten order.
+    for i, leaf in enumerate(leaves):
+        leaf += np.asarray(
+            hvd.allreduce(np.full(4, 0.1, np.float32), op=hvd.Sum,
+                          name=f"g{i}"))
+    sentinel.maybe_check(step, leaves)
+hvd.shutdown()
+EOF
+mkdir -p "$HL_TMP/bb"
+if JAX_PLATFORMS=cpu \
+   PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+   HVDTPU_HEALTH=on HVDTPU_HEALTH_CHECK_STEPS=4 \
+   HVDTPU_DIVERGENCE_ACTION=halt \
+   HVDTPU_FAULT_SPEC="grad_ready:rank=1:step=6:action=flip_bits" \
+       timeout 300 python -m horovod_tpu.run -np 2 \
+       --flightrec-dump "$HL_TMP/bb" python "$HL_TMP/worker.py"; then
+    echo "health gate FAILED: corrupted job reported success" >&2
+    exit 1
+fi
+python - "$HL_TMP/bb" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+dumps = glob.glob(f"{d}/flightrec.*rank*.json")
+assert len(dumps) == 2, f"expected 2 per-rank black boxes, got {dumps}"
+events = [e for p in dumps for e in json.load(open(p))["events"]
+          if e["kind"] == "health.divergence"]
+assert events, "no health.divergence flightrec event recorded"
+for ev in events:
+    fields = dict(kv.split("=", 1) for kv in ev["detail"].split())
+    assert fields["minority"] == "1", ev
+    assert fields["bucket"] == "0", ev
+    assert fields["leaf"] == "w2", ev
+    assert ev["cycle"] == 4, ev  # first check interval after the flip
+report = json.load(open(f"{d}/postmortem.json"))
+v = report["verdict"]
+assert "TRAINING-STATE DIVERGENCE" in v, v
+assert "rank(s) 1" in v and "bucket0" in v and "w2" in v, v
+print("health gate OK:", v.splitlines()[0])
+EOF
+echo "== health gate: clean run alerts nothing =="
+mkdir -p "$HL_TMP/clean"
+JAX_PLATFORMS=cpu \
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+HVDTPU_HEALTH=on HVDTPU_HEALTH_CHECK_STEPS=4 \
+HVDTPU_DIVERGENCE_ACTION=halt \
+    timeout 300 python -m horovod_tpu.run -np 2 \
+    --flightrec-dump "$HL_TMP/clean" python "$HL_TMP/worker.py"
+if [ -e "$HL_TMP/clean/postmortem.json" ]; then
+    echo "health gate FAILED: clean run wrote a postmortem" >&2
+    exit 1
+fi
+if grep -l "health.divergence\|health.alert" \
+        "$HL_TMP"/clean/flightrec.*rank*.json 2>/dev/null; then
+    echo "health gate FAILED: clean run recorded a health alert" >&2
+    exit 1
+fi
+rm -rf "$HL_TMP"
 
 # Elastic chaos smoke through the real launcher: a rank is killed
 # deterministically mid-training (HVDTPU_FAULT_SPEC), the job must

@@ -49,7 +49,7 @@ from ..obs import flightrec as obs_flightrec
 from ..obs import progress as obs_progress
 from ..obs import straggler as obs_straggler
 from ..run.rendezvous import KVStoreClient
-from ..testing.faults import maybe_fail
+from ..testing.faults import corrupt_grad, maybe_fail
 from ..utils.env import env_float
 from ..utils.logging import get_logger
 from .exceptions import HorovodShutdownError, RankDroppedError
@@ -294,6 +294,16 @@ class ElasticContext:
             total = total + p
         if average:
             total = (total / len(parts)).astype(arr.dtype)
+        # Chaos hook for the divergence sentinel: grad_ready fires
+        # AFTER the reduction, on this rank's copy of the agreed total
+        # — the SDC shape where exactly one rank walks away with a
+        # different result (a pre-reduce flip would spread identically
+        # to every rank and diverge nothing).
+        action = maybe_fail("grad_ready", step=self._seq, rank=self.rank,
+                            name=name)
+        if action in ("flip_bits", "nan_inject"):
+            total = corrupt_grad(total, action, rank=self.rank,
+                                 step=self._seq, name=name)
         # Progress beat source for the elastic path: the collective
         # completed with every member's contribution in hand.
         obs_flightrec.record(

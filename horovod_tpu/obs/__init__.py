@@ -51,6 +51,16 @@ quantitative):
   ``--slo-ttft-ms``-style targets, with two-window error-budget
   burn-rate alerting (fast window pages on cliffs, slow window warns
   on slow burns), published as ``serve.slo.*``.
+* **training-health plane** (obs/health.py + obs/divergence.py) — the
+  *numbers* axis: an in-graph per-step numerics bundle (loss, grad
+  norms per overlap bucket, update/param ratio, nonfinite counts)
+  riding the step's existing host sync, judged by a pure EWMA+MAD
+  anomaly table (``health.*`` gauges, rising-edge alerts), plus the
+  cross-rank divergence sentinel — periodic bitwise digests of
+  params/optimizer state/PRNG key exchanged over the engine, the
+  runtime verifier of the HVD001 bitwise-replication invariant, with
+  minority-rank + bucket + leaf localization and a serving twin over
+  the broadcast schedule doc + KV page tables.
 * **memory plane** (obs/memplane.py) — the byte axis: compiled
   per-program breakdowns (``memory_analysis()``, version-tolerant),
   an owner-tagged ``jax.live_arrays()`` census with backend
@@ -61,8 +71,10 @@ quantitative):
 See docs/observability.md and docs/postmortem.md.
 """
 
+from . import divergence  # noqa: F401
 from . import flightrec  # noqa: F401
 from . import goodput  # noqa: F401
+from . import health  # noqa: F401
 from . import memplane  # noqa: F401
 from . import slo  # noqa: F401
 from . import profile  # noqa: F401
@@ -96,8 +108,10 @@ __all__ = [
     "dump_metrics",
     "dump_flight_recorder",
     "install_death_hooks",
+    "divergence",
     "flightrec",
     "goodput",
+    "health",
     "profile",
     "progress",
     "slo",

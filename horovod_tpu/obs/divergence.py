@@ -1,0 +1,503 @@
+"""Cross-rank divergence sentinel: the HVD001 invariant, verified at
+runtime.
+
+The repo's load-bearing invariant — "every rank derives the bitwise-
+identical schedule/state" — is enforced statically by the PR-5/PR-12
+lint and pinned by tests, but nothing watches the *running* job: data
+skew, a nondeterministic kernel, or silent data corruption (an SDC bit
+flip that survives the allreduce) can break bitwise replication
+invisibly for thousands of steps, until a checkpoint poisons every
+future restart.  This module is the runtime half of that proof
+(O'Hearn's continuous-reasoning thesis, PAPERS.md: the property the
+analyzer proves about the source, an always-on sentinel keeps proving
+about the process).
+
+Design:
+
+* **Digest algebra** (:func:`bit_words`, :func:`digest_words`) — arrays
+  are *bit-reinterpreted* into a uint32 word stream (one zero-extended
+  word per element; float64 splits into lo/hi words) and folded through
+  two independent position-mixed multiply-XOR lanes.  Equality of bit
+  patterns ⟺ equality of digests for any single-site difference (odd
+  multipliers are bijections mod 2^32), so the adversarial float pairs
+  value-comparison would wave through — ``+0.0`` vs ``-0.0``, NaNs with
+  different payloads, denormals — all produce distinct digests, and
+  bitwise-identical state always digests identically.  The same algebra
+  is implementable in-graph (:func:`jit_digest`) via
+  ``lax.bitcast_convert_type``, byte-for-byte equal to the host path.
+
+* **Per-bucket digest vector** (:func:`tree_digest_vector`) — params
+  digest per overlap bucket (reusing ``optim/overlap.py``'s
+  :class:`~..optim.overlap.BucketLayout`, the same deterministic
+  grouping every rank already derives), plus one digest each for the
+  optimizer state and the replicated PRNG key.  A mismatch therefore
+  localizes to a component and a bucket from the FIRST exchange.
+
+* **:class:`DivergenceSentinel`** — every ``--health-check-steps`` N,
+  allgathers the tiny digest vector over the engine, compares all rows,
+  and on mismatch names the minority-partition ranks, then descends:
+  a second (equally tiny) exchange of the divergent bucket's per-leaf
+  digests names the first divergent leaf.  Every rank runs the same
+  comparison on the same gathered matrix, so every rank reaches the
+  identical verdict and the identical ``--divergence-action`` — the
+  sentinel obeys the very invariant it checks.
+
+Cost, stated honestly: one ~(2·buckets+4)-word allgather every N steps.
+Through the eager engine that is one extra negotiated collective per
+check, which also breaks the schedule-replay epoch for ~2 cycles
+(runtime/engine.py) — at the default N=100 that is noise; at N=1 it
+would halve the replay skip rate.  See docs/health.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+LOG = get_logger("obs.divergence")
+
+__all__ = [
+    "bit_words",
+    "digest_words",
+    "digest_array",
+    "digest_leaves",
+    "blob_digest",
+    "tree_digest_vector",
+    "leaf_digest_matrix",
+    "jit_digest",
+    "page_state_digest",
+    "serve_state_digest",
+    "DivergenceReport",
+    "DivergenceHalt",
+    "DivergenceSentinel",
+    "ACTIONS",
+]
+
+ACTIONS = ("warn", "dump", "halt")
+
+# Two independent mix lanes: (index stride, odd multiplier, seed).
+# Odd multipliers are bijections mod 2^32, so a word that differs at one
+# position always changes that lane's XOR fold; two lanes make a
+# cross-position cancellation require a simultaneous collision in both.
+_LANES = (
+    (np.uint32(0x9E3779B9), np.uint32(0x85EBCA6B), np.uint32(0x02E1B213)),
+    (np.uint32(0xC2B2AE35), np.uint32(0x27D4EB2F), np.uint32(0x165667B1)),
+)
+DIGEST_WIDTH = len(_LANES)  # uint32 words per digest
+
+
+class DivergenceHalt(RuntimeError):
+    """Raised on every rank when ``--divergence-action halt`` fires."""
+
+
+def bit_words(arr) -> np.ndarray:
+    """The canonical uint32 word stream of an array's BIT PATTERN: one
+    zero-extended word per element for itemsize <= 4, two (lo, hi) words
+    per element for itemsize 8.  Per-element (not a raw byte stream) so
+    the identical stream is cheap to produce in-graph, where
+    ``bitcast_convert_type`` yields one integer per element."""
+    a = np.ascontiguousarray(arr)
+    size = a.dtype.itemsize
+    if size == 1:
+        return a.view(np.uint8).ravel().astype(np.uint32)
+    if size == 2:
+        return a.view(np.uint16).ravel().astype(np.uint32)
+    if size == 4:
+        return a.view(np.uint32).ravel().copy()
+    if size == 8:
+        w = a.view(np.uint64).ravel()
+        out = np.empty(w.size * 2, dtype=np.uint32)
+        out[0::2] = (w & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        out[1::2] = (w >> np.uint64(32)).astype(np.uint32)
+        return out
+    raise TypeError(f"no bit_words rule for itemsize {size} ({a.dtype})")
+
+
+def digest_words(words: np.ndarray) -> np.ndarray:
+    """Fold a uint32 word stream into the ``(DIGEST_WIDTH,)`` digest.
+    Length is mixed in, so a zero-padded stream never digests equal to
+    its unpadded prefix."""
+    w = np.asarray(words, dtype=np.uint32)
+    n = np.uint32(w.size)
+    idx = np.arange(w.size, dtype=np.uint32)
+    out = np.empty(DIGEST_WIDTH, dtype=np.uint32)
+    for lane, (c, m, seed) in enumerate(_LANES):
+        if w.size:
+            mixed = np.multiply(
+                np.bitwise_xor(w, np.multiply(idx, c, dtype=np.uint32)
+                               + seed),
+                m, dtype=np.uint32,
+            )
+            acc = np.bitwise_xor.reduce(mixed)
+        else:
+            acc = np.uint32(0)
+        length_mix = np.uint32((int(n) * int(m) + int(c)) & 0xFFFFFFFF)
+        out[lane] = np.bitwise_xor(acc, length_mix)
+    return out
+
+
+def digest_array(arr) -> np.ndarray:
+    """Digest of one array's bit pattern (host side)."""
+    return digest_words(bit_words(arr))
+
+
+def digest_leaves(leaves: Sequence) -> np.ndarray:
+    """Digest of several arrays' concatenated word streams — the
+    per-bucket digest is over the bucket's leaves in bucket order, the
+    same concatenation order ``_bucket_concat`` fuses gradients in."""
+    if not leaves:
+        return digest_words(np.empty(0, dtype=np.uint32))
+    return digest_words(np.concatenate([bit_words(l) for l in leaves]))
+
+
+def blob_digest(raw: bytes) -> np.ndarray:
+    """Digest of an opaque byte payload (zero-padded to whole words) —
+    the serving twin's schedule-doc digest."""
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    pad = (-buf.size) % 4
+    if pad:
+        buf = np.concatenate([buf, np.zeros(pad, dtype=np.uint8)])
+    return digest_words(buf.view(np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# pytree / bucket digests
+# ---------------------------------------------------------------------------
+
+
+def tree_digest_vector(leaves: Sequence, layout,
+                       extras: Sequence[Tuple[str, Sequence]] = ()
+                       ) -> Tuple[np.ndarray, List[str]]:
+    """The exchange vector: per-bucket digests of ``leaves`` (flattened
+    params, in ``layout``'s flatten order) followed by one digest per
+    named extra component (optimizer state, PRNG key, ...).  Returns
+    ``(uint32 vector, component names)`` where component ``i`` owns
+    words ``[i*DIGEST_WIDTH, (i+1)*DIGEST_WIDTH)`` — the first
+    mismatching word indexes straight into a component."""
+    parts: List[np.ndarray] = []
+    names: List[str] = []
+    for b in layout.buckets:
+        parts.append(digest_leaves([np.asarray(leaves[i])
+                                    for i in b.leaf_indices]))
+        names.append(f"bucket{b.index}")
+    for name, arrs in extras:
+        parts.append(digest_leaves([np.asarray(a) for a in arrs]))
+        names.append(name)
+    return np.concatenate(parts), names
+
+
+def leaf_digest_matrix(leaves: Sequence, bucket) -> np.ndarray:
+    """Per-leaf digests of one bucket, shape ``(n_leaves,
+    DIGEST_WIDTH)`` — the descent exchange that turns "bucket 3
+    diverged" into "leaf mlp/kernel diverged"."""
+    return np.stack([digest_array(np.asarray(leaves[i]))
+                     for i in bucket.leaf_indices])
+
+
+def jit_digest(layout):
+    """Compile the IN-GRAPH digest: a jitted function mapping the
+    params' flat leaves to the ``(n_buckets, DIGEST_WIDTH)`` uint32
+    digest matrix, byte-for-byte equal to :func:`tree_digest_vector`'s
+    bucket prefix.  Runs on device — the host fetches 8 bytes per
+    bucket instead of the parameters themselves."""
+    import jax  # noqa: PLC0415
+    import jax.numpy as jnp  # noqa: PLC0415
+    from jax import lax  # noqa: PLC0415
+
+    def words_of(leaf):
+        size = jnp.dtype(leaf.dtype).itemsize
+        if size == 2:
+            return lax.bitcast_convert_type(
+                leaf, jnp.uint16).ravel().astype(jnp.uint32)
+        if size == 4:
+            return lax.bitcast_convert_type(leaf, jnp.uint32).ravel()
+        raise TypeError(
+            f"no in-graph bit_words rule for itemsize {size} "
+            f"({leaf.dtype}); use the host digest"
+        )
+
+    def one_lane(w, c, m, seed):
+        n = w.shape[0]
+        idx = jnp.arange(n, dtype=jnp.uint32)
+        if n:
+            mixed = (w ^ (idx * c + seed)) * m
+            acc = lax.reduce(mixed, jnp.uint32(0),
+                             lambda a, b: lax.bitwise_xor(a, b), (0,))
+        else:
+            acc = jnp.uint32(0)
+        return acc ^ (jnp.uint32(n) * m + c)
+
+    def digests(*leaves):
+        rows = []
+        for b in layout.buckets:
+            w = jnp.concatenate(
+                [words_of(leaves[i]) for i in b.leaf_indices]
+            )
+            rows.append(jnp.stack([
+                one_lane(w, jnp.uint32(c), jnp.uint32(m), jnp.uint32(s))
+                for (c, m, s) in _LANES
+            ]))
+        return jnp.stack(rows)
+
+    return jax.jit(digests)
+
+
+# ---------------------------------------------------------------------------
+# the sentinel
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DivergenceReport:
+    """One confirmed divergence, fully localized."""
+
+    step: int
+    component: str               # "bucket<i>" | "opt_state" | "prng"
+    bucket: Optional[int]        # set when the component is a bucket
+    leaf_index: Optional[int]    # flatten-order leaf position
+    leaf_name: Optional[str]
+    minority_ranks: Tuple[int, ...] = ()
+    majority_ranks: Tuple[int, ...] = ()
+    detail: str = field(default="", compare=False)
+
+    def describe(self) -> str:
+        where = self.component
+        if self.leaf_name is not None:
+            where += f" (leaf {self.leaf_name})"
+        ranks = ",".join(str(r) for r in self.minority_ranks)
+        return (f"rank(s) {ranks} diverged from the majority at step "
+                f"{self.step} in {where}")
+
+
+def _default_exchange(vec: np.ndarray, name: str) -> np.ndarray:
+    """Allgather over the eager engine.  The engine's dtype table has
+    no uint32 entry, so the digest words ride as int32 bit patterns —
+    a pure reinterpretation, gathered bytes come back untouched."""
+    from ..ops import eager  # noqa: PLC0415
+
+    wire = np.ascontiguousarray(vec).view(np.int32)
+    return np.asarray(eager.allgather(wire, name=name)).view(np.uint32)
+
+
+class DivergenceSentinel:
+    """Periodic cross-rank digest compare over a bucket layout.
+
+    ``exchange(vec, name) -> (world * len(vec),)`` is injectable so the
+    decision logic is testable without an engine; the default is the
+    eager ``hvd.allgather``.  Every rank must call :meth:`maybe_check`
+    at the same steps with the same component set — the check is itself
+    a collective, and the HVD001 rule applies to it like any other.
+    """
+
+    def __init__(
+        self,
+        layout,
+        *,
+        rank: int,
+        check_steps: int = 100,
+        action: str = "warn",
+        exchange: Optional[Callable[[np.ndarray, str], np.ndarray]] = None,
+        leaf_names: Optional[Sequence[str]] = None,
+        registry=None,
+    ):
+        if action not in ACTIONS:
+            raise ValueError(
+                f"divergence action must be one of {ACTIONS}, got "
+                f"{action!r}"
+            )
+        if check_steps < 1:
+            raise ValueError(f"check_steps must be >= 1, got {check_steps}")
+        self.layout = layout
+        self.rank = int(rank)
+        self.check_steps = int(check_steps)
+        self.action = action
+        self.exchange = exchange or _default_exchange
+        self.leaf_names = list(leaf_names) if leaf_names else None
+        if registry is None:
+            from .registry import get_registry  # noqa: PLC0415
+
+            registry = get_registry()
+        self._reg = registry
+        self.checks = 0
+        self.detections = 0
+
+    # ----------------------------------------------------------- checks
+
+    def maybe_check(self, step: int, leaves: Sequence, *,
+                    opt_leaves: Optional[Sequence] = None,
+                    prng_key=None) -> Optional[DivergenceReport]:
+        """Run :meth:`check` when ``step`` lands on the cadence.  All
+        ranks share the cadence arithmetic, so either every rank
+        exchanges or none does."""
+        if step % self.check_steps != 0:
+            return None
+        return self.check(step, leaves, opt_leaves=opt_leaves,
+                          prng_key=prng_key)
+
+    def check(self, step: int, leaves: Sequence, *,
+              opt_leaves: Optional[Sequence] = None,
+              prng_key=None) -> Optional[DivergenceReport]:
+        extras: List[Tuple[str, Sequence]] = []
+        if opt_leaves is not None:
+            extras.append(("opt_state", list(opt_leaves)))
+        if prng_key is not None:
+            extras.append(("prng", [np.asarray(prng_key)]))
+        vec, components = tree_digest_vector(leaves, self.layout,
+                                             extras=extras)
+        mat = self._gather(vec, f"health.digest.s{step}")
+        self.checks += 1
+        self._reg.counter("health.divergence.checks").inc()
+        self._reg.gauge("health.divergence.last_check_step").set(step)
+        if bool((mat == mat[0]).all()):
+            self._reg.gauge("health.divergence.alert").set(0)
+            return None
+        report = self._localize(step, mat, components, leaves)
+        self._record(report)
+        self._act(report)
+        return report
+
+    def _gather(self, vec: np.ndarray, name: str) -> np.ndarray:
+        flat = np.asarray(self.exchange(vec, name), dtype=np.uint32)
+        world = flat.size // vec.size
+        if world * vec.size != flat.size:
+            raise ValueError(
+                f"digest exchange returned {flat.size} words for a "
+                f"{vec.size}-word vector — ragged gather?"
+            )
+        return flat.reshape(world, vec.size)
+
+    # ------------------------------------------------------ localization
+
+    def _localize(self, step: int, mat: np.ndarray,
+                  components: List[str],
+                  leaves: Sequence) -> DivergenceReport:
+        minority, majority = _partition(mat)
+        bad_cols = np.nonzero((mat != mat[majority[0]]).any(axis=0))[0]
+        comp_index = int(bad_cols[0]) // DIGEST_WIDTH
+        component = components[comp_index]
+        bucket = leaf_index = None
+        leaf_name = None
+        if component.startswith("bucket"):
+            bucket = int(component[len("bucket"):])
+            leaf_index, leaf_name = self._descend(step, bucket, leaves)
+        return DivergenceReport(
+            step=int(step),
+            component=component,
+            bucket=bucket,
+            leaf_index=leaf_index,
+            leaf_name=leaf_name,
+            minority_ranks=tuple(minority),
+            majority_ranks=tuple(majority),
+        )
+
+    def _descend(self, step: int, bucket_index: int, leaves: Sequence):
+        """Second-phase exchange: the divergent bucket's per-leaf
+        digests.  Deterministic on every rank (all ranks saw the same
+        gathered matrix, so all reach this call or none do)."""
+        bucket = self.layout.buckets[bucket_index]
+        local = leaf_digest_matrix(leaves, bucket).ravel()
+        mat = self._gather(local,
+                           f"health.digest.b{bucket_index}.s{step}")
+        _, majority = _partition(mat)
+        bad = np.nonzero((mat != mat[majority[0]]).any(axis=0))[0]
+        if not bad.size:  # raced a repair; keep the bucket verdict
+            return None, None
+        pos = int(bad[0]) // DIGEST_WIDTH
+        leaf_index = bucket.leaf_indices[pos]
+        name = (self.leaf_names[leaf_index]
+                if self.leaf_names and leaf_index < len(self.leaf_names)
+                else f"leaf{leaf_index}")
+        return leaf_index, name
+
+    # ----------------------------------------------------------- verdict
+
+    def _record(self, report: DivergenceReport) -> None:
+        self.detections += 1
+        minority = ",".join(str(r) for r in report.minority_ranks)
+        detail = (f"step={report.step} minority={minority} "
+                  f"component={report.component}")
+        if report.bucket is not None:
+            detail += f" bucket={report.bucket}"
+        if report.leaf_name is not None:
+            detail += f" leaf={report.leaf_name}"
+        report.detail = detail
+        tags = {"component": report.component}
+        if report.leaf_name is not None:
+            tags["leaf"] = report.leaf_name
+        self._reg.counter("health.divergence.detected", **tags).inc()
+        self._reg.gauge("health.divergence.alert").set(1)
+        from . import flightrec  # noqa: PLC0415
+
+        flightrec.record("health.divergence", name=report.component,
+                         cycle=report.step, detail=detail)
+        LOG.error("HVD001 runtime violation: %s", report.describe())
+
+    def _act(self, report: DivergenceReport) -> None:
+        if self.action == "warn":
+            return
+        if self.action == "dump":
+            # Leave the evidence NOW: the poisoned state may kill the
+            # job (or worse, checkpoint) before any death-path dump.
+            from . import flightrec  # noqa: PLC0415
+            from .registry import dump_metrics  # noqa: PLC0415
+
+            try:
+                flightrec.dump_flight_recorder(trigger="health.divergence")
+            except Exception:  # pragma: no cover - defensive
+                pass
+            try:
+                dump_metrics()
+            except Exception:  # pragma: no cover - defensive
+                pass
+            return
+        raise DivergenceHalt(
+            f"divergence sentinel: {report.describe()} "
+            f"(--divergence-action halt)"
+        )
+
+
+def _partition(mat: np.ndarray) -> Tuple[List[int], List[int]]:
+    """Split ranks into (minority, majority) by digest-row pattern.
+    Majority = the most common row; ties break toward the pattern of
+    the lowest rank holding it, so every rank (and every rerun) names
+    the same culprit."""
+    rows = [tuple(int(x) for x in mat[r]) for r in range(mat.shape[0])]
+    counts: dict = {}
+    for row in rows:
+        counts[row] = counts.get(row, 0) + 1
+    best = max(counts.items(),
+               key=lambda kv: (kv[1], -rows.index(kv[0])))[0]
+    majority = [r for r, row in enumerate(rows) if row == best]
+    minority = [r for r, row in enumerate(rows) if row != best]
+    return minority, majority
+
+
+# ---------------------------------------------------------------------------
+# serving twin
+# ---------------------------------------------------------------------------
+
+
+def page_state_digest(paged) -> np.ndarray:
+    """Digest of a :class:`~..serve.paged.PagedKV` pool's observable
+    state: every slot's block-table row + position, plus the free list
+    (sorted — the heap's internal order is arrival-dependent, the SET
+    of free pages is the invariant)."""
+    if paged is None:
+        return digest_words(np.empty(0, dtype=np.uint32))
+    rows: List[List[int]] = [list(paged.table(s)) + [paged.position(s)]
+                             for s in range(paged.num_slots)]
+    flat = [x for row in rows for x in row] + sorted(paged._free)
+    return digest_array(np.asarray(flat, dtype=np.int32))
+
+
+def serve_state_digest(sdoc_raw: bytes, paged) -> np.ndarray:
+    """The serving twin's per-check digest: broadcast schedule doc
+    bytes + page-table state, concatenated.  Replicated ranks of a
+    width group must produce identical values every step — the serving
+    form of HVD001."""
+    return np.concatenate([blob_digest(sdoc_raw),
+                           page_state_digest(paged)])

@@ -1,0 +1,418 @@
+"""Training-health plane: in-graph numerics telemetry + anomaly judge.
+
+Ten PRs of observability watch *time and bytes*; this module watches
+the *numbers*.  Three pieces:
+
+* **The bundle** (:func:`health_bundle`) — a fused device-side scalar
+  summary computed INSIDE the training step from values the step
+  already has in registers (loss, grads, updates, params): global grad
+  norm, per-bucket grad norms (the same ``build_layout`` buckets the
+  overlap plan fuses), max |update|/|param| ratio, and nonfinite
+  counts.  It is returned as an extra step output, so it rides the
+  step's existing device→host sync — no extra round trip.  With
+  ``--health off`` the step closure is *the same object as today's*
+  and the compiled HLO is byte-identical (asserted in CI).
+
+* **The judge** (:class:`AnomalyJudge`) — a pure decision table over
+  the bundle stream.  Per-series EWMA mean + EWMA absolute deviation
+  (a robust MAD-flavored scale, cheap and clock-free); alert classes
+  ``loss-spike``, ``grad-explode``, ``grad-vanish``, ``dead-gradient``
+  (a bucket's norm pinned at zero for ``dead_steps``), and
+  ``nonfinite`` (absolute — no baseline needed to know NaN is bad).
+  Alerts are edge-triggered: the counter increments once per episode,
+  the gauge holds while the condition persists (the same discipline as
+  obs/slo.py's burn-rate alerts).
+
+* **The monitor** (:class:`HealthMonitor`) — host-side glue: feeds the
+  judge, publishes ``health.*`` gauges/histograms into the registry
+  (→ /metrics, live digest, history rows, ``--stats-summary``, bench
+  records), records flightrec events on rising edges, and on the FIRST
+  nonfinite runs the off-hot-path provenance bisection
+  (:func:`nonfinite_provenance`) that names the first offending leaf.
+
+Everything here is decision logic over small host scalars; the only
+jax in the file is inside :func:`health_bundle`, which callers embed
+in their own jitted step.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import env as envmod
+from ..utils.logging import get_logger
+
+LOG = get_logger("obs.health")
+
+__all__ = [
+    "HealthConfig",
+    "ALERT_CLASSES",
+    "health_bundle",
+    "bundle_names",
+    "nonfinite_provenance",
+    "AnomalyJudge",
+    "Alert",
+    "HealthMonitor",
+]
+
+ALERT_CLASSES = (
+    "loss-spike",
+    "grad-explode",
+    "grad-vanish",
+    "dead-gradient",
+    "nonfinite",
+)
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Knobs, resolved once from env (set by run/config_parser.py from
+    ``--health`` / ``--health-check-steps`` / ``--divergence-action``)."""
+
+    enabled: bool = False
+    check_steps: int = 100
+    divergence_action: str = "warn"
+
+    @classmethod
+    def from_env(cls) -> "HealthConfig":
+        import os  # noqa: PLC0415
+
+        raw = os.environ.get(envmod.HEALTH, "off").strip().lower()
+        enabled = raw in ("on", "1", "true", "yes")
+        return cls(
+            enabled=enabled,
+            check_steps=max(1, envmod.env_int(envmod.HEALTH_CHECK_STEPS,
+                                              100)),
+            divergence_action=os.environ.get(
+                envmod.DIVERGENCE_ACTION, "warn").strip().lower() or "warn",
+        )
+
+
+# ---------------------------------------------------------------------------
+# the in-graph bundle
+# ---------------------------------------------------------------------------
+
+
+def bundle_names(n_buckets: int) -> List[str]:
+    """Stable component order of the bundle vector."""
+    return (["loss", "grad_norm", "update_ratio_max", "nonfinite"]
+            + [f"bucket{i}_grad_norm" for i in range(n_buckets)])
+
+
+def health_bundle(loss, grads_flat: Sequence, layout,
+                  updates_flat: Optional[Sequence] = None,
+                  params_flat: Optional[Sequence] = None):
+    """Build the fused health vector INSIDE a jitted step.
+
+    ``grads_flat``/``updates_flat``/``params_flat`` are the step's flat
+    leaves (``layout``'s flatten order).  Returns a float32 vector of
+    ``4 + n_buckets`` scalars in :func:`bundle_names` order.  All
+    reductions fuse into the step's existing HLO; the output is a few
+    dozen bytes riding the loss fetch.
+    """
+    import jax.numpy as jnp  # noqa: PLC0415
+
+    f32 = jnp.float32
+    per_bucket = []
+    nonfinite = jnp.zeros((), jnp.int32)
+    total_sq = jnp.zeros((), f32)
+    for b in layout.buckets:
+        sq = jnp.zeros((), f32)
+        for i in b.leaf_indices:
+            g = grads_flat[i].astype(f32)
+            sq = sq + jnp.sum(g * g)
+            nonfinite = nonfinite + jnp.sum(
+                (~jnp.isfinite(g)).astype(jnp.int32))
+        per_bucket.append(jnp.sqrt(sq))
+        total_sq = total_sq + sq
+    ratio = jnp.zeros((), f32)
+    if updates_flat is not None and params_flat is not None:
+        eps = f32(1e-12)
+        for u, p in zip(updates_flat, params_flat):
+            u32 = u.astype(f32)
+            p32 = p.astype(f32)
+            r = jnp.max(jnp.abs(u32)) / (jnp.max(jnp.abs(p32)) + eps)
+            ratio = jnp.maximum(ratio, r)
+    return jnp.stack(
+        [jnp.asarray(loss, f32).reshape(()),
+         jnp.sqrt(total_sq),
+         ratio,
+         nonfinite.astype(f32)]
+        + per_bucket
+    )
+
+
+def nonfinite_provenance(grads_flat: Sequence, layout,
+                         leaf_names: Optional[Sequence[str]] = None
+                         ) -> Optional[Tuple[int, int, str]]:
+    """Off-hot-path bisection: name the FIRST leaf carrying a
+    nonfinite value.  Host-side, runs only after the bundle has already
+    reported ``nonfinite > 0`` — cost does not matter by then.  Returns
+    ``(bucket_index, leaf_index, leaf_name)`` or None."""
+    for b in layout.buckets:
+        for i in b.leaf_indices:
+            g = np.asarray(grads_flat[i])
+            if not np.isfinite(g).all():
+                name = (leaf_names[i]
+                        if leaf_names and i < len(leaf_names)
+                        else f"leaf{i}")
+                return b.index, i, name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the anomaly judge (pure)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Series:
+    """EWMA mean + EWMA absolute deviation of one scalar stream."""
+
+    alpha: float
+    mean: float = 0.0
+    dev: float = 0.0
+    n: int = 0
+
+    def z(self, x: float) -> float:
+        """Robust z-score of ``x`` against the history BEFORE observing
+        it.  The relative floor on the scale means a perfectly flat or
+        smoothly ramping series (dev ~ 0) only alerts on a step change
+        of >~ ``z_spike * 2%`` of the mean — not on sub-percent drift."""
+        if self.n == 0:
+            return 0.0
+        scale = max(self.dev, 1e-9, 2e-2 * abs(self.mean))
+        return (x - self.mean) / scale
+
+    def observe(self, x: float) -> None:
+        if self.n == 0:
+            self.mean = x
+            self.dev = 0.0
+        else:
+            a = self.alpha
+            self.dev = (1 - a) * self.dev + a * abs(x - self.mean)
+            self.mean = (1 - a) * self.mean + a * x
+        self.n += 1
+
+
+@dataclass(frozen=True)
+class Alert:
+    cls: str          # one of ALERT_CLASSES
+    rising: bool      # True exactly once per episode
+    detail: str = ""
+
+
+class AnomalyJudge:
+    """Pure decision table over bundle observations — no clocks, no
+    I/O, fully deterministic, so it is testable as a table of (series
+    in, alerts out).
+
+    Rules (evaluated per :meth:`observe` call):
+
+    * ``nonfinite``     — bundle's nonfinite count > 0 or loss not
+                          finite.  Absolute: fires even before
+                          ``min_samples``.
+    * ``loss-spike``    — loss z-score > ``z_spike`` AND loss above its
+                          EWMA mean (a downward "spike" is good news).
+    * ``grad-explode``  — grad-norm z-score > ``z_spike``, norm above
+                          mean.
+    * ``grad-vanish``   — grad norm below ``vanish_frac`` of its EWMA
+                          mean (scale-relative: an absolute threshold
+                          would need per-model tuning).
+    * ``dead-gradient`` — any bucket's grad norm exactly 0.0 for
+                          ``dead_steps`` consecutive observations (a
+                          detached/frozen subtree).
+
+    Relative rules hold off until ``min_samples`` observations so a
+    cold EWMA can't fire on warmup transients.
+    """
+
+    def __init__(self, *, alpha: float = 0.1, z_spike: float = 6.0,
+                 vanish_frac: float = 1e-3, dead_steps: int = 10,
+                 min_samples: int = 8):
+        self.z_spike = float(z_spike)
+        self.vanish_frac = float(vanish_frac)
+        self.dead_steps = int(dead_steps)
+        self.min_samples = int(min_samples)
+        self.loss = _Series(alpha)
+        self.grad = _Series(alpha)
+        self._zero_streak: Dict[int, int] = {}
+        self.firing: Dict[str, bool] = {c: False for c in ALERT_CLASSES}
+        self.alerts_total: Dict[str, int] = {c: 0 for c in ALERT_CLASSES}
+        self.last_loss_z = 0.0
+        self.last_grad_z = 0.0
+
+    def observe(self, *, loss: float, grad_norm: float,
+                nonfinite: int = 0,
+                bucket_norms: Sequence[float] = ()) -> List[Alert]:
+        """Feed one step's bundle; returns the alerts active AFTER this
+        observation (``rising=True`` on the first step of an episode)."""
+        active: Dict[str, str] = {}
+
+        finite = math.isfinite(loss) and math.isfinite(grad_norm)
+        if nonfinite > 0 or not finite:
+            active["nonfinite"] = f"count={int(nonfinite)}"
+
+        warm = (self.loss.n >= self.min_samples and finite)
+        self.last_loss_z = self.loss.z(loss) if finite else float("inf")
+        self.last_grad_z = (self.grad.z(grad_norm) if finite
+                            else float("inf"))
+        if warm:
+            if (self.last_loss_z > self.z_spike
+                    and loss > self.loss.mean):
+                active["loss-spike"] = f"z={self.last_loss_z:.1f}"
+            if (self.last_grad_z > self.z_spike
+                    and grad_norm > self.grad.mean):
+                active["grad-explode"] = f"z={self.last_grad_z:.1f}"
+            if (self.grad.mean > 0
+                    and grad_norm < self.vanish_frac * self.grad.mean):
+                active["grad-vanish"] = f"norm={grad_norm:.3g}"
+
+        for i, bn in enumerate(bucket_norms):
+            streak = self._zero_streak.get(i, 0)
+            streak = streak + 1 if bn == 0.0 else 0
+            self._zero_streak[i] = streak
+            if streak >= self.dead_steps and "dead-gradient" not in active:
+                active["dead-gradient"] = f"bucket={i} steps={streak}"
+
+        # Only clean samples train the baseline — a NaN loss would
+        # poison the EWMA and mask everything after it.
+        if finite:
+            self.loss.observe(loss)
+            self.grad.observe(grad_norm)
+
+        out: List[Alert] = []
+        for cls in ALERT_CLASSES:
+            now = cls in active
+            rising = now and not self.firing[cls]
+            if rising:
+                self.alerts_total[cls] += 1
+            self.firing[cls] = now
+            if now:
+                out.append(Alert(cls=cls, rising=rising,
+                                 detail=active[cls]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the monitor (host-side glue)
+# ---------------------------------------------------------------------------
+
+
+class HealthMonitor:
+    """Publishes the bundle + judge verdicts to every obs surface."""
+
+    def __init__(self, n_buckets: int = 0, *, rank: int = 0,
+                 judge: Optional[AnomalyJudge] = None,
+                 leaf_names: Optional[Sequence[str]] = None,
+                 registry=None):
+        self.n_buckets = int(n_buckets)
+        self.rank = int(rank)
+        self.judge = judge or AnomalyJudge()
+        self.leaf_names = list(leaf_names) if leaf_names else None
+        if registry is None:
+            from .registry import get_registry  # noqa: PLC0415
+
+            registry = get_registry()
+        self._reg = registry
+        self.nonfinite_total = 0
+        self.first_nonfinite: Optional[dict] = None
+
+    # -- feeding ----------------------------------------------------------
+
+    def observe_bundle(self, step: int, bundle,
+                       grads_flat: Optional[Sequence] = None,
+                       layout=None) -> List[Alert]:
+        """Consume one step's bundle vector (:func:`health_bundle`
+        order).  ``grads_flat``/``layout``, when provided, enable the
+        first-nonfinite provenance bisection."""
+        vec = np.asarray(bundle, dtype=np.float64).ravel()
+        loss, grad_norm, ratio, nonfinite = (
+            float(vec[0]), float(vec[1]), float(vec[2]), int(vec[3]))
+        bucket_norms = [float(x) for x in vec[4:4 + self.n_buckets]]
+        return self.observe(step, loss=loss, grad_norm=grad_norm,
+                            update_ratio=ratio, nonfinite=nonfinite,
+                            bucket_norms=bucket_norms,
+                            grads_flat=grads_flat, layout=layout)
+
+    def observe(self, step: int, *, loss: float, grad_norm: float,
+                update_ratio: float = 0.0, nonfinite: int = 0,
+                bucket_norms: Sequence[float] = (),
+                grads_flat: Optional[Sequence] = None,
+                layout=None) -> List[Alert]:
+        alerts = self.judge.observe(loss=loss, grad_norm=grad_norm,
+                                    nonfinite=nonfinite,
+                                    bucket_norms=bucket_norms)
+        self._publish(step, loss, grad_norm, update_ratio, nonfinite,
+                      bucket_norms, alerts)
+        if nonfinite > 0 or not math.isfinite(loss):
+            self._first_nonfinite(step, nonfinite, grads_flat, layout)
+        return alerts
+
+    # -- publishing -------------------------------------------------------
+
+    def _publish(self, step: int, loss: float, grad_norm: float,
+                 ratio: float, nonfinite: int,
+                 bucket_norms: Sequence[float],
+                 alerts: List[Alert]) -> None:
+        reg = self._reg
+        if math.isfinite(loss):
+            reg.gauge("health.loss").set(loss)
+        if math.isfinite(grad_norm):
+            reg.gauge("health.grad_norm").set(grad_norm)
+            reg.histogram("health.grad_norm_hist").observe(grad_norm)
+        reg.gauge("health.grad_norm_z").set(
+            self.judge.last_grad_z
+            if math.isfinite(self.judge.last_grad_z) else -1.0)
+        reg.gauge("health.update_ratio_max").set(ratio)
+        reg.gauge("health.nonfinite").set(nonfinite)
+        if nonfinite > 0:
+            self.nonfinite_total += nonfinite
+            reg.counter("health.nonfinite_total").inc(int(nonfinite))
+        for i, bn in enumerate(bucket_norms):
+            reg.gauge("health.bucket_grad_norm", bucket=str(i)).set(
+                bn if math.isfinite(bn) else -1.0)
+
+        firing = {a.cls for a in alerts}
+        for cls in ALERT_CLASSES:
+            reg.gauge("health.alert", **{"class": cls}).set(
+                1 if cls in firing else 0)
+        for a in alerts:
+            if not a.rising:
+                continue
+            reg.counter("health.alerts", **{"class": a.cls}).inc()
+            detail = f"step={step} {a.detail}".strip()
+            from . import flightrec  # noqa: PLC0415
+
+            flightrec.record("health.alert", name=a.cls, cycle=step,
+                             detail=detail)
+            LOG.warning("health alert [%s] at step %d (%s)",
+                        a.cls, step, a.detail)
+
+    # -- provenance -------------------------------------------------------
+
+    def _first_nonfinite(self, step: int, count: int,
+                         grads_flat: Optional[Sequence],
+                         layout) -> None:
+        if self.first_nonfinite is not None:
+            return
+        info = {"step": int(step), "rank": self.rank,
+                "count": int(count)}
+        if grads_flat is not None and layout is not None:
+            found = nonfinite_provenance(grads_flat, layout,
+                                         self.leaf_names)
+            if found is not None:
+                bucket, leaf_index, leaf_name = found
+                info.update(bucket=bucket, leaf_index=leaf_index,
+                            leaf=leaf_name)
+        self.first_nonfinite = info
+        detail = " ".join(f"{k}={v}" for k, v in info.items())
+        from . import flightrec  # noqa: PLC0415
+
+        flightrec.record("health.nonfinite", name="first", cycle=step,
+                         detail=detail)
+        LOG.error("first nonfinite at step %d (%s)", step, detail)

@@ -137,6 +137,30 @@ _METRIC_HELP = {
                           "tenant/class/metric",
     "serve.slo.alerts": "Burn-rate alert rising edges, by "
                         "tenant/class/metric",
+    "health.loss": "Per-step training loss from the in-graph health "
+                   "bundle (obs/health.py)",
+    "health.grad_norm": "Global gradient L2 norm per step",
+    "health.grad_norm_z": "Robust z-score of the last grad norm "
+                          "against its EWMA baseline (-1 = nonfinite)",
+    "health.update_ratio_max": "Max per-leaf |update|/|param| ratio "
+                               "this step",
+    "health.nonfinite": "Nonfinite gradient elements this step",
+    "health.nonfinite_total": "Cumulative nonfinite gradient elements",
+    "health.bucket_grad_norm": "Gradient L2 norm per overlap bucket "
+                               "(label: bucket index)",
+    "health.alert": "1 while the labelled anomaly class is firing "
+                    "(loss-spike / grad-explode / grad-vanish / "
+                    "dead-gradient / nonfinite)",
+    "health.alerts": "Anomaly alert rising edges, by class",
+    "health.divergence.checks": "Cross-rank digest exchanges completed "
+                                "by the divergence sentinel",
+    "health.divergence.detected": "Confirmed cross-rank state "
+                                  "divergences (labels: component, "
+                                  "leaf)",
+    "health.divergence.last_check_step": "Step of the sentinel's most "
+                                         "recent digest exchange",
+    "health.divergence.alert": "1 after a divergence was detected, 0 "
+                               "while checks pass",
 }
 
 
@@ -274,6 +298,9 @@ class LiveAggregator:
         slo = self._slo_part(views)
         if slo:
             parts.append(slo)
+        health = self._health_part(views)
+        if health:
+            parts.append(health)
         goodput = self._goodput_part(views)
         if goodput:
             parts.append(goodput)
@@ -496,6 +523,36 @@ class LiveAggregator:
         return f"slo OK burn {worst_burn or 0.0:.1f}x"
 
     @staticmethod
+    def _health_part(views) -> Optional[str]:
+        """One digest token for the training-health plane
+        (obs/health.py): ``health OK`` while the numerics are clean,
+        ``health ALERT(loss-spike, divergence)`` when an anomaly class
+        or the cross-rank sentinel is firing — silent corruption an
+        operator must see without opening /metrics.  Absent on jobs
+        that never armed ``--health``, so serving fleets stay quiet."""
+        firing: List[str] = []
+        saw_series = False
+        for view in views.values():
+            for m in view.metrics.values():
+                name = m.get("name")
+                if name == "health.alert":
+                    saw_series = True
+                    if float(m["value"]):
+                        cls = (m.get("tags") or {}).get("class", "?")
+                        firing.append(cls)
+                elif name == "health.divergence.alert":
+                    saw_series = True
+                    if float(m["value"]):
+                        firing.append("divergence")
+                elif name in ("health.loss", "health.grad_norm"):
+                    saw_series = True
+        if not saw_series:
+            return None
+        if firing:
+            return "health ALERT(" + ", ".join(sorted(set(firing))) + ")"
+        return "health OK"
+
+    @staticmethod
     def _goodput_part(views) -> Optional[str]:
         """One digest token for the goodput ledger (obs/goodput.py):
         the fleet's worst productive fraction (the fleet is only as
@@ -705,6 +762,30 @@ class LiveAggregator:
                         alerts += float(m["value"])
             if saw_slo:
                 row["slo"] = {"firing": firing, "alerts": int(alerts)}
+            # Training-health plane (obs/health.py): anomaly classes
+            # currently firing + cumulative rising edges + divergence
+            # checks, so the history file answers "when did the loss
+            # spike / which step diverged" after the job is gone.
+            h_firing = 0
+            h_alerts = 0.0
+            div_detected = 0.0
+            saw_health = False
+            for view in views.values():
+                for m in view.metrics.values():
+                    name = m.get("name")
+                    if name in ("health.alert", "health.divergence.alert"):
+                        saw_health = True
+                        h_firing += 1 if float(m["value"]) else 0
+                    elif name == "health.alerts":
+                        saw_health = True
+                        h_alerts += float(m["value"])
+                    elif name == "health.divergence.detected":
+                        saw_health = True
+                        div_detected += float(m["value"])
+            if saw_health:
+                row["health"] = {"firing": h_firing,
+                                 "alerts": int(h_alerts),
+                                 "divergences": int(div_detected)}
             return row
 
     # ------------------------------------------------------- prometheus

@@ -172,6 +172,45 @@ def _rank_summary(doc: dict) -> dict:
     # census + dominant owner) on every RESOURCE_EXHAUSTED death path.
     # The NEWEST one is this incarnation's memory story — the proof of
     # WHAT was resident when the allocator gave up.
+    # Training-health black box: the divergence sentinel drops a
+    # ``health.divergence`` event the moment a rank's state digest
+    # splits from the majority, and the health monitor a
+    # ``health.nonfinite`` on the FIRST nonfinite gradient.  The NEWEST
+    # of each is this incarnation's numerics story — often the real
+    # root cause steps before the crash the other planes see.
+    divs = [e for e in events if e.get("kind") == "health.divergence"]
+    last_divergence = None
+    if divs:
+        ev = divs[-1]
+        fields = dict(
+            kv.split("=", 1) for kv in (ev.get("detail") or "").split()
+            if "=" in kv
+        )
+        last_divergence = {
+            "step": ev.get("cycle"),
+            "component": fields.get("component") or ev.get("name"),
+            "bucket": fields.get("bucket"),
+            "leaf": fields.get("leaf"),
+            "minority": fields.get("minority"),
+        }
+    nonfinites = [e for e in events if e.get("kind") == "health.nonfinite"]
+    first_nonfinite = None
+    if nonfinites:
+        ev = nonfinites[0]
+        fields = dict(
+            kv.split("=", 1) for kv in (ev.get("detail") or "").split()
+            if "=" in kv
+        )
+        first_nonfinite = {
+            "step": ev.get("cycle"),
+            "bucket": fields.get("bucket"),
+            "leaf": fields.get("leaf"),
+            "count": fields.get("count"),
+        }
+    health_alerts = sorted({
+        e.get("name") for e in events
+        if e.get("kind") == "health.alert" and e.get("name")
+    })
     ooms = [e for e in events if e.get("kind") == "mem.oom"]
     last_oom = None
     if ooms:
@@ -211,6 +250,9 @@ def _rank_summary(doc: dict) -> dict:
         "last_exception": doc.get("last_exception"),
         "last_restore": last_restore,
         "last_oom": last_oom,
+        "last_divergence": last_divergence,
+        "first_nonfinite": first_nonfinite,
+        "health_alerts": health_alerts,
         "submitted": [e.get("name") for e in aligned
                       if e.get("kind") == "enqueue"],
         "completed": [e.get("name") for e in aligned
@@ -378,6 +420,16 @@ def analyze(
             str(r["rank"]): r["last_oom"]
             for r in ranks if r.get("last_oom")
         },
+        "health": {
+            str(r["rank"]): {
+                "divergence": r.get("last_divergence"),
+                "first_nonfinite": r.get("first_nonfinite"),
+                "alerts": r.get("health_alerts") or [],
+            }
+            for r in ranks
+            if r.get("last_divergence") or r.get("first_nonfinite")
+            or r.get("health_alerts")
+        },
         "ranks": ranks,
         "live_last_round": _read_live_history(live_history),
     }
@@ -480,6 +532,54 @@ def verdict(report: dict) -> str:
             f"{div['index'] + 1}: {ops} — ranks disagreeing on the op "
             f"sequence is the classic desync hang."
         )
+    health = report.get("health") or {}
+    div_bits = []
+    nf_bits = []
+    alert_bits = []
+    for rank, h in sorted(health.items(), key=lambda kv: int(kv[0])):
+        h = h or {}
+        d = h.get("divergence")
+        if d:
+            where = d.get("component") or "?"
+            if d.get("leaf"):
+                where += f" (leaf {d['leaf']})"
+            bit = f"rank {rank} diverged from the majority"
+            if d.get("minority") not in (None, "", str(rank)):
+                bit = (f"rank(s) {d['minority']} diverged from the "
+                       f"majority (seen by rank {rank})")
+            if d.get("step") is not None:
+                bit += f" at step {d['step']}"
+            bit += f" in {where}"
+            div_bits.append(bit)
+        nf = h.get("first_nonfinite")
+        if nf:
+            bit = f"rank {rank}'s first nonfinite gradient"
+            if nf.get("step") is not None:
+                bit += f" appeared at step {nf['step']}"
+            if nf.get("leaf"):
+                bit += f" in leaf {nf['leaf']!r}"
+                if nf.get("bucket") is not None:
+                    bit += f" (bucket {nf['bucket']})"
+            nf_bits.append(bit)
+        alerts = h.get("alerts") or []
+        if alerts and not d and not nf:
+            alert_bits.append(
+                f"rank {rank} raised health alert(s) "
+                + ", ".join(repr(a) for a in alerts)
+            )
+    if div_bits:
+        # Dedup: every rank records the identical verdict (the sentinel
+        # compares the same gathered matrix everywhere).
+        parts.append("TRAINING-STATE DIVERGENCE: "
+                     + "; ".join(sorted(set(div_bits))) + " — "
+                     "the bitwise-replication invariant broke at "
+                     "runtime; do not trust checkpoints taken after "
+                     "this step.")
+    if nf_bits:
+        parts.append("NONFINITE GRADIENTS: " + "; ".join(nf_bits) + ".")
+    if alert_bits:
+        parts.append("Training-health alerts before death: "
+                     + "; ".join(alert_bits) + ".")
     mem = report.get("memory") or {}
     if mem:
         def _gb(b):

@@ -26,6 +26,7 @@ __all__ = [
     "mem_section",
     "goodput_section",
     "slo_section",
+    "health_section",
     "summarize",
 ]
 
@@ -441,6 +442,73 @@ def slo_section(dumps: Dict[str, dict]) -> Optional[str]:
             row += (f", ALERTS FIRED {int(b['alerts'])}"
                     f" (see docs/troubleshooting.md burn-rate runbook)")
         rows.append(row)
+    return "\n".join(rows)
+
+
+def health_section(dumps: Dict[str, dict]) -> Optional[str]:
+    """End-of-job training-health verdict (obs/health.py +
+    obs/divergence.py gauges): anomaly alerts by class, the worst
+    grad-norm z-score any rank saw, nonfinite counts, and the
+    divergence sentinel's record — checks passed, last check step, and
+    any confirmed divergence with its component/leaf.  None when no
+    rank armed ``--health``."""
+    alerts: Dict[str, float] = {}
+    worst_z = None
+    nonfinite = 0.0
+    checks = 0.0
+    last_check = None
+    detected: Dict[str, float] = {}
+    saw = False
+    for label in sorted(dumps, key=_rank_sort_key):
+        for m in dumps[label].get("metrics", []):
+            name = m.get("name")
+            if not name or not name.startswith("health."):
+                continue
+            saw = True
+            tags = m.get("tags") or {}
+            if "value" not in m:
+                continue  # histograms carry quantiles, not a value
+            value = float(m["value"])
+            if name == "health.alerts":
+                cls = tags.get("class", "?")
+                alerts[cls] = alerts.get(cls, 0.0) + value
+            elif name == "health.grad_norm_z":
+                worst_z = value if worst_z is None else max(worst_z,
+                                                            value)
+            elif name == "health.nonfinite_total":
+                nonfinite += value
+            elif name == "health.divergence.checks":
+                checks = max(checks, value)
+            elif name == "health.divergence.last_check_step":
+                last_check = (value if last_check is None
+                              else max(last_check, value))
+            elif name == "health.divergence.detected":
+                where = tags.get("component", "?")
+                if tags.get("leaf"):
+                    where += f"/{tags['leaf']}"
+                detected[where] = detected.get(where, 0.0) + value
+    if not saw:
+        return None
+    rows = []
+    fired = {c: int(n) for c, n in sorted(alerts.items()) if n}
+    if fired:
+        rows.append("alerts: " + ", ".join(
+            f"{c} x{n}" for c, n in fired.items()))
+    else:
+        rows.append("alerts: none")
+    if worst_z is not None:
+        rows.append(f"worst grad-norm z-score: {worst_z:.2f}")
+    if nonfinite:
+        rows.append(f"nonfinite gradient elements: {int(nonfinite)}")
+    div = f"divergence checks: {int(checks)}"
+    if last_check is not None:
+        div += f" (last at step {int(last_check)})"
+    rows.append(div)
+    for where, n in sorted(detected.items()):
+        rows.append(
+            f"DIVERGENCE DETECTED x{int(n)} in {where} "
+            f"(see docs/health.md runbook)"
+        )
     return "\n".join(rows)
 
 

@@ -182,7 +182,35 @@ def allreduce_async(
 
 def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None, **kw):
     """Blocking allreduce (reference torch/mpi_ops.py:131-155)."""
-    return synchronize(allreduce_async(tensor, op, name, **kw))
+    result = synchronize(allreduce_async(tensor, op, name, **kw))
+    return _grad_ready_fault(result, name)
+
+
+def _grad_ready_fault(result, name: Optional[str]):
+    """Chaos hook for the divergence sentinel (testing/faults.py,
+    point ``grad_ready``): fired AFTER the reduction so an injected
+    bit flip lands on this rank's copy of the agreed result — the SDC
+    shape that makes exactly one rank diverge.  Corrupting the input
+    instead would spread identically through the reduce to every rank
+    and diverge nothing."""
+    from ..testing import faults  # noqa: PLC0415
+
+    if not faults.active():
+        return result
+    action = faults.maybe_fail("grad_ready", name=name)
+    if action not in ("flip_bits", "nan_inject"):
+        return result
+    from ..utils.env import resolve_rank  # noqa: PLC0415
+
+    corrupted = faults.corrupt_grad(
+        np.asarray(result), action,
+        rank=resolve_rank(0),
+        step=faults.point_count("grad_ready"),
+        name=name,
+    )
+    if isinstance(result, np.ndarray):
+        return corrupted
+    return jax.numpy.asarray(corrupted)
 
 
 # In-place spellings: JAX arrays are immutable, so these return the result;

@@ -679,15 +679,25 @@ class OverlapPlan:
 
     # ---------------------------------------------------------------- step
 
-    def local_step(self, loss_fn: Callable, *, has_aux: bool = False):
+    def local_step(self, loss_fn: Callable, *, has_aux: bool = False,
+                   health: bool = False):
         """The per-device train-step body: ``fn(state, *batch) ->
         (state, loss[, aux])`` where ``loss_fn(params, *batch)`` returns
         the local scalar loss (or ``(loss, aux)``).  Wrap the result in
         shard_map over the plan's mesh/axes and jit it with the state
-        donated."""
+        donated.
+
+        ``health=True`` appends one more output: the fused float32
+        health-bundle vector (obs/health.py ``bundle_names`` order —
+        loss, global grad norm, max update/param ratio, nonfinite
+        count, per-bucket grad norms), computed from values the step
+        already holds so it rides the existing device→host sync.  With
+        ``health=False`` (the default) the traced computation is
+        exactly today's — the compiled HLO is byte-identical, which CI
+        asserts."""
         if self.mode == "bucket+zero1":
-            return self._zero1_step(loss_fn, has_aux)
-        return self._replicated_step(loss_fn, has_aux)
+            return self._zero1_step(loss_fn, has_aux, health)
+        return self._replicated_step(loss_fn, has_aux, health)
 
     def _grads_off(self, loss_fn, params, args, has_aux):
         """End-of-backward fused reduce (the status quo this plane is
@@ -718,7 +728,7 @@ class OverlapPlan:
                 off += n
         return val, jax.tree_util.tree_unflatten(treedef, out)
 
-    def _replicated_step(self, loss_fn, has_aux):
+    def _replicated_step(self, loss_fn, has_aux, health=False):
         def step(state, *args):
             params, opt_state = state
             if self.mode == "bucket":
@@ -732,15 +742,32 @@ class OverlapPlan:
             else:
                 val, grads = self._grads_off(loss_fn, params, args,
                                              has_aux)
+            if health:
+                # Captured BEFORE the update so the ratio compares the
+                # step's update against the params it applied to.
+                old_params = params
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             loss, aux = (val if has_aux else (val, None))
             out = ((params, opt_state), loss)
-            return out + ((aux,) if has_aux else ())
+            if has_aux:
+                out = out + (aux,)
+            if health:
+                from ..obs.health import health_bundle  # noqa: PLC0415
+
+                bundle = health_bundle(
+                    loss,
+                    jax.tree_util.tree_flatten(grads)[0],
+                    self.layout,
+                    jax.tree_util.tree_flatten(updates)[0],
+                    jax.tree_util.tree_flatten(old_params)[0],
+                )
+                out = out + (bundle,)
+            return out
 
         return step
 
-    def _zero1_step(self, loss_fn, has_aux):
+    def _zero1_step(self, loss_fn, has_aux, health=False):
         hier = self.hierarchical_axes
 
         def gather_with_scatter_vjp(shard):
@@ -779,13 +806,54 @@ class OverlapPlan:
             val, gshards = jax.value_and_grad(
                 shard_loss, has_aux=has_aux
             )(shards, *args)
+            old_shards = shards
             updates, opt_state = self.tx.update(gshards, opt_state, shards)
             shards = optax.apply_updates(shards, updates)
             loss, aux = (val if has_aux else (val, None))
             out = ((shards, opt_state), loss)
-            return out + ((aux,) if has_aux else ())
+            if has_aux:
+                out = out + (aux,)
+            if health:
+                out = out + (self._zero1_bundle(loss, gshards, updates,
+                                                old_shards),)
+            return out
 
         return step
+
+    def _zero1_bundle(self, loss, gshards, updates, shards):
+        """The zero1 health bundle: each rank holds only its flat shard
+        of every bucket, so the per-bucket sum-of-squares, nonfinite
+        count and max-ratio are psum/pmax'd over the shard axes — one
+        tiny ``(n_buckets + 2,)`` cross-replica vector, not a second
+        gradient exchange."""
+        f32 = jnp.float32
+        axes = (self.hierarchical_axes if self.hierarchical_axes
+                else (self.axis_name,))
+        sq = []
+        nonfinite = jnp.zeros((), f32)
+        for g in gshards:
+            g32 = g.astype(f32)
+            sq.append(jnp.sum(g32 * g32))
+            nonfinite = nonfinite + jnp.sum(
+                (~jnp.isfinite(g32)).astype(f32))
+        ratio = jnp.zeros((), f32)
+        eps = f32(1e-12)
+        for u, p in zip(updates, shards):
+            r = (jnp.max(jnp.abs(u.astype(f32)))
+                 / (jnp.max(jnp.abs(p.astype(f32))) + eps))
+            ratio = jnp.maximum(ratio, r)
+        summed = jnp.stack(sq + [nonfinite])
+        for ax in axes:
+            summed = lax.psum(summed, ax)
+            ratio = lax.pmax(ratio, ax)
+        bucket_sq = summed[:-1]
+        return jnp.concatenate([
+            jnp.stack([jnp.asarray(loss, f32).reshape(()),
+                       jnp.sqrt(jnp.sum(bucket_sq)),
+                       ratio,
+                       summed[-1]]),
+            jnp.sqrt(bucket_sq),
+        ])
 
 
 # ---------------------------------------------------------------------------

@@ -120,6 +120,15 @@ _ARG_ENV_MAP = {
         envmod.SCALE_COOLDOWN_SECS,
         "serve.scale-cooldown-secs",
     ),
+    "health": (envmod.HEALTH, "metrics.health"),
+    "health_check_steps": (
+        envmod.HEALTH_CHECK_STEPS,
+        "metrics.health-check-steps",
+    ),
+    "divergence_action": (
+        envmod.DIVERGENCE_ACTION,
+        "metrics.divergence-action",
+    ),
 }
 
 

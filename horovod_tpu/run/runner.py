@@ -516,6 +516,35 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
              "default 1.0).  The verdict is a pure function of the "
              "request id, so every rank samples the identical set.",
     )
+    obs_group.add_argument(
+        "--health", choices=("on", "off"), action=_StoreOverrideAction,
+        dest="health", default=None,
+        help="Training-health plane (HVDTPU_HEALTH, default off): "
+             "in-graph per-step numerics bundle (loss, per-bucket grad "
+             "norms, update/param ratio, nonfinite counts) + EWMA "
+             "anomaly alerts, and the cross-rank divergence sentinel. "
+             "Off leaves the compiled training step byte-identical.",
+    )
+    obs_group.add_argument(
+        "--health-check-steps", type=int, action=_StoreOverrideAction,
+        dest="health_check_steps", default=None,
+        help="Divergence-sentinel cadence (HVDTPU_HEALTH_CHECK_STEPS, "
+             "default 100): every N steps each rank allgathers a tiny "
+             "bitwise digest of params/optimizer state/PRNG key and "
+             "all ranks compare — the runtime check of the bitwise-"
+             "replication invariant.",
+    )
+    obs_group.add_argument(
+        "--divergence-action", choices=("warn", "dump", "halt"),
+        action=_StoreOverrideAction, dest="divergence_action",
+        default=None,
+        help="What a confirmed cross-rank divergence does "
+             "(HVDTPU_DIVERGENCE_ACTION, default warn): warn logs and "
+             "alerts; dump additionally flushes the flight recorder "
+             "and metrics immediately; halt raises on every rank — "
+             "stop before the next checkpoint poisons every future "
+             "restart.",
+    )
 
     stall = parser.add_argument_group("stall check")
     stall.add_argument(
@@ -2002,6 +2031,10 @@ def _print_stats_summary(args, env: Dict[str, str]) -> None:
     if slo is not None:
         print("\n== tenant SLO / burn rate ==")
         print(slo)
+    health = obs_summary.health_section(dumps)
+    if health is not None:
+        print("\n== training health ==")
+        print(health)
     goodput = obs_summary.goodput_section(dumps)
     if goodput is not None:
         print("\n== goodput ledger ==")

@@ -206,6 +206,74 @@ def _fetch(ctx, scope: str, key: str, what: str) -> bytes:
         time.sleep(0.005)
 
 
+def _serve_health_check(ctx, scope: str, group: int, group_world,
+                        step: int, sdoc_raw: bytes, paged,
+                        action: str) -> None:
+    """The divergence sentinel's serving twin: every rank of a width
+    group digests the broadcast schedule doc it is about to obey plus
+    its KV page-table state, publishes the tiny digest under
+    ``healthd/``, fetches its peers' and compares.  Replicated decode
+    is the serving form of HVD001 — followers that drift from the
+    leader's schedule or page tables produce silent token corruption
+    the output checksums can't localize.  Every rank runs the identical
+    comparison on the identical matrix, so every rank reaches the
+    identical verdict (and ``halt`` stops the whole group, not one
+    rank)."""
+    import numpy as np  # noqa: PLC0415
+
+    from ..obs import divergence as obs_divergence  # noqa: PLC0415
+
+    digest = obs_divergence.serve_state_digest(sdoc_raw, paged)
+    members = sorted(group_world)
+    ctx.kv.put(scope, f"healthd/{group}/{step}/{ctx.rank}",
+               digest.astype(np.uint32).tobytes())
+    rows = []
+    for r in members:
+        if r == ctx.rank:
+            rows.append(digest)
+        else:
+            raw = _fetch(ctx, scope, f"healthd/{group}/{step}/{r}",
+                         f"serve health digest from rank {r}")
+            rows.append(np.frombuffer(raw, dtype=np.uint32))
+    mat = np.stack(rows)
+    reg = get_registry()
+    reg.counter("health.divergence.checks").inc()
+    reg.gauge("health.divergence.last_check_step").set(step)
+    # GC our own stale key (leader GC'd sched keys the same way).
+    prev = step - _SCHED_KEEP
+    if prev > 0:
+        ctx.kv.delete(scope, f"healthd/{group}/{prev}/{ctx.rank}")
+    if bool((mat == mat[0]).all()):
+        reg.gauge("health.divergence.alert").set(0)
+        return
+    minority_idx, _ = obs_divergence._partition(mat)
+    minority = [members[i] for i in minority_idx]
+    component = ("page_table"
+                 if bool((mat[:, :obs_divergence.DIGEST_WIDTH]
+                          == mat[0, :obs_divergence.DIGEST_WIDTH]).all())
+                 else "sched_doc")
+    detail = (f"step={step} "
+              f"minority={','.join(str(r) for r in minority)} "
+              f"component={component} group={group}")
+    reg.counter("health.divergence.detected", component=component).inc()
+    reg.gauge("health.divergence.alert").set(1)
+    obs_flightrec.record("health.divergence", name=component,
+                         cycle=step, detail=detail)
+    LOG.error("serving-state divergence: %s", detail)
+    if action == "halt":
+        raise obs_divergence.DivergenceHalt(
+            f"serving divergence sentinel: rank(s) {minority} diverged "
+            f"from the group at step {step} in {component} "
+            f"(--divergence-action halt)"
+        )
+    if action == "dump":
+        try:
+            obs_flightrec.dump_flight_recorder(
+                trigger="health.divergence")
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+
 def _frontdoor_shape(kv) -> int:
     """The front-door shard count ``F`` from the ownership doc the
     launcher published (``serve/frontdoor``): the interleave constant
@@ -608,6 +676,14 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
     # a CPU decode step, and it is exactly the fleet shape the width-1
     # scaling bench runs, so skip it.
     solo = len(group_world) == 1
+    # Serving twin of the divergence sentinel (obs/divergence.py):
+    # armed by --health, cadence --health-check-steps.  Solo groups
+    # have no replica to diverge from, so they skip it entirely.
+    from ..obs.health import HealthConfig  # noqa: PLC0415
+
+    health_cfg = HealthConfig.from_env()
+    health_every = (health_cfg.check_steps
+                    if health_cfg.enabled and not solo else 0)
     # The drain sentinel is write-once; probing it every busy step is
     # another roundtrip per step.  Probe on idle steps and every 8th
     # busy step (drain latency <= 8 steps), and latch the first hit.
@@ -696,17 +772,26 @@ def _serve_epoch(ctx, engine, spec: dict, totals: Dict[str, Any],
                 sw = swap.leader_step(ctx.kv, scope, group_world, step)
                 if sw is not None:
                     sdoc["swap"] = sw
+            sdoc_raw = pickle.dumps(sdoc) if not solo else b""
             if not solo:
-                ctx.kv.put(scope, f"sched/{group}/{step}",
-                           pickle.dumps(sdoc))
+                ctx.kv.put(scope, f"sched/{group}/{step}", sdoc_raw)
                 if step > _SCHED_KEEP:
                     ctx.kv.delete(scope,
                                   f"sched/{group}/{step - _SCHED_KEEP}")
         else:
-            sdoc = pickle.loads(_fetch(
+            sdoc_raw = _fetch(
                 ctx, scope, f"sched/{group}/{step}",
-                f"schedule for group {group} step {step}"))
+                f"schedule for group {group} step {step}")
+            sdoc = pickle.loads(sdoc_raw)
         t_sched = time.time()
+
+        # -- serving divergence sentinel: digest the schedule doc this
+        # rank is about to obey + its page-table state, compare across
+        # the width group (every rank, identical verdict) ----------------
+        if health_every and step % health_every == 0:
+            _serve_health_check(ctx, scope, group, group_world, step,
+                                sdoc_raw, getattr(engine, "paged", None),
+                                health_cfg.divergence_action)
 
         # -- weight hot-swap transitions (between decode steps, before
         # this step's admissions: a flip is version-stamped to exactly

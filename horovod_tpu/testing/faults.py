@@ -23,7 +23,13 @@ Grammar::
   per-rank shard write), ``replica_push`` (ckpt/replica.py peer-replica
   push after each commit), ``trace_flush`` (obs/trace.py span-dump
   path), ``mem_alloc`` (obs/memplane.py alloc_guard on the serve
-  decode/prefill paths).
+  decode/prefill paths), ``grad_ready`` (the reduced-gradient landing
+  sites — ops/eager.py's blocking allreduce after synchronize, and
+  elastic/context.py's KV allreduce after the total is computed; fired
+  AFTER the reduction so a corruption lands on one rank's copy of the
+  *agreed* result, the silent-data-corruption shape the divergence
+  sentinel exists to catch — corrupting before the reduce would spread
+  identically to every rank and diverge nothing).
 * ``rank`` — only fire on this rank (resolved from the ``rank=`` call
   argument, else ``HVDTPU_RANK``, else ``HVDTPU_ELASTIC_RANK``).  Absent
   means any rank.
@@ -77,7 +83,14 @@ Grammar::
   — fired at the top of each pump round, with the pump's frontend id
   as the rank and its beat counter as the step) to die abruptly
   mid-stream without draining — the deterministic frontend death the
-  heartbeat-takeover chaos gate is tested against.
+  heartbeat-takeover chaos gate is tested against; ``flip_bits``
+  instructs a ``grad_ready`` site to XOR one exponent bit of one
+  element of the reduced gradient it is about to hand back (element
+  chosen by ``crc32(rank:step:name)`` — deterministic per rank, step
+  and tensor, finite-in/finite-out, the canonical SDC bit flip);
+  ``nan_inject`` instructs the same site to overwrite that element
+  with NaN (the nonfinite-provenance chaos input).  Both are applied
+  by the site via :func:`corrupt_grad`.
   ``worker_exit``/``task_fn`` points default to ``exit``.
 * ``code`` — exit code for ``action=exit`` (default 43, distinguishable
   from real crashes in launcher traces).
@@ -91,8 +104,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-__all__ = ["InjectedFault", "maybe_fail", "corrupt_bytes", "parse_spec",
-           "reset", "active"]
+__all__ = ["InjectedFault", "maybe_fail", "corrupt_bytes", "corrupt_grad",
+           "parse_spec", "reset", "active", "point_count"]
 
 SPEC_ENV = "HVDTPU_FAULT_SPEC"
 DEFAULT_EXIT_CODE = 43
@@ -109,6 +122,8 @@ _ADVISORY_POINTS = {
     "scale_fail": ("scale_admit",),
     "oom": ("mem_alloc",),
     "frontend_exit": ("frontend_beat",),
+    "flip_bits": ("grad_ready",),
+    "nan_inject": ("grad_ready",),
 }
 
 
@@ -192,7 +207,8 @@ def parse_spec(raw: str) -> List[FaultSpec]:
                 if value not in ("raise", "exit", "abort", "hang", "delay",
                                  "corrupt_write", "drop_replica",
                                  "trace_drop", "swap_abort",
-                                 "scale_fail", "oom", "frontend_exit"):
+                                 "scale_fail", "oom", "frontend_exit",
+                                 "flip_bits", "nan_inject"):
                     raise ValueError(f"unknown fault action {value!r}")
                 spec.action = value
             elif key == "name":
@@ -244,6 +260,14 @@ def active() -> bool:
     return bool(_load())
 
 
+def point_count(point: str) -> int:
+    """Current value of a point's 1-based invocation counter (0 before
+    the first visit) — lets an advisory site key deterministic payload
+    corruption (:func:`corrupt_grad`) on the same step number
+    :func:`maybe_fail` just matched."""
+    return _counters.get(point, 0)
+
+
 def _resolve_rank(rank: Optional[int]) -> Optional[int]:
     if rank is not None:
         return rank
@@ -267,6 +291,49 @@ def corrupt_bytes(data: bytes) -> bytes:
     for i in (0, len(buf) // 2, len(buf) - 1):
         buf[i] ^= 0xFF
     return bytes(buf)
+
+
+def corrupt_grad(arr, action: str, *, rank: int = 0, step: int = 0,
+                 name: Optional[str] = None):
+    """Apply a fired ``grad_ready`` advisory action to a reduced
+    gradient: damage exactly ONE element, chosen deterministically by
+    ``crc32(rank:step:name)`` so a chaos assertion can name the exact
+    bucket/tensor it expects to see diverge.
+
+    ``flip_bits`` XORs 0x40 into the element's most-significant byte —
+    for floats that is a single exponent-bit flip (the canonical SDC:
+    a large, *finite* magnitude change that value-level sanity checks
+    miss but a bitwise digest cannot); ``nan_inject`` overwrites the
+    element with NaN (integer dtypes fall back to the bit flip).
+    Returns a same-dtype copy; the input is never mutated.
+    """
+    import zlib  # noqa: PLC0415
+
+    import numpy as np  # noqa: PLC0415
+
+    a = np.array(arr, copy=True)
+    if a.size == 0:
+        return a
+    key = f"{rank}:{step}:{name or ''}".encode()
+    # CRC32 is linear over GF(2): a one-character key change (e.g. the
+    # rank digit) XORs a fixed delta whose low bits can be all-zero, so
+    # ``crc % power_of_two_size`` would hit the same slot for every
+    # rank.  Avalanche the high bits down before reducing.
+    h = zlib.crc32(key)
+    h ^= h >> 16
+    h = (h * 0x45D9F3B) & 0xFFFFFFFF
+    h ^= h >> 16
+    pos = h % a.size
+    if action == "nan_inject" and np.issubdtype(a.dtype, np.floating):
+        a.reshape(-1)[pos] = np.nan
+        return a
+    if action not in ("flip_bits", "nan_inject"):
+        raise ValueError(f"corrupt_grad does not implement {action!r}")
+    raw = a.view(np.uint8).reshape(a.size, a.dtype.itemsize)
+    # Little-endian: the last byte of each element is the most
+    # significant — sign + high exponent bits for IEEE floats.
+    raw[pos, -1] ^= 0x40
+    return a
 
 
 def maybe_fail(
@@ -320,7 +387,7 @@ def maybe_fail(
         )
         if spec.action in ("corrupt_write", "drop_replica", "trace_drop",
                            "swap_abort", "scale_fail", "oom",
-                           "frontend_exit"):
+                           "frontend_exit", "flip_bits", "nan_inject"):
             # Advisory actions: the call site owns the I/O, so the
             # registry can only instruct it — corrupt the payload it is
             # about to write, or skip the push entirely.

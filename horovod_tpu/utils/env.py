@@ -174,6 +174,17 @@ MAX_WORKERS = "HVDTPU_MAX_WORKERS"
 SCALE_UP_QUEUE = "HVDTPU_SCALE_UP_QUEUE"
 SCALE_DOWN_IDLE_SECS = "HVDTPU_SCALE_DOWN_IDLE_SECS"
 SCALE_COOLDOWN_SECS = "HVDTPU_SCALE_COOLDOWN_SECS"
+# Training-health plane (obs/health.py, obs/divergence.py, ISSUE 18):
+# HEALTH arms the in-graph numerics bundle + anomaly judge ("on"/"off",
+# default off — off must leave the compiled step HLO byte-identical);
+# HEALTH_CHECK_STEPS is the divergence sentinel's cadence N (digest
+# allgather every N steps, default 100); DIVERGENCE_ACTION is what a
+# confirmed divergence does: warn | dump | halt.  Fleet-wide: the
+# sentinel's exchange is itself a collective, so every rank must derive
+# the identical cadence and action (HVD001 applies to the checker too).
+HEALTH = "HVDTPU_HEALTH"
+HEALTH_CHECK_STEPS = "HVDTPU_HEALTH_CHECK_STEPS"
+DIVERGENCE_ACTION = "HVDTPU_DIVERGENCE_ACTION"
 
 
 def resolve_rank(default=None):
