@@ -1029,12 +1029,29 @@ def _serve_bench(args) -> int:
         mem = results[ranks[0]].get("memory")
         if mem:
             out["memory"] = mem
+    # Decode-step anatomy from the leader's perf summary (no training
+    # collectives on the serve path, so the split is compute vs host
+    # gap) — attached before the degraded-record path, same rule as the
+    # training bench.
+    try:
+        from horovod_tpu.obs.anatomy import attach_anatomy  # noqa: PLC0415
+
+        perf = out.get("perf") or {}
+        attach_anatomy(
+            out, step_ms=perf.get("step_ms"), mfu=perf.get("mfu"),
+            flops_per_step=perf.get("flops_per_step"),
+            device_kind=jax.devices()[0].device_kind,
+        )
+    except Exception:
+        pass
     if on_cpu:
         out["degraded"] = True
+    # Sentinel BEFORE the record write, same rule as the training path.
+    attach_regression(out)
+    if on_cpu:
         _auto_record("cpu fallback: numbers not comparable to TPU "
                      "records", rc=0, phase="serve-cpu-fallback",
                      parsed=out)
-    attach_regression(out)
     _watchdog_disarm.set()
     print(json.dumps(out), flush=True)
     return 0
@@ -1042,55 +1059,53 @@ def _serve_bench(args) -> int:
 
 def attach_regression(out: dict, record_dir: str = None,
                       threshold_pct: float = 5.0) -> dict:
-    """Regression gate against the driver's ``BENCH_*.json`` records.
+    """Trend-aware regression sentinel over the ``BENCH_*.json``
+    trajectory (obs/trend.py owns the record reading/classification).
 
-    Compares the fresh result to the most recent record whose parsed
-    payload matches this run's metric AND device (a CPU dev run must
-    never be judged against a TPU record), embeds per-metric deltas and
-    a ``regression`` flag (value drop > ``threshold_pct``%), and makes
-    record staleness self-announcing: ``stale_records_skipped`` counts
-    the newer records that carry no comparable measurement (rc!=0 or a
-    different config) — the VERDICT r5 situation, where the official
-    record was three failed rounds old, becomes visible in the output
-    JSON itself instead of needing a reviewer to notice.
+    The baseline is the EWMA over the last K non-degraded records
+    matching this run's metric AND device (a CPU dev run must never be
+    judged against a TPU record) — one lucky round no longer owns the
+    bar.  The embedded delta carries ``baseline_records`` provenance
+    (which records the EWMA folded), ``stale_records_skipped`` counts
+    the newer records with no comparable measurement (the VERDICT r5
+    situation, self-announcing), ``degraded_records_skipped`` counts
+    the fallback records the baseline refused, and ``regression`` flags
+    a value drop > ``threshold_pct``% vs the EWMA.  Every record also
+    gets the ``trend`` stamp — the degraded-streak verdict ("N
+    consecutive records without a real measurement, last real is rX")
+    rides in the measurement itself.
 
     Best-effort by construction: any failure here must never sink the
     measurement that just survived the watchdog gauntlet.
     """
     try:
-        import glob as _glob  # noqa: PLC0415
+        from horovod_tpu.obs import trend as _trend  # noqa: PLC0415
 
         d = record_dir or os.path.dirname(os.path.abspath(__file__))
-        records = []
-        for path in _glob.glob(os.path.join(d, "BENCH_*.json")):
-            try:
-                with open(path) as f:
-                    doc = json.load(f)
-            except (OSError, ValueError):
-                continue
-            records.append((doc.get("n", 0), os.path.basename(path), doc))
-        records.sort()
-        baseline = None
+        records = _trend.load_bench_records(d)
+        stamp = _trend.trend_stamp(d)
+        if stamp is not None:
+            out["trend"] = stamp
+        key = (out.get("metric"), out.get("device"))
+        newest = None  # newest real matching record: (fname, parsed)
         skipped = 0
         degraded_skipped = 0
         for _, fname, doc in reversed(records):
-            parsed = doc.get("parsed")
+            parsed = _trend.parsed_payload(doc)
             # Degraded records (write_degraded_record) keep the
             # trajectory visible but are never a regression baseline: a
             # failed round must not reset the bar a real measurement is
             # judged against.
-            if doc.get("degraded") or (
-                isinstance(parsed, dict) and parsed.get("degraded")
-            ):
+            if _trend.classify(doc) == "degraded":
                 degraded_skipped += 1
                 continue
             if (isinstance(parsed, dict)
-                    and parsed.get("metric") == out.get("metric")
-                    and parsed.get("device") == out.get("device")):
-                baseline = (fname, parsed)
+                    and _trend.scenario_key(parsed) == key):
+                newest = (fname, parsed)
                 break
             skipped += 1
-        if baseline is None:
+        ewma = _trend.ewma_baseline(records, *key)
+        if newest is None or ewma is None:
             out["baseline_record"] = {
                 "file": None,
                 "stale_records_skipped": skipped,
@@ -1098,13 +1113,13 @@ def attach_regression(out: dict, record_dir: str = None,
             }
             out["regression"] = None  # nothing comparable to regress from
             return out
-        fname, parsed = baseline
+        fname, parsed = newest
         deltas = {}
-        for key in ("value", "mfu"):
-            old, new = parsed.get(key), out.get(key)
+        for key_name in ("value", "mfu"):
+            old, new = ewma.get(key_name), out.get(key_name)
             if (isinstance(old, (int, float)) and isinstance(new, (int, float))
                     and old):
-                deltas[key] = {
+                deltas[key_name] = {
                     "baseline": old,
                     "pct": round((new - old) / old * 100.0, 2),
                 }
@@ -1130,6 +1145,9 @@ def attach_regression(out: dict, record_dir: str = None,
             }
         out["baseline_record"] = {
             "file": fname,
+            "baseline_records": ewma["records"],
+            "ewma": {"k": ewma["k"], "alpha": ewma["alpha"],
+                     "count": ewma["count"]},
             "stale_records_skipped": skipped,
             "degraded_records_skipped": degraded_skipped,
             "stale": skipped > 0,
@@ -1317,6 +1335,12 @@ def main() -> int:
                              "an F=1 leg on the same trace and embeds "
                              "the ingest comparison + per-shard "
                              "counters in the record")
+    parser.add_argument("--campaign", default=None, metavar="SPEC",
+                        help="run a resumable benchmark campaign from "
+                        "this sweep-spec JSON instead of one "
+                        "measurement (delegates to python -m "
+                        "horovod_tpu.bench.campaign; see "
+                        "docs/performance.md 'Running a campaign')")
     parser.add_argument("--attempts", type=int, default=4,
                         help="retries (fresh process) on tunnel UNAVAILABLE")
     parser.add_argument("--watchdog-secs", type=int, default=780,
@@ -1330,6 +1354,13 @@ def main() -> int:
     parser.add_argument("--deadline-epoch", type=float, default=0.0,
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
+    if args.campaign:
+        # Campaign mode: this process becomes the sweep driver — each
+        # point runs as its own bench.py subprocess (crash isolation),
+        # so none of the watchdog/retry machinery below applies here.
+        from horovod_tpu.bench.campaign import main as campaign_main
+
+        return campaign_main(["--spec", args.campaign])
     if not args.deadline_epoch:
         args.deadline_epoch = time.time() + args.total_budget_secs
 
@@ -1578,6 +1609,23 @@ def main() -> int:
             out["num_slices"] = hvd.num_slices()
     except Exception:
         pass
+    # Step-time anatomy (obs/anatomy.py): compute / collective-wait /
+    # host-gap components that tile the measured step time, the top-K
+    # HLO op table, and the roofline verdict — attached BEFORE the
+    # degraded-record path below so even a CPU fallback record ships
+    # its number with the explanation.
+    try:
+        from horovod_tpu.obs.anatomy import attach_anatomy  # noqa: PLC0415
+
+        attach_anatomy(
+            out, step_ms=elapsed / args.iters * 1e3, mfu=out.get("mfu"),
+            flops_per_step=prof_flops,
+            device_kind=jax.devices()[0].device_kind, dtype=args.dtype,
+            compiled=compiled, steps_observed=args.warmup + args.iters,
+            gauges=gauges,
+        )
+    except Exception:
+        pass
     on_cpu = jax.devices()[0].platform == "cpu"
     if on_cpu:
         # A CPU measurement is a trajectory placeholder, not a perf
@@ -1585,9 +1633,12 @@ def main() -> int:
         # saying so (the dark-trajectory fix — the driver may not write
         # one for an off-nominal run).
         out["degraded"] = True
+    # Sentinel BEFORE the record write: the landed record must carry
+    # its own trend/regression provenance, not just the stdout line.
+    attach_regression(out)
+    if on_cpu:
         _auto_record("cpu fallback: numbers not comparable to TPU records",
                      rc=0, phase="cpu-fallback", parsed=out)
-    attach_regression(out)
     _watchdog_disarm.set()
     print(json.dumps(out), flush=True)
     return 0

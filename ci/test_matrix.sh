@@ -391,6 +391,84 @@ grep -q "REGRESSION" "$GP_TMP/verdict.txt" || {
 }
 rm -rf "$GP_TMP"
 
+# Campaign gate (ISSUE 19): the resumable-campaign plane end to end.
+# Lint stays clean over the new surface, the unit suite runs, then the
+# acceptance chaos shape through the REAL front door (bench.py
+# --campaign on a 2-point CPU spec): a seeded SIGABRT between point 1's
+# journal commit and point 2's launch kills the first session; the
+# journal on disk must still be schema-valid with point 1 committed and
+# point 2 pending; the rerun (no fault) must resume and run ONLY point
+# 2.  Every landed record must carry the step-time anatomy (components
+# tiling the step within 5%) and the trend stamp, and perf_report.py
+# must name the committed trajectory's degraded streak with r02 as the
+# last real number.
+echo "== campaign gate: lint + unit suite =="
+python -m horovod_tpu.analysis horovod_tpu/bench/campaign.py \
+    horovod_tpu/obs/trend.py horovod_tpu/obs/anatomy.py \
+    scripts/perf_report.py \
+    --baseline horovod_tpu/analysis/baseline.json
+JAX_PLATFORMS=cpu \
+    timeout 300 python -m pytest tests/test_campaign.py -x -q
+echo "== campaign gate: seeded abort between points, then resume =="
+CP_TMP=$(mktemp -d)
+cat > "$CP_TMP/spec.json" <<'EOF'
+{"name": "ci_campaign",
+ "base_args": ["--cpu", "--model", "resnet18", "--batch-size", "4",
+               "--image-size", "64", "--iters", "2", "--warmup", "1"],
+ "points": [{"name": "p1", "args": []},
+            {"name": "p2", "args": ["--batch-size", "8"]}],
+ "retry_degraded": 0,
+ "point_budget_secs": 600}
+EOF
+if JAX_PLATFORMS=cpu HVDTPU_RECORD_DIR="$CP_TMP/records" \
+   HVDTPU_FAULT_SPEC="campaign_point:step=2:action=abort" \
+       timeout 900 python bench.py --campaign "$CP_TMP/spec.json"; then
+    echo "campaign gate FAILED: aborted campaign reported success" >&2
+    exit 1
+fi
+python - "$CP_TMP/records" <<'EOF'
+import json, sys
+j = json.load(open(f"{sys.argv[1]}/campaign.json"))
+assert j["schema"] == "hvdtpu-campaign-v1", j["schema"]
+assert j["points"]["p1"]["status"] == "degraded", j["points"]["p1"]
+assert j["points"]["p2"]["status"] == "pending", j["points"]["p2"]
+print("campaign gate: journal survived the abort intact")
+EOF
+JAX_PLATFORMS=cpu HVDTPU_RECORD_DIR="$CP_TMP/records" \
+    timeout 900 python bench.py --campaign "$CP_TMP/spec.json"
+python - "$CP_TMP/records" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+j = json.load(open(f"{d}/campaign.json"))
+# Resume ran ONLY the in-flight point: p1's single pre-abort attempt
+# stands (retry_degraded=0), p2 completed exactly once.
+assert j["points"]["p1"]["attempts"] == 1, j["points"]["p1"]
+assert j["points"]["p2"]["attempts"] == 1, j["points"]["p2"]
+assert j["points"]["p2"]["status"] == "degraded", j["points"]["p2"]
+records = sorted(glob.glob(f"{d}/BENCH_*.json"))
+assert len(records) == 2, records
+for path in records:
+    parsed = json.load(open(path)).get("parsed") or {}
+    anatomy = parsed.get("anatomy") or {}
+    tile = anatomy.get("tile_pct")
+    assert tile is not None and abs(tile - 100.0) <= 5.0, (path, tile)
+    assert parsed.get("trend", {}).get("verdict"), path
+print("campaign gate: resume completed only point 2; every record "
+      "carries anatomy + trend provenance")
+EOF
+echo "== campaign gate: perf_report names the degraded streak =="
+python scripts/perf_report.py --records-dir . \
+    --campaign "$CP_TMP/records/campaign.json" > "$CP_TMP/report.txt"
+python - "$CP_TMP/report.txt" <<'EOF'
+import sys
+text = open(sys.argv[1]).read()
+assert "10 consecutive records without a real measurement" in text, text
+assert "BENCH_r02.json" in text, text
+assert "ci_campaign" in text, text
+print("campaign gate OK")
+EOF
+rm -rf "$CP_TMP"
+
 # Post-mortem gate (ISSUE 4): a 2-proc job crashed with action=abort on
 # rank 1 must leave per-rank flight-recorder dumps and a launcher-written
 # postmortem.json that is schema-valid and blames the injected rank; the

@@ -1,39 +1,12 @@
 #!/usr/bin/env bash
-# Hardware measurement plan for the first available tunnel window
-# (docs/performance.md "Round-4 transformer levers").  Sequential, each
-# config tolerant of failure, everything appended as labeled JSON lines —
-# a later hang can't erase earlier results.
-#
-#   scripts/hw_sweep.sh [results_file]
-set -u
-cd "$(dirname "$0")/.."
-OUT="${1:-/tmp/hw_sweep_results.jsonl}"
-
-# run <label> <outer-timeout> <bench-budget> [bench args...] — shared
-# with hw_sweep2.sh (timeout/validation semantics documented there)
-. "$(dirname "$0")/_bench_run.sh"
-
-# 1. the headline record (VERDICT r3 item 1): expect ~2660 img/s bf16
-#    (batch 128 is the measured sweet spot — performance.md "Knobs tried")
-run resnet50_bf16_b128 1800 1440
-# 2. first real-chip GPT number (VERDICT r3 item 2)
-run gpt_small_base 1800 1440 --model gpt-small --flash-block-q 128 --flash-block-k 128
-# 3. the round-4 levers, one at a time
-run gpt_small_remat 1800 1440 --model gpt-small --remat --flash-block-q 128 --flash-block-k 128
-run gpt_small_remat_b16 1800 1440 --model gpt-small --remat --batch-size 16 --flash-block-q 128 --flash-block-k 128
-run gpt_small_blocks256 1800 1440 --model gpt-small --flash-block-q 256 --flash-block-k 256
-run gpt_small_blocks512q 1800 1440 --model gpt-small --flash-block-q 512 --flash-block-k 256
-run gpt_small_gqa4 1800 1440 --model gpt-small --kv-heads 4 --flash-block-q 128 --flash-block-k 128
-run gpt_small_rope 1800 1440 --model gpt-small --pos-embedding rope --flash-block-q 128 --flash-block-k 128
-run gpt_small_rope_gqa_remat 1800 1440 --model gpt-small --pos-embedding rope --kv-heads 4 --remat --batch-size 16
-# 4. the other headline families (docs/benchmarks.md)
-run inception3_bf16 1800 1440 --model inception3 --batch-size 128
-run vgg16_bf16 1800 1440 --model vgg16 --batch-size 64
-# 5. fp8-vs-bf16 replication (VERDICT r4 weak #2): 3-run medians in one
-#    session; repeats are cache-warmed so each costs ~1 min of chip time
-run resnet50_bf16_rep2 1800 1440
-run resnet50_bf16_rep3 1800 1440
-run resnet50_fp8_rep1 1800 1440 --dtype fp8
-run resnet50_fp8_rep2 1800 1440 --dtype fp8
-run resnet50_fp8_rep3 1800 1440 --dtype fp8
-echo "sweep complete -> $OUT" >&2
+# DEPRECATED (ISSUE 19): the ad-hoc sweep scripts are retired in favor
+# of ONE resumable entry point.  This plan lives on (merged with
+# hw_sweep2.sh) as a campaign spec: committed points are journaled in
+# campaign.json, a tunnel flake loses at most the in-flight point, and
+# rerunning the same command resumes instead of starting over.
+echo "scripts/hw_sweep.sh is deprecated; run the resumable campaign instead:" >&2
+echo "" >&2
+echo "    python bench.py --campaign scripts/campaigns/hw_round.json" >&2
+echo "" >&2
+echo "then render results with:  python scripts/perf_report.py" >&2
+exit 2

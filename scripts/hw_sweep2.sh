@@ -1,55 +1,12 @@
 #!/usr/bin/env bash
-# Hardware sweep, part 2 — the configs the first tunnel window didn't
-# reach (the outage killed hw_sweep.sh at gpt_small_rope) plus the
-# follow-ups the part-1 results motivated: flash-block sizes were the
-# dominant lever (128->512q: +69% tokens/sec), so push that axis further
-# and retry the two GQA configs with a wider compile window (the kv-heads
-# compile burned its whole 1440s budget in part 1).
-#
-#   scripts/hw_sweep2.sh [results_file]
-set -u
-cd "$(dirname "$0")/.."
-OUT="${1:-/tmp/hw_sweep2_results.jsonl}"
-
-. "$(dirname "$0")/_bench_run.sh"
-
-# 1. the must-land records first: bf16 3-run median completion + the fp8
-#    replication (VERDICT r5 task 8).  resnet executables are already in
-#    .jax_cache, so the bf16 reps cost ~2 min each.
-run resnet50_bf16_rep2 1800 1440
-run resnet50_bf16_rep3 1800 1440
-run resnet50_fp8_rep1 1800 1440 --dtype fp8
-run resnet50_fp8_rep2 1800 1440 --dtype fp8
-run resnet50_fp8_rep3 1800 1440 --dtype fp8
-# 2. the other headline conv families (docs/benchmarks.md)
-run inception3_bf16 1800 1440 --model inception3 --batch-size 128
-run vgg16_bf16 1800 1440 --model vgg16 --batch-size 64
-# 3. part-1 stragglers
-run gpt_small_rope 1800 1440 --model gpt-small --pos-embedding rope
-# 4. flash-block follow-ups (the big lever: 0.193 -> 0.325 MFU in part 1)
-run gpt_small_blocks512x512 1800 1440 --model gpt-small --flash-block-q 512 --flash-block-k 512
-run gpt_small_blocks1024q 1800 1440 --model gpt-small --flash-block-q 1024 --flash-block-k 256
-run gpt_small_blocks512q_b16 1800 1440 --model gpt-small --flash-block-q 512 --flash-block-k 256 --batch-size 16
-run gpt_small_ref_attn 1800 1440 --model gpt-small --attention reference
-# 4b. transformer fp8 act storage (round-5 feature: e4m3 attention
-#     context + branch deltas + gelu intermediates)
-run gpt_small_fp8 1800 1440 --model gpt-small --dtype fp8
-# 4c. sliding-window attention (round-5 feature: banded tiles skipped
-#     fwd+bwd).  128x128 tiles on purpose: W=256 at seq 1024 then skips
-#     21/36 causal tiles (58%) — at the default 512x256 tiles the band
-#     only removes 1/6 and measures nothing.  Compare vs gpt_small_base
-#     (also 128x128, part-1: 57.5k tok/s).
-run gpt_small_window256 1800 1440 --model gpt-small --attention-window 256 --flash-block-q 128 --flash-block-k 128
-# 5. GQA retries with a wide compile window (part-1 failure mode: compile
-#    alone outlived the 780s watchdog AND the 1440s budget)
-run gpt_small_gqa4 3000 2700 --model gpt-small --kv-heads 4 --watchdog-secs 2400
-run gpt_small_rope_gqa_remat 3000 2700 --model gpt-small --pos-embedding rope --kv-heads 4 --remat --batch-size 16 --watchdog-secs 2400
-# 6. scale-up: medium at the best small-model blocks
-run gpt_medium_blocks512q 3000 2700 --model gpt-medium --flash-block-q 512 --flash-block-k 256 --watchdog-secs 2400
-run gpt_small_moe8 3000 2700 --model gpt-small --moe-experts 8 --watchdog-secs 2400
-# 7. trace-grade residual-bound analysis of the winning gpt config
-#    (cache-warmed by section 4, so this costs ~2 min of chip time);
-#    the per-category breakdown prints to the sweep log
-timeout 900 python scripts/profile_bench.py --model gpt-small \
-    --out /root/repo/gpt_trace_r05 2>&1 | tail -30 >&2 || true
-echo "sweep2 complete -> $OUT" >&2
+# DEPRECATED (ISSUE 19): the ad-hoc sweep scripts are retired in favor
+# of ONE resumable entry point.  This plan lives on (merged with
+# hw_sweep.sh) as a campaign spec: committed points are journaled in
+# campaign.json, a tunnel flake loses at most the in-flight point, and
+# rerunning the same command resumes instead of starting over.
+echo "scripts/hw_sweep2.sh is deprecated; run the resumable campaign instead:" >&2
+echo "" >&2
+echo "    python bench.py --campaign scripts/campaigns/hw_round.json" >&2
+echo "" >&2
+echo "then render results with:  python scripts/perf_report.py" >&2
+exit 2

@@ -13,9 +13,15 @@ against placeholders.  This gate makes the trajectory machine-visible:
    the r03–r05 dark rounds), and print it.
 2. **Baseline** per scenario ``(metric, device)``: the best value among
    real records only.  A degraded record is trajectory evidence, never
-   a bar.
+   a bar.  The audit also prints the degraded-streak verdict ("N
+   consecutive records without a real measurement; last real number is
+   rX") from the trend observatory (horovod_tpu/obs/trend.py), which
+   owns record classification for this gate, bench.py's in-record
+   sentinel and scripts/perf_report.py alike.
 3. **Judge a candidate** (``--candidate fresh.json``) against its
-   scenario's baseline with a configurable noise band
+   scenario's EWMA-over-the-last-K-real-records baseline
+   (obs/trend.py's fold — one lucky round must not own the bar) with a
+   configurable noise band
    (``--noise-pct``, default 5): a drop past the band exits nonzero so
    CI can gate on it.  Backend provenance (the ``provenance`` stamp
    bench.py embeds: platform / device kind / JAX_PLATFORMS) is printed
@@ -38,6 +44,18 @@ import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# Record classification is single-sourced in the trend observatory
+# (horovod_tpu/obs/trend.py): the gate, bench.py's in-record sentinel
+# and scripts/perf_report.py must never disagree about what counts as
+# a real measurement.
+from horovod_tpu.obs import trend as _trend  # noqa: E402
+
+parsed_payload = _trend.parsed_payload
+classify = _trend.classify
+scenario_key = _trend.scenario_key
 
 
 def load_records(record_dir):
@@ -60,31 +78,6 @@ def load_records(record_dir):
                         os.path.basename(path), doc))
     records.sort()
     return records
-
-
-def parsed_payload(doc):
-    """The measurement payload: bench.py main() embeds it under
-    ``parsed`` in driver records; a bare bench stdout JSON (a fresh
-    ``--candidate``) IS the payload."""
-    parsed = doc.get("parsed")
-    if isinstance(parsed, dict):
-        return parsed
-    if "metric" in doc:
-        return doc
-    return None
-
-
-def classify(doc):
-    """'real' | 'degraded' | 'failed' for one record document."""
-    parsed = parsed_payload(doc)
-    if doc.get("degraded") or (isinstance(parsed, dict)
-                               and parsed.get("degraded")):
-        return "degraded"
-    if (doc.get("rc", 0) == 0 and isinstance(parsed, dict)
-            and parsed.get("metric")
-            and isinstance(parsed.get("value"), (int, float))):
-        return "real"
-    return "failed"
 
 
 def provenance_of(doc):
@@ -111,10 +104,6 @@ def _prov_str(prov):
     if prov.get("jax_platforms"):
         bits.append(f"JAX_PLATFORMS={prov['jax_platforms']}")
     return " ".join(bits) or "provenance unknown"
-
-
-def scenario_key(parsed):
-    return (parsed.get("metric"), parsed.get("device"))
 
 
 def partition(records):
@@ -198,12 +187,21 @@ def main(argv=None):
         print(f"  {metric} on {device or 'unknown device'}: "
               f"{parsed['value']} ({fname})")
 
+    # The dark trajectory self-announces: how many rounds since the
+    # last real number, and what that number was.  (Printed after the
+    # per-record lines so the partition stays the first mention of
+    # every record name — CI greps by first match.)
+    streak = _trend.degraded_streak(records)
+    print(f"# degraded-streak verdict: {streak['verdict']}")
+
     verdict = {
         "records": len(records),
         "real": [f for _, f, _ in buckets["real"]],
         "degraded": [f for _, f, _ in buckets["degraded"]],
         "failed": [f for _, f, _ in buckets["failed"]],
         "noise_pct": args.noise_pct,
+        "degraded_streak": streak["streak"],
+        "last_real_record": streak["last_real_record"],
         "regression": False,
     }
 
@@ -241,16 +239,23 @@ def main(argv=None):
             verdict["candidate"] = {"scenario": list(key),
                                     "baseline": None}
         else:
-            fname, parsed = base[key]
-            word, pct = judge(cand, parsed, args.noise_pct)
-            print(f"# candidate {cand['value']} vs baseline "
-                  f"{parsed['value']} ({fname}): {pct:+.2f}% "
+            # EWMA over the last K real records of the scenario, not
+            # the single best one: one lucky round must not own the bar
+            # (obs/trend.py owns the fold; same baseline bench.py's
+            # in-record sentinel uses).
+            ewma = _trend.ewma_baseline(records, *key)
+            word, pct = judge(cand, ewma, args.noise_pct)
+            print(f"# candidate {cand['value']} vs EWMA baseline "
+                  f"{ewma['value']} over {len(ewma['records'])} real "
+                  f"record{'s' if len(ewma['records']) != 1 else ''} "
+                  f"({', '.join(ewma['records'])}): {pct:+.2f}% "
                   f"[band ±{args.noise_pct}%] -> {word.upper()} ({prov})")
             verdict["candidate"] = {
                 "scenario": list(key),
                 "value": cand["value"],
-                "baseline": parsed["value"],
-                "baseline_record": fname,
+                "baseline": ewma["value"],
+                "baseline_record": ewma["newest"],
+                "baseline_records": ewma["records"],
                 "pct": round(pct, 2),
                 "verdict": word,
             }

@@ -1,98 +1,25 @@
 #!/usr/bin/env python
-"""Summarize hw_sweep results (JSONL from scripts/hw_sweep*.sh):
-
-* a markdown table (config, value, unit, MFU) ready for
-  docs/performance.md,
-* replication medians ± spread for any config family with reps
-  (``<name>_rep<N>`` rows fold into one median row),
-* the fp8-vs-bf16 ratio when both medians exist.
-
-Usage: python scripts/summarize_sweep.py results.jsonl [more.jsonl ...]
+"""DEPRECATED (ISSUE 19): summarize_sweep.py read the ad-hoc JSONL the
+retired hw_sweep*.sh scripts appended.  Sweeps are campaigns now — a
+``campaign.json`` journal with per-point status/provenance — and the
+report side lives in scripts/perf_report.py, which also renders the
+full BENCH/MULTICHIP trajectory and the degraded-streak verdict.
 """
 
 from __future__ import annotations
 
-import json
-import re
-import statistics
 import sys
-from collections import defaultdict
-
-
-def load(paths):
-    rows = []
-    for path in paths:
-        with open(path) as f:
-            for n, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rows.append(json.loads(line))
-                except ValueError:
-                    # A truncated line (sweep killed mid-write) must not
-                    # take the whole summary down with it.
-                    rows.append({"config": f"{path}:{n}", "result": None,
-                                 "malformed": True})
-    return rows
 
 
 def main() -> int:
-    if len(sys.argv) < 2:
-        print(__doc__)
-        return 2
-    rows = load(sys.argv[1:])
-
-    reps = defaultdict(list)
-    singles = []
-    for row in rows:
-        config = row.get("config", "(unnamed)")
-        r = row.get("result")
-        # A row whose result lacks value/unit (a bench that died after
-        # emitting a partial object) renders as one (malformed) line
-        # instead of KeyError-ing the whole summary.
-        if isinstance(r, dict) and r.get("value") is None:
-            singles.append((config, "malformed"))
-            continue
-        if r is None:
-            singles.append(
-                (config, "malformed" if row.get("malformed") else None)
-            )
-            continue
-        m = re.fullmatch(r"(.*)_rep\d+", config)
-        if m:
-            reps[m.group(1)].append(r)
-        else:
-            singles.append((config, r))
-
-    print("| Config | value | unit | MFU |")
-    print("|---|---|---|---|")
-    for name, r in singles:
-        if r == "malformed":
-            print(f"| {name} | (malformed) | | |")
-        elif r is None:
-            print(f"| {name} | (no result) | | |")
-        else:
-            print(f"| {name} | {r['value']:,} | {r.get('unit', '')} "
-                  f"| {r.get('mfu')} |")
-    medians = {}
-    for name, results in sorted(reps.items()):
-        vals = [r["value"] for r in results]
-        med = statistics.median(vals)
-        medians[name] = med
-        spread = (max(vals) - min(vals)) / med * 100 if med else 0
-        mfus = [r["mfu"] for r in results if r.get("mfu") is not None]
-        mfu = statistics.median(mfus) if mfus else ""
-        print(f"| {name} (median of {len(vals)}) | {med:,} "
-              f"| {results[0].get('unit', '')} ± {spread:.1f}% | {mfu} |")
-
-    fp8 = next((v for k, v in medians.items() if "fp8" in k), None)
-    bf16 = next((v for k, v in medians.items()
-                 if "bf16" in k and "fp8" not in k), None)
-    if fp8 and bf16:
-        print(f"\nfp8 / bf16 median ratio: {fp8 / bf16:.4f} "
-              f"({(fp8 / bf16 - 1) * 100:+.1f}%)")
-    return 0
+    print("scripts/summarize_sweep.py is deprecated; sweeps are "
+          "resumable campaigns now:", file=sys.stderr)
+    print("", file=sys.stderr)
+    print("    python bench.py --campaign "
+          "scripts/campaigns/hw_round.json", file=sys.stderr)
+    print("    python scripts/perf_report.py   # trajectory + campaign "
+          "verdict table", file=sys.stderr)
+    return 2
 
 
 if __name__ == "__main__":

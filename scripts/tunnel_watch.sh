@@ -1,13 +1,16 @@
 #!/usr/bin/env bash
-# Wait for the axon TPU tunnel to come back, then run the full hardware
-# measurement sweep (scripts/hw_sweep.sh) unattended.  The probe is cheap
-# (one jax.devices() with a hard timeout) so a multi-hour outage costs
-# nothing but probes; the first successful probe triggers the sweep.
+# Wait for the axon TPU tunnel to come back, then run the hardware
+# measurement campaign (scripts/campaigns/hw_round.json) unattended.
+# The probe is cheap (one jax.devices() with a hard timeout) so a
+# multi-hour outage costs nothing but probes; the first successful
+# probe triggers the campaign.  Because the campaign is resumable, a
+# mid-sweep tunnel drop is cheap too: the loop keeps probing and the
+# next window picks up from the campaign.json journal.
 #
-#   scripts/tunnel_watch.sh [results_file]
+#   scripts/tunnel_watch.sh [campaign_spec]
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-/tmp/hw_sweep_results.jsonl}"
+SPEC="${1:-scripts/campaigns/hw_round.json}"
 # A broken environment (no jax, wrong python) would fail every probe with
 # the same silence as a tunnel outage and loop forever; tell them apart
 # up front.
@@ -22,9 +25,18 @@ while true; do
     if timeout 240 python -c \
             "import jax; assert jax.devices()[0].platform != 'cpu'" \
             >/dev/null 2>&1; then
-        echo "# tunnel up at $(date -u +%FT%TZ); starting sweep" >&2
-        bash scripts/hw_sweep.sh "$OUT"
-        exit 0
+        echo "# tunnel up at $(date -u +%FT%TZ); starting campaign" >&2
+        # Resumable: a tunnel drop mid-campaign exits nonzero here and
+        # the watch loop resumes probing; the next window continues
+        # from the journal instead of starting over.  Bounded launches:
+        # once every point's retry budget is spent the campaign keeps
+        # exiting 1 with nothing left to run — don't loop on that.
+        LAUNCHES=$((${LAUNCHES:-0} + 1))
+        if python bench.py --campaign "$SPEC" || [ "$LAUNCHES" -ge 5 ]; then
+            python scripts/perf_report.py || true
+            exit 0
+        fi
+        echo "# campaign interrupted (launch $LAUNCHES); resuming probe loop" >&2
     fi
     echo "# tunnel down at $(date -u +%FT%TZ); next probe in 300s" >&2
     sleep 300

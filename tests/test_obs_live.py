@@ -822,10 +822,16 @@ def test_bench_regression_gate(tmp_path):
     out = bench.attach_regression(
         {"metric": "m", "value": 99.0, "mfu": 0.22, "device": dev},
         record_dir=str(tmp_path))
+    # r19: the baseline is the EWMA over the real trajectory
+    # (0.5*110 + 0.5*100 = 105), not the single newest record, and the
+    # provenance names every record the fold consumed.
     assert out["baseline_record"] == {
-        "file": "BENCH_r02.json", "stale_records_skipped": 1,
+        "file": "BENCH_r02.json",
+        "baseline_records": ["BENCH_r01.json", "BENCH_r02.json"],
+        "ewma": {"k": 5, "alpha": 0.5, "count": 2},
+        "stale_records_skipped": 1,
         "degraded_records_skipped": 0, "stale": True}
-    assert out["deltas"]["value"]["pct"] == -10.0
+    assert out["deltas"]["value"]["pct"] == -5.71
     assert out["regression"] is True
 
     ok = bench.attach_regression(
