@@ -130,8 +130,81 @@ EOF
     echo "analysis gate OK"
 }
 
+# Race gate (ISSUE 20): the guarded-by data-race family (HVDC108/109/
+# 110) specifically.  Two halves: the committed tree restricted to the
+# race rules must be clean against the committed baseline (every racy
+# access in the serving fleet is either fixed or carries a reasoned
+# baseline entry), and a seeded unguarded-write fixture must FAIL the
+# run with the class, the field AND the inferred guard named — a gate
+# that cannot fail, or that fails without attribution, is decorative.
+races_gate() {
+    echo "== races gate: HVDC108-110 clean tree vs baseline =="
+    RG_TMP=$(mktemp -d)
+    # --rules is a partial view, so baseline-staleness policing stays
+    # with analysis_gate's full-surface --strict-baseline run; this
+    # run asserts the race family's own verdict in isolation.
+    if ! python -m horovod_tpu.analysis \
+        --rules HVDC108,HVDC109,HVDC110 \
+        --baseline horovod_tpu/analysis/baseline.json \
+        > "$RG_TMP/clean.out"; then
+        echo "races gate FAILED: new race findings on the clean tree" >&2
+        cat "$RG_TMP/clean.out" >&2
+        rm -rf "$RG_TMP"
+        exit 1
+    fi
+    echo "== races gate: seeded unguarded write must fail, attributed =="
+    cat > "$RG_TMP/seeded_race.py" <<'EOF'
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+
+    def start(self):
+        threading.Thread(target=self._run).start()
+
+    def _run(self):
+        with self._lock:
+            self._depth += 1
+        with self._lock:
+            self._depth -= 1
+
+    def depth(self):
+        with self._lock:
+            return self._depth
+
+    def spill(self):
+        self._depth = 0     # write outside the inferred guard: HVDC108
+EOF
+    if python -m horovod_tpu.analysis "$RG_TMP/seeded_race.py" \
+        --baseline horovod_tpu/analysis/baseline.json \
+        > "$RG_TMP/seeded.out" 2>&1; then
+        echo "races gate FAILED: seeded unguarded write passed the linter" >&2
+        cat "$RG_TMP/seeded.out" >&2
+        rm -rf "$RG_TMP"
+        exit 1
+    fi
+    # the finding must name the class+field and the inferred lock
+    for want in "HVDC108" "Pump._depth" "Pump.self._lock"; do
+        grep -q "$want" "$RG_TMP/seeded.out" || {
+            echo "races gate FAILED: finding lost its attribution ($want)" >&2
+            cat "$RG_TMP/seeded.out" >&2
+            rm -rf "$RG_TMP"
+            exit 1
+        }
+    done
+    rm -rf "$RG_TMP"
+    echo "races gate OK"
+}
+
 if [ "${1:-full}" = "quick" ]; then
-    analysis_gate
+    # Fast lint pre-gate: changed-files-only via the dev-loop wrapper
+    # (ISSUE 20 satellite) — on a per-commit diff this is seconds; the
+    # FULL-surface analysis_gate + races_gate stay in the full tier,
+    # where their cost is amortized against the long pole.
+    echo "== quick tier: lint pre-gate over changed files =="
+    python scripts/lint.py --changed
     # per-commit tier: everything except the long pole (soak, differential
     # fuzz, fp8 numerics contract, scaling gates) — see pytest.ini markers.
     # The elastic/fault-injection suite runs first and by name: recovery
@@ -161,6 +234,7 @@ if [ "${1:-full}" = "quick" ]; then
 fi
 
 analysis_gate
+races_gate
 
 echo "== unit + in-process multiprocess suite (builds cover both engines) =="
 # Parallel full tier (VERDICT r4 weak #6: 30 min single-threaded and

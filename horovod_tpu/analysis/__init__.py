@@ -19,7 +19,12 @@ Two rule families (catalog: ``--list-rules`` / docs/analysis.md):
 * ``HVDC1xx`` — concurrency discipline: lock-order inversions,
   blocking calls under locks, and the signal-path rules (non-reentrant
   locks, logging, blocking calls, unbounded growth reachable from
-  death hooks), plus swallowed shutdown exceptions.
+  death hooks), plus swallowed shutdown exceptions — and, since PR 20,
+  the RacerD-style data-race family (:mod:`horovod_tpu.analysis.racer`):
+  per-field guarded-by inference over thread-escaped lock-owning
+  classes, reporting unguarded writes (HVDC108), unguarded reads
+  against a disciplined write side (HVDC109), and lock-split
+  check-then-act pairs (HVDC110).
 
 The compiled-artifact side lives in :mod:`horovod_tpu.analysis.hlo`
 (``python -m horovod_tpu.analysis.hlo``): parse scheduled HLO dumps

@@ -8,10 +8,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import multiprocessing
 import os
 import subprocess
 import sys
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from . import baseline as baseline_mod
 from . import cache as cache_mod
@@ -60,33 +61,42 @@ def _changed_files(root: str) -> List[str]:
     ``--name-status`` (not ``--name-only``) so deletions are dropped
     and renames contribute their NEW path: a plain name listing hands
     back paths that no longer exist (the D side of a delete, the old
-    side of a rename), which then crash the per-file loop."""
+    side of a rename), which then crash the per-file loop.
+
+    ``-z`` so records are NUL-separated: the text form C-quotes paths
+    containing tabs/newlines/non-ASCII, which a tab-split mangles into
+    a path that isn't on disk.  A ``-z`` record is ``status NUL path``
+    (two paths for R/C, old then new)."""
     files: Set[str] = set()
     try:
         res = subprocess.run(
-            ["git", "diff", "--name-status", "-M", "HEAD"],
+            ["git", "diff", "--name-status", "-z", "-M", "HEAD"],
             cwd=root, capture_output=True, text=True,
             timeout=30, check=True,
         )
-        for line in res.stdout.splitlines():
-            parts = line.split("\t")
-            if len(parts) < 2:
-                continue
-            status = parts[0]
+        toks = res.stdout.split("\0")
+        i = 0
+        while i < len(toks):
+            status = toks[i]
+            i += 1
+            if not status:
+                continue  # trailing NUL
+            npaths = 2 if status[:1] in ("R", "C") else 1
+            rec = toks[i:i + npaths]
+            i += npaths
+            if len(rec) < npaths:
+                break  # torn record: trust only complete ones
             if status.startswith("D"):
                 continue  # deleted: nothing on disk to lint
-            # R100\told\tnew / C90\tsrc\tdst: the last column is the
-            # path that exists in the working tree now.
-            files.add(parts[-1].strip())
+            # R100 old new / C90 src dst: the last path is the one that
+            # exists in the working tree now.
+            files.add(rec[-1])
         res = subprocess.run(
-            ["git", "ls-files", "--others", "--exclude-standard"],
+            ["git", "ls-files", "--others", "--exclude-standard", "-z"],
             cwd=root, capture_output=True, text=True,
             timeout=30, check=True,
         )
-        files.update(
-            line.strip() for line in res.stdout.splitlines()
-            if line.strip()
-        )
+        files.update(t for t in res.stdout.split("\0") if t)
     except (OSError, subprocess.SubprocessError) as e:
         # exit 2: environment/usage error — never 1, which the
         # documented contract reserves for "new findings".
@@ -102,6 +112,27 @@ def _changed_files(root: str) -> List[str]:
     )
 
 
+def _lint_one(job: Tuple[str, str]) -> Tuple[str, Optional[dict]]:
+    """``--jobs`` worker: one file's module findings plus its taint
+    local phase, returned in **cache-entry shape** (plain JSON types).
+
+    That shape is the whole trick: the result pickles cheaply across
+    the process boundary, the parent validates it with the exact same
+    ``findings_from_entry``/``seed_summary_memo`` path a warm on-disk
+    cache hit takes, and it slots verbatim into the merged cache — so
+    parallelism cannot make the cache incoherent without also breaking
+    the (well-tested) cache read path."""
+    path, rel = job
+    model = load_module(path, rel)
+    if model is None:
+        return rel, None  # parse error: the parent re-reports it
+    key = taint.content_key(model.source)
+    module_findings = registry.run_module_rules(model)
+    taint.module_taint_cached(model)  # force the local phase for dump
+    return rel, cache_mod.entry_for(
+        key, module_findings, taint.dump_summary_memo(key))
+
+
 def analyze_paths(
     paths: Sequence[str],
     *,
@@ -109,6 +140,7 @@ def analyze_paths(
     exclude: Sequence[str] = (),
     rules: Optional[Set[str]] = None,
     cache_path: Optional[str] = None,
+    jobs: int = 1,
 ) -> List[Finding]:
     """Library entry point: lint ``paths`` (files or directories),
     returning findings with suppression status applied (baseline is the
@@ -118,6 +150,11 @@ def analyze_paths(
     unchanged files reuse their module-scope findings and taint
     summaries by content hash; project-scope rules always re-run (their
     verdicts span files) but start from the cached summaries.
+
+    ``jobs`` > 1 fans the per-file work (module rules + taint local
+    phase) for cache MISSES out over worker processes; cache hits and
+    project-scope rules stay in-process, so the on-disk cache and the
+    interprocedural closures behave identically to a serial run.
     """
     root = os.path.abspath(root or os.getcwd())
     files = _iter_py_files(paths, exclude, root)
@@ -137,6 +174,7 @@ def analyze_paths(
             continue
         models.append(model)
     dirty = False
+    misses: List[ModuleModel] = []
     for model in models:
         key = taint.content_key(model.source)
         entry = cached.get(model.relpath)
@@ -150,9 +188,51 @@ def analyze_paths(
         else:
             entry = None
         if module_findings is None:
+            misses.append(model)
+            continue
+        findings.extend(module_findings)
+        if cache_path:
+            new_cache[model.relpath] = (key, module_findings, entry)
+    worker_entries: Dict[str, dict] = {}
+    if jobs > 1 and len(misses) > 1:
+        try:
+            # fork where available (Linux): the workers inherit the
+            # imported rule modules instead of re-importing them.
+            try:
+                ctx = multiprocessing.get_context("fork")
+            except ValueError:
+                ctx = multiprocessing.get_context()
+            nproc = min(jobs, len(misses))
+            with ctx.Pool(nproc) as pool:
+                results = pool.map(
+                    _lint_one,
+                    [(m.path, m.relpath) for m in misses],
+                    chunksize=max(1, len(misses) // (nproc * 4)),
+                )
+            worker_entries = {
+                rel: entry for rel, entry in results
+                if entry is not None
+            }
+        except OSError:
+            worker_entries = {}  # no fds / sandboxed: serial fallback
+    for model in misses:
+        key = taint.content_key(model.source)
+        entry: Optional[dict] = worker_entries.get(model.relpath)
+        module_findings = None
+        if entry is not None and entry.get("key") == key:
+            # Same validation path as an on-disk cache hit; anything
+            # malformed falls through to in-process recompute.
+            module_findings = cache_mod.findings_from_entry(
+                entry, model.relpath)
+            raw_taint = entry.get("taint")
+            if isinstance(raw_taint, dict) and raw_taint:
+                taint.seed_summary_memo(key, raw_taint)
+        else:
+            entry = None
+        if module_findings is None:
             module_findings = registry.run_module_rules(model)
             entry = None
-            dirty = True
+        dirty = True
         findings.extend(module_findings)
         if cache_path:
             new_cache[model.relpath] = (key, module_findings, entry)
@@ -277,6 +357,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "gate; full-surface runs only)",
     )
     parser.add_argument(
+        "--jobs", "-j", type=int, default=1, metavar="N",
+        help="analyze files with N worker processes (per-file rules + "
+             "taint local phase; project-scope rules stay in-process); "
+             "0 = one per CPU",
+    )
+    parser.add_argument(
         "--no-cache", action="store_true",
         help="skip the per-file analysis cache (content-hash keyed "
              "module findings + taint summaries)",
@@ -361,10 +447,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         cache_path = cfg.cache if os.path.isabs(cfg.cache) else \
             os.path.join(root, cfg.cache)
 
+    jobs = args.jobs
+    if jobs < 0:
+        print(f"hvdtpu-lint: --jobs must be >= 0, got {jobs}",
+              file=sys.stderr)
+        return 2
+    if jobs == 0:
+        jobs = os.cpu_count() or 1
+
     try:
         findings = analyze_paths(
             paths, root=root, exclude=cfg.exclude, rules=rules_filter,
-            cache_path=cache_path,
+            cache_path=cache_path, jobs=jobs,
         )
     except ValueError as e:  # config errors
         print(f"hvdtpu-lint: {e}", file=sys.stderr)

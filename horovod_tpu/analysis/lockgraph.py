@@ -72,27 +72,36 @@ class FuncSummary:
 
 def lock_kinds(model: ModuleModel) -> Dict[str, str]:
     """Map lock display text -> 'Lock'/'RLock' from assignments like
-    ``X = threading.Lock()`` / ``self._x = threading.RLock()``."""
+    ``X = threading.Lock()`` / ``self._x = threading.RLock()``
+    (annotated assignments included)."""
     kinds: Dict[str, str] = {}
     for node in ast.walk(model.tree):
-        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target, value = node.target, node.value
+        else:
             continue
-        value = node.value
         if not isinstance(value, ast.Call):
             continue
         name = astutil.call_name(value)
         if name not in ("Lock", "RLock"):
             continue
-        target = node.targets[0]
         if isinstance(target, (ast.Name, ast.Attribute)):
             kinds[astutil.expr_text(target)] = name
     return kinds
 
 
-def _lock_expr(item: ast.withitem) -> Optional[str]:
-    """The with-item's expression text when it looks like a lock."""
+def _lock_expr(item: ast.withitem,
+               kinds: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """The with-item's expression text when it looks like a lock: a
+    lockish name, or — regardless of name — an expression we saw
+    assigned from ``threading.Lock()`` / ``RLock()`` (``self._meta =
+    threading.Lock()`` guards just as hard as ``self._lock``)."""
     expr = item.context_expr
     text = astutil.expr_text(expr)
+    if kinds and text in kinds:
+        return text
     tail = text.rsplit(".", 1)[-1]
     if "lock" in tail.lower() or "mutex" in tail.lower():
         return text
@@ -134,7 +143,7 @@ def summarize_module(
         for node in own_body:
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
-                    display = _lock_expr(item)
+                    display = _lock_expr(item, kinds)
                     if display is None:
                         continue
                     s.locks.append(LockSite(

@@ -51,6 +51,7 @@ def _load() -> None:
     from . import rules_spmd  # noqa: F401, PLC0415
     from . import rules_concurrency  # noqa: F401, PLC0415
     from . import rules_mesh  # noqa: F401, PLC0415
+    from . import rules_races  # noqa: F401, PLC0415
 
 
 def all_rules() -> Dict[str, Rule]:

@@ -809,7 +809,11 @@ class EagerEngine:
                 self._pm.record_bytes(_response_bytes(resp))
             proposal = self._pm.cycle()
             if proposal is not None:
-                self._pending_params = proposal.as_wire()
+                # Same write the replay path makes under the lock:
+                # _pending_params is drained under self._lock at cycle
+                # start, so the publish side must hold it too.
+                with self._lock:
+                    self._pending_params = proposal.as_wire()
 
         # ---- replay arming: judge this cycle's stability --------------
         # Every input below is shared data (gathered control vector,

@@ -824,11 +824,16 @@ class FrontDoor:
     # ------------------------------------------------------------- state
 
     def _publish_doc(self) -> None:
-        self._kv.put(SCOPE, FRONTDOOR_KEY, pickle.dumps({
-            "frontends": self.frontends,
-            "owners": dict(self.owners),
-            "fd_epoch": self.fd_epoch,
-        }))
+        # Snapshot under the lock, put outside it: the supervisor
+        # mutates owners/fd_epoch under self._lock, and the KV put is
+        # network I/O that must not ride inside the critical section.
+        with self._lock:
+            doc = {
+                "frontends": self.frontends,
+                "owners": dict(self.owners),
+                "fd_epoch": self.fd_epoch,
+            }
+        self._kv.put(SCOPE, FRONTDOOR_KEY, pickle.dumps(doc))
 
     def _publish_gauges(self) -> None:
         from ..obs import get_registry  # noqa: PLC0415
@@ -852,11 +857,19 @@ class FrontDoor:
         for p in self._pumps.values():
             for s, c in p.ingested_by_shard.items():
                 by_shard[s] = by_shard.get(s, 0) + c
+        # stats() runs on bench/test/metrics threads while the
+        # supervisor mutates this state under self._lock mid-takeover:
+        # iterating self.owners bare can observe a dict resize, and a
+        # bare fd_epoch/takeovers pair can be torn across a takeover.
+        with self._lock:
+            owners = {int(k): int(v) for k, v in self.owners.items()}
+            fd_epoch = self.fd_epoch
+            takeovers = self.takeovers
         return {
             "frontends": self.frontends,
-            "owners": {int(k): int(v) for k, v in self.owners.items()},
-            "fd_epoch": self.fd_epoch,
-            "takeovers": self.takeovers,
+            "owners": owners,
+            "fd_epoch": fd_epoch,
+            "takeovers": takeovers,
             "ingested_by_shard": {int(s): by_shard[s]
                                   for s in sorted(by_shard)},
         }
@@ -1013,6 +1026,7 @@ class FrontDoor:
                 self.owners[s] = owner
             self.fd_epoch += 1
             self.takeovers += 1
+            fd_epoch = self.fd_epoch
             self._events.append({"fid": fid, "owner": owner,
                                  "shards": list(shards)})
         self._publish_doc()
@@ -1020,7 +1034,7 @@ class FrontDoor:
         reg.counter("serve.frontend.takeovers").inc()
         LOG.warning("frontend %d dead; shards %s taken over by "
                     "frontend %d (fd_epoch %d)", fid, shards, owner,
-                    self.fd_epoch)
+                    fd_epoch)
 
     def stop(self) -> None:
         self._stop.set()

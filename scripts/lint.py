@@ -23,7 +23,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # mistaken for path arguments when deciding whether to default to
 # --changed ("--format json" carries no path).
 _VALUE_FLAGS = {"--format", "--baseline", "--rules", "--root",
-                "--write-baseline"}
+                "--write-baseline", "--jobs", "-j"}
 
 
 def _has_explicit_paths(args: list) -> bool:

@@ -462,6 +462,142 @@ FIXTURES = {
             signal.signal(signal.SIGTERM, _handler)
         """,
     ),
+    "HVDC108": (
+        """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._depth += 1
+                with self._lock:
+                    self._depth -= 1
+
+            def depth(self):
+                with self._lock:
+                    return self._depth
+
+            def spill(self):
+                self._depth = 0  # write outside the inferred guard
+        """,
+        """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._depth += 1
+                with self._lock:
+                    self._depth -= 1
+
+            def depth(self):
+                with self._lock:
+                    return self._depth
+
+            def spill(self):
+                with self._lock:
+                    self._depth = 0
+        """,
+    ),
+    "HVDC109": (
+        """
+        import threading
+
+        class Gauge:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._value += 1
+                with self._lock:
+                    self._value = 0
+
+            def peek(self):
+                return self._value  # read outside the write guard
+        """,
+        """
+        import threading
+
+        class Gauge:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._value += 1
+                with self._lock:
+                    self._value = 0
+
+            def peek(self):
+                with self._lock:
+                    return self._value
+        """,
+    ),
+    "HVDC110": (
+        """
+        import threading
+
+        class Once:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._started = False
+
+            def launch(self):
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                with self._lock:
+                    self._started = False
+
+            def start(self):
+                if not self._started:  # test outside the lock
+                    with self._lock:
+                        self._started = True  # act under it
+        """,
+        """
+        import threading
+
+        class Once:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._started = False
+
+            def launch(self):
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                with self._lock:
+                    self._started = False
+
+            def start(self):
+                with self._lock:
+                    if not self._started:
+                        self._started = True
+        """,
+    ),
 }
 
 
@@ -967,6 +1103,340 @@ def test_pr4_reentrant_flush_deadlock_shape(tmp_path):
     assert not _new(findings, "HVDC103")
 
 
+# ---------------------------------------------------------------------------
+# race rules (HVDC108-110): guarded-by inference edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_racer_init_writes_exempt(tmp_path):
+    """Construction-time writes (in __init__ and init-only callees,
+    before the first escape) are exempt from guard coverage: they
+    happen before any other thread can hold a reference."""
+    src = """
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []       # unguarded, but pre-escape
+                self._fill()
+
+            def _fill(self):
+                self._rows.append(0)  # init-only callee: same exemption
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._rows.append(1)
+                with self._lock:
+                    self._rows.append(2)
+                with self._lock:
+                    self._rows.pop()
+                with self._lock:
+                    self._rows.clear()
+
+            def snap(self):
+                with self._lock:
+                    return list(self._rows)
+    """
+    findings = _lint_source(tmp_path, src)
+    assert not _new(findings, "HVDC108"), \
+        [f.message for f in _new(findings, "HVDC108")]
+    assert not _new(findings, "HVDC109")
+
+
+def test_racer_unescaped_class_never_reported(tmp_path):
+    """The RacerD ownership rule: a lock-owning class whose instances
+    never escape to another thread (no spawn, no registry handoff, no
+    module global) is single-threaded as far as the analysis can see —
+    even a field with a broken guard protocol stays quiet."""
+    src = """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def _run(self):
+                with self._lock:
+                    self._depth += 1
+                with self._lock:
+                    self._depth -= 1
+
+            def depth(self):
+                with self._lock:
+                    return self._depth
+
+            def spill(self):
+                self._depth = 0  # would be HVDC108 if Pump escaped
+    """
+    findings = _lint_source(tmp_path, src)
+    for rid in ("HVDC108", "HVDC109", "HVDC110"):
+        assert not _new(findings, rid), rid
+
+
+def test_racer_callee_held_lock_counts_as_guarded(tmp_path):
+    """Interprocedural held-lock closure: a write in a helper with no
+    visible ``with`` is guarded when EVERY call path into the helper
+    holds the lock (the HVDC101-style fixpoint) — and becomes a finding
+    the moment one lockless call site appears."""
+    quiet = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._bump()
+                with self._lock:
+                    self._n = 0
+
+            def get(self):
+                with self._lock:
+                    return self._n
+
+            def peek(self):
+                with self._lock:
+                    return self._n
+
+            def _bump(self):
+                self._n += 1  # every caller holds self._lock
+    """
+    findings = _lint_source(tmp_path, quiet)
+    assert not _new(findings, "HVDC108"), \
+        [f.message for f in _new(findings, "HVDC108")]
+    racy = quiet + """
+            def poke(self):
+                self._bump()  # lockless path into the helper
+    """
+    findings = _lint_source(tmp_path, racy)
+    hits = _new(findings, "HVDC108")
+    assert hits, "lockless call path into _bump must fire"
+    assert "Counter" in hits[0].message
+    assert "_n" in hits[0].message
+    assert "_lock" in hits[0].message
+
+
+def test_racer_no_dominant_guard_stays_quiet(tmp_path):
+    """Threshold edge: with one guarded write, one unguarded write and
+    an unguarded read, no lock reaches the guard fraction on either the
+    all-access or the write-side criterion — no discernible discipline
+    means nothing to enforce (reporting here would be noise)."""
+    src = """
+        import threading
+
+        class Mixed:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._x = 1
+
+            def a(self):
+                self._x = 2
+
+            def b(self):
+                return self._x
+    """
+    findings = _lint_source(tmp_path, src)
+    assert not _new(findings, "HVDC108")
+    assert not _new(findings, "HVDC109")
+
+
+# ---------------------------------------------------------------------------
+# PR-20 self-application regressions: the races the rules found & fixed
+# ---------------------------------------------------------------------------
+
+
+def test_race_fix_engine_pending_params_shape(tmp_path):
+    """Reduced shape of the EagerEngine._pending_params race: the
+    negotiation loop drains the field under the engine lock and the
+    replay path writes it under the lock, but the post-negotiation
+    store skipped it.  HVDC108 must fire on the lockless store and go
+    quiet once it is inside the lock — the shipped fix in
+    runtime/engine.py."""
+    bad = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = None
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        req = self._pending
+                        self._pending = None
+                    self._negotiate(req)
+
+            def _negotiate(self, req):
+                self._pending = req  # the bug: lockless store
+
+            def replay(self, req):
+                with self._lock:
+                    self._pending = req
+    """
+    findings = _lint_source(tmp_path, bad, name="engine_shape.py")
+    hits = _new(findings, "HVDC108")
+    assert hits, "the pending-params shape must be rejected"
+    assert "_pending" in hits[0].message
+    fixed = bad.replace(
+        "self._pending = req  # the bug: lockless store",
+        "with self._lock:\n"
+        "                    self._pending = req",
+    )
+    findings = _lint_source(tmp_path, fixed, name="engine_shape.py")
+    assert not _new(findings, "HVDC108"), \
+        [f.message for f in _new(findings, "HVDC108")]
+
+
+def test_race_fix_frontend_stats_snapshot_shape(tmp_path):
+    """Reduced shape of the FrontDoor.stats() race: the supervisor
+    thread mutates owners/epoch under the lock while stats() reads them
+    bare (the one-guarded-writer-many-lockless-readers shape that the
+    write-side guard criterion exists for).  HVDC109 must fire on both
+    fields; the snapshot-under-lock fix must be quiet."""
+    bad = """
+        import threading
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.owners = {}
+                self.epoch = 0
+
+            def start(self):
+                threading.Thread(target=self._watch).start()
+
+            def _watch(self):
+                while True:
+                    with self._lock:
+                        self.owners = {"s0": "fe1"}
+                        self.epoch += 1
+
+            def stats(self):
+                return {"owners": dict(self.owners),
+                        "epoch": self.epoch}
+    """
+    findings = _lint_source(tmp_path, bad, name="door_shape.py")
+    hits = _new(findings, "HVDC109")
+    assert {m for f in hits for m in ("owners", "epoch")
+            if m in f.message} == {"owners", "epoch"}, \
+        [f.message for f in hits]
+    fixed = bad.replace(
+        'return {"owners": dict(self.owners),\n'
+        '                        "epoch": self.epoch}',
+        'with self._lock:\n'
+        '                    return {"owners": dict(self.owners),\n'
+        '                            "epoch": self.epoch}',
+    )
+    assert fixed != bad
+    findings = _lint_source(tmp_path, fixed, name="door_shape.py")
+    assert not _new(findings, "HVDC109"), \
+        [f.message for f in _new(findings, "HVDC109")]
+
+
+def test_race_fix_frontend_publish_doc_shape(tmp_path):
+    """Reduced shape of the FrontDoor._publish_doc race: building the
+    discovery document read owners/epoch with no lock before handing it
+    to the KV store.  The fix snapshots under the lock and publishes
+    outside it (publishing INSIDE would trade the race for an HVDC102
+    blocking-call-under-lock finding)."""
+    bad = """
+        import threading
+
+        class Door:
+            def __init__(self, kv):
+                self._lock = threading.Lock()
+                self._kv = kv
+                self.owners = {}
+                self.epoch = 0
+
+            def start(self):
+                threading.Thread(target=self._watch).start()
+
+            def _watch(self):
+                while True:
+                    with self._lock:
+                        self.owners = {"s0": "fe1"}
+                        self.epoch += 1
+                    self.publish()
+
+            def publish(self):
+                doc = {"owners": dict(self.owners),
+                       "epoch": self.epoch}
+                self._kv.put("frontends", doc)
+    """
+    findings = _lint_source(tmp_path, bad, name="publish_shape.py")
+    assert _new(findings, "HVDC109"), "lockless doc build must fire"
+    fixed = bad.replace(
+        'doc = {"owners": dict(self.owners),\n'
+        '                       "epoch": self.epoch}\n',
+        'with self._lock:\n'
+        '                    doc = {"owners": dict(self.owners),\n'
+        '                           "epoch": self.epoch}\n',
+    )
+    assert fixed != bad
+    findings = _lint_source(tmp_path, fixed, name="publish_shape.py")
+    assert not _new(findings, "HVDC109"), \
+        [f.message for f in _new(findings, "HVDC109")]
+
+
+def test_race_fix_frontend_takeover_log_read_shape(tmp_path):
+    """Reduced shape of the FrontDoor._takeover race: the epoch bump
+    happens under the lock but the log line after the block re-reads
+    the field bare — a second takeover can bump it in between, logging
+    the wrong epoch.  The fix captures a local inside the block."""
+    bad = """
+        import threading
+
+        class Door:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.epoch = 0
+
+            def start(self):
+                threading.Thread(target=self._watch).start()
+
+            def _watch(self):
+                with self._lock:
+                    self.epoch += 1
+                print("took over at epoch", self.epoch)
+    """
+    findings = _lint_source(tmp_path, bad, name="takeover_shape.py")
+    hits = _new(findings, "HVDC109")
+    assert hits and "epoch" in hits[0].message
+    fixed = bad.replace(
+        "self.epoch += 1\n"
+        '                print("took over at epoch", self.epoch)',
+        "self.epoch += 1\n"
+        "                    epoch = self.epoch\n"
+        '                print("took over at epoch", epoch)',
+    )
+    assert fixed != bad
+    findings = _lint_source(tmp_path, fixed, name="takeover_shape.py")
+    assert not _new(findings, "HVDC109"), \
+        [f.message for f in _new(findings, "HVDC109")]
+
+
 def test_self_application_is_clean_against_baseline():
     """The shipped tree lints clean: no new findings over horovod_tpu/
     + examples/ + scripts/ once the committed baseline (reasoned false
@@ -1463,5 +1933,164 @@ def test_lint_script_flag_values_not_paths():
     assert not lint_script._has_explicit_paths(["--format", "json"])
     assert not lint_script._has_explicit_paths(
         ["--rules", "HVD001", "--format=json"])
+    assert not lint_script._has_explicit_paths(["--jobs", "4"])
+    assert not lint_script._has_explicit_paths(["-j", "4"])
     assert lint_script._has_explicit_paths(["horovod_tpu"])
     assert lint_script._has_explicit_paths(["--format", "json", "a.py"])
+
+
+# ---------------------------------------------------------------------------
+# --jobs: parallel per-file analysis
+# ---------------------------------------------------------------------------
+
+
+def test_jobs_parallel_matches_serial(tmp_path):
+    """A --jobs run must be bit-identical to a serial run: same
+    findings (rule/path/line/status) over a mixed dirty tree, including
+    project-scope race findings whose closure runs in-process."""
+    (tmp_path / "a.py").write_text(textwrap.dedent(FIXTURES["HVD001"][0]))
+    (tmp_path / "b.py").write_text(textwrap.dedent(FIXTURES["HVDC108"][0]))
+    (tmp_path / "c.py").write_text(textwrap.dedent(FIXTURES["HVD002"][1]))
+    (tmp_path / "d.py").write_text(textwrap.dedent(FIXTURES["HVDC109"][0]))
+    key = lambda fs: [(f.rule, f.path, f.line, f.status) for f in fs]  # noqa: E731
+    serial = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    par = analyze_paths([str(tmp_path)], root=str(tmp_path), jobs=3)
+    assert key(par) == key(serial)
+    assert any(f.rule == "HVDC108" for f in par)
+
+
+def test_jobs_cache_written_by_workers_is_coherent(tmp_path, monkeypatch):
+    """The cache a parallel run persists must satisfy a later serial
+    run as a plain content-hash hit — worker results travel in cache-
+    entry shape, so an incoherent merge would show up here as a module-
+    rule recompute (or wrong findings)."""
+    from horovod_tpu.analysis import registry
+
+    (tmp_path / "a.py").write_text(textwrap.dedent(FIXTURES["HVD001"][0]))
+    (tmp_path / "b.py").write_text(textwrap.dedent(FIXTURES["HVDC108"][0]))
+    cache = tmp_path / "cache.json"
+    first = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                          cache_path=str(cache), jobs=2)
+    assert cache.is_file()
+
+    def boom(model):
+        raise AssertionError(f"module rules re-ran for {model.relpath}")
+
+    monkeypatch.setattr(registry, "run_module_rules", boom)
+    warm = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                         cache_path=str(cache))
+    key = lambda fs: [(f.rule, f.path, f.line) for f in fs]  # noqa: E731
+    assert key(warm) == key(first)
+
+
+@pytest.mark.serial
+def test_cli_jobs_flag(cli_tmp):
+    r = _run_cli(["bad.py", "--jobs", "2", "--no-cache"], cwd=cli_tmp)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "HVD001" in r.stdout
+    r = _run_cli(["bad.py", "--jobs", "-3"], cwd=cli_tmp)
+    assert r.returncode == 2
+    assert "--jobs" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# --changed hardening + wrapper-level coverage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serial
+def test_changed_handles_non_ascii_paths(tmp_path):
+    """Text-mode ``git diff`` C-quotes non-ASCII paths (core.quotePath
+    default), which the isfile() filter then silently drops — the file
+    escapes the lint. ``-z`` keeps the bytes verbatim."""
+    def git(*a):
+        subprocess.run(
+            ["git", *a], cwd=tmp_path, check=True, capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    from horovod_tpu.analysis.cli import _changed_files
+
+    git("init", "-q")
+    (tmp_path / "sürface.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (tmp_path / "sürface.py").write_text("x = 2\n")
+    assert _changed_files(str(tmp_path)) == ["sürface.py"]
+
+
+@pytest.mark.serial
+def test_lint_script_survives_deleted_and_renamed_files(tmp_path):
+    """Wrapper-level regression for the reported dev-loop crash: the
+    `python scripts/lint.py` entry (which defaults to --changed) must
+    ride out a working tree with deletions and renames."""
+    def git(*a):
+        subprocess.run(
+            ["git", *a], cwd=tmp_path, check=True, capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.hvdtpu-lint]
+        paths = ["src"]
+        baseline = ""
+    """))
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "doomed.py").write_text("x = 1\n")
+    (src / "old_name.py").write_text("y = 2\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (src / "doomed.py").unlink()
+    (src / "old_name.py").rename(src / "new_name.py")
+    (src / "fresh.py").write_text(
+        textwrap.dedent(FIXTURES["HVD001"][0]))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "lint.py"),
+         "--root", str(tmp_path)],
+        cwd=tmp_path, capture_output=True, text=True, timeout=120,
+        env={**os.environ,
+             "PYTHONPATH": _REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "HVD001" in r.stdout
+    assert "Traceback" not in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# configured surface audit
+# ---------------------------------------------------------------------------
+
+
+def test_configured_surface_covers_package():
+    """[tool.hvdtpu-lint] paths must cover EVERY python file under
+    horovod_tpu/ except explicit excludes: a subpackage added without
+    updating the config would otherwise silently escape the CI gate."""
+    from horovod_tpu.analysis.cli import _iter_py_files
+
+    cfg = load_config(_REPO)
+    surface = set(_iter_py_files(cfg.paths, cfg.exclude, _REPO))
+    excl = [os.path.normpath(os.path.join(_REPO, e))
+            for e in cfg.exclude]
+
+    def excluded(p):
+        np_ = os.path.normpath(p)
+        return any(np_ == e or np_.startswith(e + os.sep) for e in excl)
+
+    missing = []
+    pkg = os.path.join(_REPO, "horovod_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            fp = os.path.join(dirpath, fn)
+            if fn.endswith(".py") and not excluded(fp) \
+                    and fp not in surface:
+                missing.append(os.path.relpath(fp, _REPO))
+    assert not missing, \
+        f"python files outside the configured lint surface: {missing}"

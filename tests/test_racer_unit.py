@@ -1,0 +1,233 @@
+"""Unit tests for the race-analysis engine internals (ISSUE 20).
+
+tests/test_analysis.py covers the rule surface (HVDC108-110 fixtures,
+edge cases, CLI); this file pins the racer's building blocks directly —
+lock-identity normalization, escape witnesses, the entry-lock meet
+fixpoint, and assignment-fact lock detection — so a refactor that
+breaks one layer fails here with the layer named, not three rules away.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis import racer
+from horovod_tpu.analysis.core import load_module
+from horovod_tpu.analysis.lockgraph import CallGraph, lock_kinds
+from horovod_tpu.analysis.racer import _norm_lock, analyze
+
+
+def _graph(tmp_path, sources):
+    models = []
+    for name, src in sources.items():
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        model = load_module(str(p), name)
+        assert model is not None, name
+        models.append(model)
+    g = CallGraph(models)
+    g.close_summaries()
+    return g
+
+
+def test_norm_lock_collapses_subscripts_and_calls():
+    # shard-striped locks: every index spelling is ONE guard
+    assert _norm_lock("m.py::C.self._locks[shard]") == \
+        "m.py::C.self._locks[*]"
+    assert _norm_lock("m.py::C.self._locks[i % 4]") == \
+        "m.py::C.self._locks[*]"
+    # helper-call form, nested brackets collapse to the outer shape
+    assert _norm_lock("m.py::C.self.lock_of(k[0])") == \
+        "m.py::C.self.lock_of(*)"
+    # no brackets: identity
+    assert _norm_lock("m.py::C.self._lock") == "m.py::C.self._lock"
+
+
+def test_escape_witnesses(tmp_path):
+    g = _graph(tmp_path, {"esc.py": """
+        import threading
+
+        REGISTRY = []
+
+        class Spawner:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                pass
+
+        class Subclassed(threading.Thread):
+            def run(self):
+                pass
+
+        class Registered:
+            def arm(self):
+                REGISTRY.append(0)
+                register(self._cb)
+
+            def _cb(self):
+                pass
+
+        class GlobalBound:
+            def tick(self):
+                pass
+
+        SINGLETON = GlobalBound()
+
+        class Private:
+            def _run(self):
+                pass
+    """})
+    escapes, entries = racer.find_escapes_and_entries(g)
+    escaped = {cls for (_, cls) in escapes}
+    assert {"Spawner", "Subclassed", "Registered", "GlobalBound"} \
+        <= escaped
+    assert "Private" not in escaped
+    # the spawn target runs on the new thread with no locks held
+    assert any(qn.endswith("Spawner._run") for (_, qn) in entries)
+
+
+def test_entry_lock_meet_over_callers(tmp_path):
+    """A helper's guaranteed locks are the MEET (intersection) over its
+    call paths: all-guarded callers credit the lock; one lockless path
+    (here: a thread entry) takes it away."""
+    src_all_guarded = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._bump()
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self._n += 1
+    """
+    g = _graph(tmp_path, {"meet.py": src_all_guarded})
+    analysis = analyze(g)
+    (bump_key,) = [k for k in analysis.entry_locks
+                   if k[1].endswith("C._bump")]
+    held = analysis.entry_locks[bump_key]
+    assert any(lock.endswith("self._lock") for lock in held), held
+
+    src_one_bare = src_all_guarded + """
+            def poke(self):
+                self._bump()
+    """
+    g = _graph(tmp_path, {"meet.py": src_one_bare})
+    analysis = analyze(g)
+    (bump_key,) = [k for k in analysis.entry_locks
+                   if k[1].endswith("C._bump")]
+    assert analysis.entry_locks[bump_key] == frozenset()
+
+
+def test_lock_kinds_sees_nonlockish_names(tmp_path):
+    """Assignment facts, not name heuristics: ``self._meta =
+    threading.Lock()`` makes ``with self._meta:`` a real guard even
+    though the name never says 'lock'."""
+    p = tmp_path / "meta.py"
+    p.write_text(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._meta = threading.Lock()
+                self._owners = {}
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._meta:
+                    self._owners["a"] = 1
+                with self._meta:
+                    self._owners.pop("a", None)
+
+            def snap(self):
+                with self._meta:
+                    return dict(self._owners)
+    """))
+    model = load_module(str(p), "meta.py")
+    kinds = lock_kinds(model)
+    assert kinds.get("self._meta") == "Lock"
+    g = CallGraph([model])
+    g.close_summaries()
+    analysis = analyze(g)
+    # fully disciplined under the oddly-named lock: no reports
+    assert analysis.reports == []
+
+
+def test_field_report_names_guard_and_coverage(tmp_path):
+    g = _graph(tmp_path, {"rep.py": """
+        import threading
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self._d += 1
+                with self._lock:
+                    self._d -= 1
+
+            def read(self):
+                with self._lock:
+                    return self._d
+
+            def spill(self):
+                self._d = 0
+    """})
+    analysis = analyze(g)
+    (report,) = analysis.reports
+    assert (report.cls, report.attr) == ("P", "_d")
+    assert report.guard_display == "P.self._lock"
+    assert (report.guarded, report.counted) == (3, 4)
+    assert len(report.unguarded_writes) == 1
+    assert report.unguarded_reads == []
+
+
+def test_check_then_act_pair_lines(tmp_path):
+    g = _graph(tmp_path, {"cta.py": """
+        import threading
+
+        class Once:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._started = False
+
+            def launch(self):
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                with self._lock:
+                    self._started = False
+
+            def begin(self):
+                if not self._started:
+                    with self._lock:
+                        self._started = True
+    """})
+    analysis = analyze(g)
+    (pair,) = analysis.check_act
+    assert (pair.cls, pair.attr) == ("Once", "_started")
+    assert pair.act_line == pair.test_line + 2
+    assert pair.func[1].endswith("Once.begin")
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
